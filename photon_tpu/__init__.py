@@ -1,0 +1,20 @@
+"""photon-tpu: a TPU-native framework with the capabilities of LinkedIn
+Photon-ML (distributed GLMs + GAME mixed-effect models).
+
+Compute path: JAX/XLA (jit, shard_map over a device Mesh, psum over ICI).
+See SURVEY.md for the component-by-component mapping to the reference.
+"""
+
+__version__ = "0.1.0"
+
+from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+from photon_tpu.optim.regularization import RegularizationContext, RegularizationType
+from photon_tpu.ops.losses import TaskType
+
+__all__ = [
+    "OptimizerConfig",
+    "OptimizerType",
+    "RegularizationContext",
+    "RegularizationType",
+    "TaskType",
+]

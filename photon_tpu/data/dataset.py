@@ -1,0 +1,72 @@
+"""Labeled data containers.
+
+Reference parity: com.linkedin.photon.ml.data.LabeledPoint (label, features,
+offset, weight) and the GameDatum 4-tuple. A GLMBatch is the whole (or one
+device-shard of the) dataset as arrays-of-structs: TPU-friendly, statically
+shaped. Padding rows carry weight 0 so all reductions ignore them.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.matrix import Matrix, SparseRows
+
+
+class GLMBatch(NamedTuple):
+    X: Matrix
+    y: jax.Array  # (n,)
+    weights: jax.Array  # (n,) — 0.0 marks padding
+    offsets: jax.Array  # (n,)
+
+    @property
+    def n(self) -> int:
+        return self.y.shape[0]
+
+
+def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
+    y = jnp.asarray(y, jnp.float32)
+    n = y.shape[0]
+    if weights is None:
+        weights = jnp.ones((n,), jnp.float32)
+    if offsets is None:
+        offsets = jnp.zeros((n,), jnp.float32)
+    if not isinstance(X, SparseRows):
+        X = jnp.asarray(X, jnp.float32)
+    return GLMBatch(X, y, jnp.asarray(weights, jnp.float32),
+                    jnp.asarray(offsets, jnp.float32))
+
+
+def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
+    """Pad with zero-weight rows so shards divide evenly across the mesh."""
+    n = batch.n
+    if target_n == n:
+        return batch
+    extra = target_n - n
+    X = batch.X
+    if isinstance(X, SparseRows):
+        X = SparseRows(
+            jnp.concatenate([X.indices, jnp.zeros((extra, X.indices.shape[1]), jnp.int32)]),
+            jnp.concatenate([X.values, jnp.zeros((extra, X.values.shape[1]), jnp.float32)]),
+            X.n_features,
+        )
+    else:
+        X = jnp.concatenate([X, jnp.zeros((extra, X.shape[1]), X.dtype)])
+    zeros = jnp.zeros((extra,), jnp.float32)
+    return GLMBatch(
+        X,
+        jnp.concatenate([batch.y, zeros]),
+        jnp.concatenate([batch.weights, zeros]),
+        jnp.concatenate([batch.offsets, zeros]),
+    )
+
+
+def with_offsets(batch: GLMBatch, offsets) -> GLMBatch:
+    return batch._replace(offsets=jnp.asarray(offsets, jnp.float32))
+
+
+def total_weight(batch: GLMBatch) -> float:
+    return float(np.sum(np.asarray(batch.weights)))

@@ -1,0 +1,88 @@
+"""Generalized linear model classes.
+
+Reference parity: com.linkedin.photon.ml.supervised.model.GeneralizedLinearModel
+and its subclasses (classification.LogisticRegressionModel,
+regression.{LinearRegressionModel, PoissonRegressionModel},
+classification.SmoothedHingeLossLinearSVMModel), plus model.Coefficients
+(means + optional variances).
+
+The intercept, as in the reference, is just another feature column
+(Constants.INTERCEPT_KEY); nothing here special-cases it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.matrix import Matrix, matvec
+from photon_tpu.ops.losses import TaskType, mean_fn
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("means", "variances"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Reference: com.linkedin.photon.ml.model.Coefficients."""
+
+    means: jax.Array  # (d,)
+    variances: Optional[jax.Array] = None  # (d,) or None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[0]
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("coefficients",),
+    meta_fields=("task",),
+)
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    coefficients: Coefficients
+    task: TaskType
+
+    @property
+    def weights(self) -> jax.Array:
+        return self.coefficients.means
+
+    def score(self, X: Matrix, offsets=0.0) -> jax.Array:
+        """Raw margin x·w + offset (reference: computeScore)."""
+        return matvec(X, self.coefficients.means) + offsets
+
+    def predict_mean(self, X: Matrix, offsets=0.0) -> jax.Array:
+        """Mean response via the inverse link (reference: computeMean)."""
+        return mean_fn(self.task)(self.score(X, offsets))
+
+    def predict_class(self, X: Matrix, offsets=0.0, threshold=0.5) -> jax.Array:
+        """Binary decision for classification tasks."""
+        if self.task is TaskType.LOGISTIC_REGRESSION:
+            return (self.predict_mean(X, offsets) >= threshold).astype(jnp.int32)
+        if self.task is TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+            return (self.score(X, offsets) >= 0.0).astype(jnp.int32)
+        raise ValueError(f"{self.task} is not a classification task")
+
+
+def logistic_regression(coeffs, variances=None):
+    return GeneralizedLinearModel(
+        Coefficients(jnp.asarray(coeffs), variances), TaskType.LOGISTIC_REGRESSION
+    )
+
+
+def linear_regression(coeffs, variances=None):
+    return GeneralizedLinearModel(
+        Coefficients(jnp.asarray(coeffs), variances), TaskType.LINEAR_REGRESSION
+    )
+
+
+def poisson_regression(coeffs, variances=None):
+    return GeneralizedLinearModel(
+        Coefficients(jnp.asarray(coeffs), variances), TaskType.POISSON_REGRESSION
+    )

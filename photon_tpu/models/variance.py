@@ -1,0 +1,36 @@
+"""Coefficient variance computation.
+
+Reference parity: com.linkedin.photon.ml.optimization.VarianceComputationType
+{NONE, SIMPLE, FULL} and DistributedOptimizationProblem.computeVariances:
+- SIMPLE: var_j = 1 / H_jj (inverse of the Hessian diagonal)
+- FULL:   var = diag(H^{-1}) via Cholesky (small feature spaces only)
+"""
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.ops.objective import Objective
+
+
+class VarianceComputationType(enum.Enum):
+    NONE = "none"
+    SIMPLE = "simple"
+    FULL = "full"
+
+
+def compute_variances(
+    obj: Objective, w: jax.Array, batch: GLMBatch, kind: VarianceComputationType
+):
+    if kind is VarianceComputationType.NONE:
+        return None
+    if kind is VarianceComputationType.SIMPLE:
+        return 1.0 / jnp.maximum(obj.hess_diag(w, batch), 1e-12)
+    H = obj.full_hessian(w, batch)
+    d = H.shape[0]
+    Hinv = jnp.linalg.solve(H + 1e-12 * jnp.eye(d, dtype=H.dtype),
+                            jnp.eye(d, dtype=H.dtype))
+    return jnp.diag(Hinv)

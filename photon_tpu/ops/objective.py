@@ -1,0 +1,124 @@
+"""GLM objective: value / gradient / Hessian products over a (possibly
+device-sharded) batch.
+
+Reference parity: com.linkedin.photon.ml.function.glm.{DistributedGLMLossFunction,
+SingleNodeGLMLossFunction} and function.L2RegularizationTwiceDiffFunction.
+Where the reference aggregates per-partition (value, gradient) pairs with
+`RDD.treeAggregate(depth=2)`, here each device computes its local partial sum
+and a single `lax.psum` over the mesh's data axis combines them across the
+ICI — one fused all-reduce instead of a JVM aggregation tree.
+
+All quantities use the reference's *sum* convention (weighted sum over
+examples, not mean), so regularization weights mean the same thing.
+
+Everything is shape-static and jit/vmap-safe: the same `Objective` drives the
+distributed fixed-effect solve (under shard_map) and the vmapped per-entity
+random-effect solves.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.data.matrix import matvec, rmatvec, sq_rmatvec, weighted_gram
+from photon_tpu.ops.losses import TaskType, loss_fns
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """Smooth part of the regularized negative log-likelihood.
+
+    l2 is the smooth L2 weight; the non-smooth L1 term is owned by OWL-QN
+    (as in the reference, where Breeze's OWLQN adds the L1 term itself).
+
+    reg_mask: optional (d,) 0/1 per-coordinate regularization mask (used to
+    exclude the intercept column when configured; reference regularizes the
+    intercept, so the default is all-ones = None).
+
+    prior_mean / prior_precision: informative-prior (incremental training)
+    parameters; the L2 term becomes 0.5 Σ_j (l2 + τ_j)(w_j - μ_j)² with μ=0,
+    τ=0 when absent. Reference: function.PriorDistribution.
+    """
+
+    task: TaskType
+    l2: float = 0.0
+    axis_name: Optional[str] = None
+    reg_mask: Optional[jax.Array] = None
+    prior_mean: Optional[jax.Array] = None
+    prior_precision: Optional[jax.Array] = None
+
+    # ---------------------------------------------------------------- helpers
+    def _psum(self, x):
+        if self.axis_name is None:
+            return x
+        return lax.psum(x, self.axis_name)
+
+    def _margin(self, w, batch: GLMBatch):
+        return matvec(batch.X, w) + batch.offsets
+
+    def _reg_terms(self, w):
+        """(value, grad) of the smooth regularizer at w."""
+        mask = self.reg_mask if self.reg_mask is not None else 1.0
+        mu = self.prior_mean if self.prior_mean is not None else 0.0
+        tau = self.prior_precision if self.prior_precision is not None else 0.0
+        dw = w - mu
+        coeff = (self.l2 + tau) * mask
+        value = 0.5 * jnp.sum(coeff * dw * dw)
+        grad = coeff * dw
+        return value, grad
+
+    def _reg_hess_diag(self, w):
+        mask = self.reg_mask if self.reg_mask is not None else 1.0
+        tau = self.prior_precision if self.prior_precision is not None else 0.0
+        return (self.l2 + tau) * mask * jnp.ones_like(w)
+
+    # ------------------------------------------------------------------- API
+    def value(self, w, batch: GLMBatch):
+        return self.value_and_grad(w, batch)[0]
+
+    def grad(self, w, batch: GLMBatch):
+        return self.value_and_grad(w, batch)[1]
+
+    def value_and_grad(self, w, batch: GLMBatch):
+        loss, d1, _ = loss_fns(self.task)
+        z = self._margin(w, batch)
+        local_value = jnp.sum(batch.weights * loss(z, batch.y))
+        local_grad = rmatvec(batch.X, batch.weights * d1(z, batch.y))
+        value = self._psum(local_value)
+        grad = self._psum(local_grad)
+        rv, rg = self._reg_terms(w)
+        return value + rv, grad + rg
+
+    def hvp(self, w, batch: GLMBatch, v):
+        """Hessian-vector product: X^T diag(weight · d2) X v + reg·v.
+
+        Reference: TwiceDiffFunction.hessianVector — computed the same way
+        (Gauss-Newton form is exact for GLMs) per partition + treeAggregate.
+        """
+        _, _, d2 = loss_fns(self.task)
+        z = self._margin(w, batch)
+        Xv = matvec(batch.X, v)
+        local = rmatvec(batch.X, batch.weights * d2(z, batch.y) * Xv)
+        hv = self._psum(local)
+        return hv + self._reg_hess_diag(w) * v
+
+    def hess_diag(self, w, batch: GLMBatch):
+        """diag(H). Reference: TwiceDiffFunction.hessianDiagonal (used for
+        VarianceComputationType.SIMPLE coefficient variances)."""
+        _, _, d2 = loss_fns(self.task)
+        z = self._margin(w, batch)
+        local = sq_rmatvec(batch.X, batch.weights * d2(z, batch.y))
+        return self._psum(local) + self._reg_hess_diag(w)
+
+    def full_hessian(self, w, batch: GLMBatch):
+        """Dense (d, d) Hessian. Reference: TwiceDiffFunction.hessianMatrix
+        (VarianceComputationType.FULL); only for small feature spaces."""
+        _, _, d2 = loss_fns(self.task)
+        z = self._margin(w, batch)
+        H = self._psum(weighted_gram(batch.X, batch.weights * d2(z, batch.y)))
+        return H + jnp.diag(self._reg_hess_diag(w))

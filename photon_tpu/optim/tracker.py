@@ -1,0 +1,25 @@
+"""Solver result + per-iteration state tracking.
+
+Reference parity: com.linkedin.photon.ml.optimization.OptimizationStatesTracker
+(loss / gradient-norm per iteration). History arrays are fixed-length
+(max_iters + 1), NaN-padded, so the whole solve stays jittable.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+
+class OptResult(NamedTuple):
+    w: jax.Array
+    value: jax.Array
+    grad_norm: jax.Array
+    iterations: jax.Array
+    converged: jax.Array
+    loss_history: jax.Array  # (max_iters + 1,), NaN-padded
+
+    def history(self) -> np.ndarray:
+        h = np.asarray(self.loss_history)
+        return h[~np.isnan(h)]

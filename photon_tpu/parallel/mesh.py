@@ -1,0 +1,40 @@
+"""Device-mesh helpers.
+
+The reference distributes with Spark RDD partitions; photon-tpu uses a
+`jax.sharding.Mesh`. Conventions:
+
+- axis ``"data"``: examples are sharded across it; gradient aggregation is
+  a `psum` over this axis (the `treeAggregate` analog,
+  reference: DistributedGLMLossFunction.calculate gradient treeAggregate).
+- axis ``"entity"`` (optional, for very large random-effect spaces):
+  per-entity model blocks are sharded across it.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(data_axis: str = "data", n_devices: int | None = None,
+              devices=None) -> Mesh:
+    """A 1-D mesh over (up to) ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (data_axis,))
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (example) dimension across the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Examples are padded (with weight 0) so shards are equal-size/static."""
+    return ((n + m - 1) // m) * m

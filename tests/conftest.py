@@ -1,0 +1,40 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of unit-testing Spark code on a
+local[*] SparkContext (photon-ml SparkTestUtils): we force a fake
+8-device CPU platform so every sharding/`psum` path is exercised
+without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# f32 matmuls on CPU for numeric comparisons against scipy/sklearn.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+# The axon TPU plugin ignores JAX_PLATFORMS-based filtering; pin the default
+# device to CPU explicitly so tests run on the virtual 8-device mesh.
+_cpu_devices = jax.devices("cpu")
+jax.config.update("jax_default_device", _cpu_devices[0])
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from photon_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(data_axis="data", devices=_cpu_devices)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,58 @@
+"""Loss functions vs closed forms and autodiff.
+
+Mirrors the reference's pointwise loss unit tests
+(photon-ml: LogisticLossFunctionTest etc., which check loss/derivative
+values at hand-picked margins).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.losses import TaskType, loss_fns, mean_fn
+
+TASKS = list(TaskType)
+
+
+@pytest.mark.parametrize("task", TASKS)
+def test_d1_matches_autodiff(task):
+    loss, d1, _ = loss_fns(task)
+    z = jnp.linspace(-3.0, 3.0, 13)
+    y = jnp.array([0.0, 1.0] * 6 + [1.0])
+    if task is TaskType.POISSON_REGRESSION:
+        y = jnp.abs(y * 3.0)
+    auto = jax.vmap(jax.grad(lambda zz, yy: loss(zz, yy)))(z, y)
+    np.testing.assert_allclose(d1(z, y), auto, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("task", TASKS)
+def test_d2_matches_autodiff(task):
+    loss, _, d2 = loss_fns(task)
+    # stay off the hinge's kink points where the 2nd derivative jumps
+    z = jnp.linspace(-2.7, 2.7, 11)
+    y = jnp.array([0.0, 1.0] * 5 + [1.0])
+    auto = jax.vmap(jax.grad(jax.grad(lambda zz, yy: loss(zz, yy))))(z, y)
+    np.testing.assert_allclose(d2(z, y), auto, rtol=1e-4, atol=1e-5)
+
+
+def test_logistic_closed_form():
+    loss, _, _ = loss_fns(TaskType.LOGISTIC_REGRESSION)
+    # loss(z, y) = log(1 + e^z) - y z
+    np.testing.assert_allclose(loss(0.0, 0.0), np.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(loss(0.0, 1.0), np.log(2.0), rtol=1e-6)
+    # stable at extreme margins (no overflow)
+    assert np.isfinite(float(loss(80.0, 0.0)))
+    assert float(loss(80.0, 1.0)) < 1e-6
+
+
+def test_smoothed_hinge_regions():
+    loss, _, _ = loss_fns(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+    # y=1: margin m=z. m>=1 → 0; m<=0 → 0.5-m; else quadratic
+    np.testing.assert_allclose(loss(2.0, 1.0), 0.0, atol=1e-7)
+    np.testing.assert_allclose(loss(-1.0, 1.0), 1.5, rtol=1e-6)
+    np.testing.assert_allclose(loss(0.5, 1.0), 0.125, rtol=1e-6)
+
+
+def test_poisson_mean_is_exp():
+    assert np.isclose(float(mean_fn(TaskType.POISSON_REGRESSION)(1.0)), np.e)
+    assert np.isclose(float(mean_fn(TaskType.LOGISTIC_REGRESSION)(0.0)), 0.5)

@@ -1,0 +1,140 @@
+"""Optimizer convergence tests.
+
+Mirrors the reference's optimization suite (LBFGSTest, OWLQNTest, TRONTest:
+convergence on convex problems, agreement between optimizers, L1 sparsity).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.optim.owlqn import minimize_owlqn
+from photon_tpu.optim.tron import minimize_tron
+
+
+def _logistic_problem(rng, n=500, d=15, seed_scale=0.5):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    wt = (rng.normal(size=d) * seed_scale).astype(np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-X @ wt))).astype(np.float32)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+
+    def vg(w):
+        z = Xj @ w
+        return (
+            jnp.sum(jax.nn.softplus(z) - yj * z),
+            Xj.T @ (jax.nn.sigmoid(z) - yj),
+        )
+
+    def hvp(w, v):
+        s = jax.nn.sigmoid(Xj @ w)
+        return Xj.T @ (s * (1 - s) * (Xj @ v))
+
+    return X, y, vg, hvp
+
+
+def test_lbfgs_quadratic():
+    A = jnp.diag(jnp.array([1.0, 10.0, 100.0], jnp.float32))
+    b = jnp.array([1.0, 2.0, 3.0], jnp.float32)
+    vg = jax.value_and_grad(lambda w: 0.5 * w @ A @ w - b @ w)
+    res = minimize_lbfgs(vg, jnp.zeros(3), max_iters=60, tolerance=1e-9)
+    np.testing.assert_allclose(res.w, [1.0, 0.2, 0.03], atol=1e-3)
+    assert bool(res.converged)
+
+
+def test_lbfgs_rosenbrock():
+    def rosen(w):
+        return jnp.sum(100.0 * (w[1:] - w[:-1] ** 2) ** 2 + (1.0 - w[:-1]) ** 2)
+
+    res = minimize_lbfgs(jax.value_and_grad(rosen), jnp.zeros(6),
+                         max_iters=300, tolerance=1e-10)
+    np.testing.assert_allclose(res.w, np.ones(6), atol=1e-3)
+
+
+def test_lbfgs_matches_sklearn_l2_logistic(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    X, y, vg, _ = _logistic_problem(rng)
+    lam = 1.0
+
+    def vg_l2(w):
+        f, g = vg(w)
+        return f + 0.5 * lam * w @ w, g + lam * w
+
+    res = minimize_lbfgs(vg_l2, jnp.zeros(X.shape[1]), max_iters=300)
+    sk = LogisticRegression(C=1.0 / lam, fit_intercept=False, tol=1e-10,
+                            max_iter=5000).fit(X, y)
+    np.testing.assert_allclose(res.w, sk.coef_[0], atol=2e-3)
+
+
+def test_tron_matches_lbfgs(rng):
+    X, y, vg, hvp = _logistic_problem(rng)
+    lam = 0.5
+
+    def vg_l2(w):
+        f, g = vg(w)
+        return f + 0.5 * lam * w @ w, g + lam * w
+
+    def hvp_l2(w, v):
+        return hvp(w, v) + lam * v
+
+    rl = minimize_lbfgs(vg_l2, jnp.zeros(X.shape[1]), max_iters=300)
+    rt = minimize_tron(vg_l2, hvp_l2, jnp.zeros(X.shape[1]), max_iters=100)
+    assert bool(rt.converged)
+    np.testing.assert_allclose(rt.w, rl.w, atol=2e-3)
+
+
+def test_owlqn_matches_sklearn_l1(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    X, y, vg, _ = _logistic_problem(rng, n=400, d=20)
+    lam = 10.0
+    res = minimize_owlqn(vg, jnp.zeros(20), lam, max_iters=300)
+    sk = LogisticRegression(penalty="l1", C=1.0 / lam, solver="liblinear",
+                            fit_intercept=False, tol=1e-9, max_iter=3000).fit(X, y)
+    wsk = sk.coef_[0]
+
+    def F(w):
+        z = X @ w
+        return np.sum(np.logaddexp(0, z) - y * z) + lam * np.abs(w).sum()
+
+    # Our objective value should be at least as good (within f32 noise).
+    assert float(res.value) <= F(wsk) + 1e-2
+    # And produce a genuinely sparse solution.
+    assert int((np.asarray(res.w) != 0).sum()) < 20
+
+
+def test_owlqn_zero_l1_matches_lbfgs(rng):
+    X, y, vg, _ = _logistic_problem(rng, n=300, d=10)
+
+    def vg_l2(w):
+        f, g = vg(w)
+        return f + 0.5 * w @ w, g + w
+
+    r0 = minimize_owlqn(vg_l2, jnp.zeros(10), 0.0, max_iters=200)
+    r1 = minimize_lbfgs(vg_l2, jnp.zeros(10), max_iters=200)
+    np.testing.assert_allclose(r0.w, r1.w, atol=2e-3)
+
+
+def test_vmapped_lbfgs(rng):
+    """The random-effect pattern: many independent solves under one vmap."""
+    A = jnp.diag(jnp.array([1.0, 5.0, 25.0], jnp.float32))
+    bs = jnp.asarray(rng.normal(size=(16, 3)).astype(np.float32))
+
+    def solve(b):
+        vg = jax.value_and_grad(lambda w: 0.5 * w @ A @ w - b @ w)
+        return minimize_lbfgs(vg, jnp.zeros(3), max_iters=60, tolerance=1e-8).w
+
+    ws = jax.jit(jax.vmap(solve))(bs)
+    exact = np.asarray(bs) / np.array([1.0, 5.0, 25.0])
+    np.testing.assert_allclose(ws, exact, atol=2e-3)
+
+
+def test_loss_history_tracking():
+    A = jnp.diag(jnp.array([1.0, 10.0], jnp.float32))
+    b = jnp.array([1.0, 1.0], jnp.float32)
+    vg = jax.value_and_grad(lambda w: 0.5 * w @ A @ w - b @ w)
+    res = minimize_lbfgs(vg, jnp.zeros(2), max_iters=50)
+    h = res.history()
+    assert len(h) == int(res.iterations) + 1
+    assert h[-1] <= h[0]

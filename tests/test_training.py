@@ -1,0 +1,143 @@
+"""End-to-end GLM training: mesh == single device, parity with sklearn /
+closed forms, variances.
+
+Mirrors the reference's DistributedOptimizationProblemTest and the
+supervised-model integration tests.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.models.training import train_glm
+from photon_tpu.models.variance import VarianceComputationType
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+
+
+def _logistic_data(rng, n=2000, d=12):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    wt = (rng.normal(size=d) * 0.5).astype(np.float32)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-X @ wt))).astype(np.float32)
+    return X, y
+
+
+def test_mesh_matches_single_device(rng, mesh8):
+    X, y = _logistic_data(rng)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(max_iters=150, reg=reg.l2(), reg_weight=1.0)
+    m_mesh, r_mesh = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg, mesh=mesh8)
+    m_one, r_one = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)
+    np.testing.assert_allclose(m_mesh.weights, m_one.weights, atol=1e-5)
+    np.testing.assert_allclose(r_mesh.value, r_one.value, rtol=1e-5)
+
+
+def test_mesh_with_padding(rng, mesh8):
+    """n not divisible by 8: zero-weight padding must not change the result."""
+    X, y = _logistic_data(rng, n=1001)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(max_iters=150, reg=reg.l2(), reg_weight=1.0)
+    m_mesh, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg, mesh=mesh8)
+    m_one, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)
+    # f32 reduction order differs once padding reshapes the shards, so the
+    # iterates drift by ~1 ulp per step; equality holds to optimizer tolerance.
+    np.testing.assert_allclose(m_mesh.weights, m_one.weights, atol=5e-4)
+
+
+def test_linear_regression_closed_form(rng):
+    n, d = 500, 8
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(np.float32)
+    lam = 2.0
+    cfg = OptimizerConfig(max_iters=300, reg=reg.l2(), reg_weight=lam, tolerance=1e-9)
+    model, _ = train_glm(make_batch(X, y), TaskType.LINEAR_REGRESSION, cfg)
+    exact = np.linalg.solve(X.T @ X + lam * np.eye(d), X.T @ y)
+    np.testing.assert_allclose(model.weights, exact, atol=2e-3)
+
+
+def test_poisson_regression_recovers_truth(rng):
+    n, d = 4000, 5
+    X = (rng.normal(size=(n, d)) * 0.3).astype(np.float32)
+    wt = np.array([0.5, -0.3, 0.2, 0.0, 0.4], np.float32)
+    y = rng.poisson(np.exp(X @ wt)).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=200, reg=reg.l2(), reg_weight=1e-3)
+    model, res = train_glm(make_batch(X, y), TaskType.POISSON_REGRESSION, cfg)
+    assert bool(res.converged)
+    np.testing.assert_allclose(model.weights, wt, atol=0.1)
+
+
+def test_tron_optimizer_path(rng, mesh8):
+    X, y = _logistic_data(rng, n=800)
+    cfg_t = OptimizerConfig(optimizer=OptimizerType.TRON, max_iters=80,
+                            reg=reg.l2(), reg_weight=1.0)
+    cfg_l = OptimizerConfig(max_iters=200, reg=reg.l2(), reg_weight=1.0)
+    mt, _ = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg_t, mesh=mesh8)
+    ml, _ = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg_l)
+    np.testing.assert_allclose(mt.weights, ml.weights, atol=3e-3)
+
+
+def test_l1_auto_selects_owlqn(rng):
+    X, y = _logistic_data(rng, n=400, d=20)
+    cfg = OptimizerConfig(max_iters=200, reg=reg.l1(), reg_weight=8.0)
+    assert cfg.effective_optimizer() is OptimizerType.OWLQN
+    model, res = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    assert int((np.asarray(model.weights) != 0).sum()) < 20
+
+
+def test_elastic_net(rng):
+    X, y = _logistic_data(rng, n=400, d=15)
+    cfg = OptimizerConfig(max_iters=200, reg=reg.elastic_net(alpha=0.5),
+                          reg_weight=4.0)
+    model, res = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    assert bool(res.converged)
+    # elastic net at alpha=0.5 still induces some sparsity
+    assert int((np.asarray(model.weights) == 0).sum()) > 0
+
+
+def test_simple_variances_match_inverse_hessian_diag(rng):
+    """For linear regression with lam=0, SIMPLE variance = 1/diag(X^T X)."""
+    n, d = 300, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ np.ones(d)).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=100)
+    model, _ = train_glm(make_batch(X, y), TaskType.LINEAR_REGRESSION, cfg,
+                         variance=VarianceComputationType.SIMPLE)
+    expected = 1.0 / np.diag(X.T @ X)
+    np.testing.assert_allclose(model.coefficients.variances, expected, rtol=1e-3)
+
+
+def test_full_variances(rng):
+    n, d = 300, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ np.ones(d)).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=100)
+    model, _ = train_glm(make_batch(X, y), TaskType.LINEAR_REGRESSION, cfg,
+                         variance=VarianceComputationType.FULL)
+    expected = np.diag(np.linalg.inv(X.T @ X))
+    np.testing.assert_allclose(model.coefficients.variances, expected, rtol=2e-3)
+
+
+def test_weights_and_offsets(rng):
+    """Duplicating a row == weighting it 2x; offsets shift the margin."""
+    X, y = _logistic_data(rng, n=200, d=6)
+    cfg = OptimizerConfig(max_iters=200, reg=reg.l2(), reg_weight=0.5)
+
+    Xdup = np.concatenate([X, X[:50]])
+    ydup = np.concatenate([y, y[:50]])
+    w = np.ones(200, np.float32)
+    w[:50] = 2.0
+    m_dup, _ = train_glm(make_batch(Xdup, ydup), TaskType.LOGISTIC_REGRESSION, cfg)
+    m_wt, _ = train_glm(make_batch(X, y, weights=w), TaskType.LOGISTIC_REGRESSION, cfg)
+    np.testing.assert_allclose(m_dup.weights, m_wt.weights, atol=2e-3)
+
+
+def test_prior_incremental_training(rng):
+    """Strong prior pins coefficients at the prior mean; weak prior doesn't."""
+    X, y = _logistic_data(rng, n=300, d=5)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(max_iters=200)
+    mu = jnp.asarray(np.full(5, 0.37, np.float32))
+    strong, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                          prior_mean=mu, prior_precision=jnp.full((5,), 1e6))
+    np.testing.assert_allclose(strong.weights, mu, atol=1e-2)
