@@ -14,6 +14,7 @@ shapes, so two representations:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from functools import partial
 
 import jax
@@ -41,18 +42,41 @@ Matrix = jax.Array | SparseRows
 
 
 def from_scipy_csr(csr, k: int | None = None) -> SparseRows:
-    """Pad a scipy CSR matrix to fixed nnz-per-row."""
+    """Pad a scipy CSR matrix to fixed nnz-per-row (fully vectorized —
+    no per-row Python loop, so billion-row ingestion is numpy-bound).
+
+    If ``k`` is smaller than some row's nnz, the row keeps its k
+    largest-|value| entries and a UserWarning reports how many rows were
+    truncated (the reference never truncates; Breeze vectors are exact).
+    """
     n, d = csr.shape
-    row_nnz = np.diff(csr.indptr)
+    indptr = np.asarray(csr.indptr)
+    row_nnz = np.diff(indptr)
+    max_nnz = int(row_nnz.max()) if n else 0
     if k is None:
-        k = max(1, int(row_nnz.max()))
+        k = max(1, max_nnz)
+    col = np.asarray(csr.indices)
+    dat = np.asarray(csr.data, np.float32)
+    row = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
+    if max_nnz > k:
+        n_trunc = int((row_nnz > k).sum())
+        warnings.warn(
+            f"from_scipy_csr: {n_trunc} rows exceed k={k} nnz; keeping the "
+            f"k largest-|value| entries per row (max row nnz = {max_nnz})",
+            stacklevel=2,
+        )
+        # Reorder within each row by descending |value| so the first k kept
+        # below are the largest-magnitude entries.
+        order = np.lexsort((-np.abs(dat), row))
+        col, dat, row = col[order], dat[order], row[order]
+    pos = np.arange(row.shape[0], dtype=np.int64) - np.repeat(
+        indptr[:-1].astype(np.int64), row_nnz
+    )
+    keep = pos < k
     indices = np.zeros((n, k), np.int32)
     values = np.zeros((n, k), np.float32)
-    for i in range(n):
-        lo, hi = csr.indptr[i], csr.indptr[i + 1]
-        c = min(hi - lo, k)
-        indices[i, :c] = csr.indices[lo:lo + c]
-        values[i, :c] = csr.data[lo:lo + c]
+    indices[row[keep], pos[keep]] = col[keep]
+    values[row[keep], pos[keep]] = dat[keep]
     return SparseRows(jnp.asarray(indices), jnp.asarray(values), d)
 
 
@@ -83,12 +107,26 @@ def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     return (X * X).T @ r
 
 
+MAX_GRAM_FEATURES = 20_000
+
+
 def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     """X^T diag(r) X -> (d, d). Dense-only; used for full-Hessian variances
-    (reference: VarianceComputationType.FULL) on small feature spaces."""
+    (reference: VarianceComputationType.FULL) on small feature spaces.
+
+    Sparse inputs are densified, so d is capped at MAX_GRAM_FEATURES —
+    at the 10M-feature regime a (d, d) Gram is impossible anyway; use
+    hess_diag (VarianceComputationType.SIMPLE) there.
+    """
     if isinstance(X, SparseRows):
         n, k = X.indices.shape
         d = X.n_features
+        if d > MAX_GRAM_FEATURES:
+            raise ValueError(
+                f"weighted_gram densifies SparseRows: d={d} exceeds "
+                f"MAX_GRAM_FEATURES={MAX_GRAM_FEATURES}; use hess_diag/"
+                "SIMPLE variances for large feature spaces"
+            )
         rows = jnp.zeros((n, d), X.values.dtype)
         rows = rows.at[jnp.arange(n)[:, None], X.indices].add(X.values)
         return (rows * r[:, None]).T @ rows

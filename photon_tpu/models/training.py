@@ -43,12 +43,19 @@ def make_objective(
     axis_name: Optional[str] = None,
     prior_mean=None,
     prior_precision=None,
+    intercept_index: Optional[int] = -1,
 ) -> Objective:
+    """Build the smooth objective for one coordinate's solve.
+
+    intercept_index: which column to exclude from regularization when
+    ``config.regularize_intercept`` is False. Defaults to -1 because
+    photon_tpu's design-matrix builders (``data.feature_bags``) append the
+    intercept as the LAST column; callers building their own X with a
+    different layout must pass the actual index (or None for no intercept).
+    """
     reg_mask = None
-    if not config.regularize_intercept:
-        # Intercept is by convention the LAST column (data.feature_bags puts
-        # it there); mask it out of the regularizer.
-        reg_mask = jnp.ones((n_features,), jnp.float32).at[-1].set(0.0)
+    if not config.regularize_intercept and intercept_index is not None:
+        reg_mask = jnp.ones((n_features,), jnp.float32).at[intercept_index].set(0.0)
     return Objective(
         task=task,
         l2=config.reg.l2_weight(config.reg_weight),

@@ -32,7 +32,9 @@ class _State(NamedTuple):
     it: jax.Array
     done: jax.Array
     converged: jax.Array
+    failed: jax.Array
     hist: jax.Array
+    ghist: jax.Array
 
 
 def two_loop(g, S, Y, rho, idx, count):
@@ -98,6 +100,7 @@ def minimize_lbfgs(
     g0norm = jnp.linalg.norm(g0)
 
     hist0 = jnp.full((max_iters + 1,), jnp.nan, dtype).at[0].set(f0)
+    ghist0 = jnp.full((max_iters + 1,), jnp.nan, dtype).at[0].set(g0norm)
 
     def cond(s: _State):
         return (~s.done) & (s.it < max_iters)
@@ -132,15 +135,26 @@ def minimize_lbfgs(
 
         gnorm = jnp.linalg.norm(g_new)
         grad_conv = gnorm <= tolerance * jnp.maximum(1.0, g0norm)
-        f_conv = jnp.abs(s.f - f_new) <= tolerance * jnp.maximum(
-            jnp.maximum(jnp.abs(s.f), jnp.abs(f_new)), 1e-12
+        # f_conv is meaningful only for an accepted step; a rejected step
+        # leaves f unchanged and would trivially satisfy it.
+        f_conv = ok & (
+            jnp.abs(s.f - f_new)
+            <= tolerance * jnp.maximum(jnp.maximum(jnp.abs(s.f), jnp.abs(f_new)), 1e-12)
         )
-        converged = grad_conv | f_conv
+        # Precision-limited stop: the line search failed but the expected
+        # decrease |dphi0| is below the float noise floor of f — no
+        # representable progress remains; machine-precision convergence,
+        # not a failure.
+        noise = 4.0 * jnp.finfo(dtype).eps * jnp.maximum(jnp.abs(s.f), 1.0)
+        precision_limited = (~ok) & (jnp.abs(dphi0) <= noise)
+        converged = grad_conv | f_conv | precision_limited
         it = s.it + 1
         return _State(
             w=w_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, idx=idx,
             count=count, it=it, done=converged | ~ok,
-            converged=converged, hist=s.hist.at[it].set(f_new),
+            converged=converged, failed=s.failed | (~ok & ~converged),
+            hist=s.hist.at[it].set(f_new),
+            ghist=s.ghist.at[it].set(gnorm),
         )
 
     init = _State(
@@ -151,10 +165,13 @@ def minimize_lbfgs(
         it=jnp.zeros((), jnp.int32),
         done=g0norm <= 1e-14,
         converged=g0norm <= 1e-14,
+        failed=jnp.zeros((), bool),
         hist=hist0,
+        ghist=ghist0,
     )
     out = lax.while_loop(cond, body, init)
     return OptResult(
         w=out.w, value=out.f, grad_norm=jnp.linalg.norm(out.g),
-        iterations=out.it, converged=out.converged | out.done, loss_history=out.hist,
+        iterations=out.it, converged=out.converged, failed=out.failed,
+        loss_history=out.hist, grad_norm_history=out.ghist,
     )

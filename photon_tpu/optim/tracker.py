@@ -3,6 +3,11 @@
 Reference parity: com.linkedin.photon.ml.optimization.OptimizationStatesTracker
 (loss / gradient-norm per iteration). History arrays are fixed-length
 (max_iters + 1), NaN-padded, so the whole solve stays jittable.
+
+`converged` reports ONLY the gradient/function tolerance criteria;
+`failed` reports abnormal termination (line-search failure, trust region
+collapsed) — mirroring the reference, which distinguishes Breeze's
+line-search failure (FailedLineSearch) from convergence.
 """
 from __future__ import annotations
 
@@ -17,9 +22,15 @@ class OptResult(NamedTuple):
     value: jax.Array
     grad_norm: jax.Array
     iterations: jax.Array
-    converged: jax.Array
+    converged: jax.Array  # tolerance criteria met
+    failed: jax.Array  # abnormal stop (line search / trust region failure)
     loss_history: jax.Array  # (max_iters + 1,), NaN-padded
+    grad_norm_history: jax.Array  # (max_iters + 1,), NaN-padded
 
     def history(self) -> np.ndarray:
         h = np.asarray(self.loss_history)
+        return h[~np.isnan(h)]
+
+    def grad_history(self) -> np.ndarray:
+        h = np.asarray(self.grad_norm_history)
         return h[~np.isnan(h)]

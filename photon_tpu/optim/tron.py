@@ -86,7 +86,9 @@ class _State(NamedTuple):
     it: jax.Array
     done: jax.Array
     converged: jax.Array
+    failed: jax.Array
     hist: jax.Array
+    ghist: jax.Array
 
 
 def minimize_tron(
@@ -104,6 +106,7 @@ def minimize_tron(
     f0, g0 = value_and_grad(w0)
     g0norm = jnp.linalg.norm(g0)
     hist0 = jnp.full((max_iters + 1,), jnp.nan, dtype).at[0].set(f0)
+    ghist0 = jnp.full((max_iters + 1,), jnp.nan, dtype).at[0].set(g0norm)
 
     def cond(s: _State):
         return (~s.done) & (s.it < max_iters)
@@ -114,8 +117,15 @@ def minimize_tron(
         pred = -(jnp.dot(s.g, p) + 0.5 * jnp.dot(p, Hp))
         f_try, g_try = value_and_grad(s.w + p)
         actual = s.f - f_try
-        rho = actual / jnp.maximum(pred, 1e-20)
-        accept = (rho > ETA0) & jnp.isfinite(f_try) & (pred > 0.0)
+        # A non-finite trial (NaN/inf loss) must count as a hard rejection:
+        # rho = -inf forces the shrink branch below (a NaN rho would compare
+        # False to every threshold and silently GROW delta).
+        rho = jnp.where(
+            jnp.isfinite(f_try) & (pred > 0.0),
+            actual / jnp.maximum(pred, 1e-20),
+            -jnp.inf,
+        )
+        accept = rho > ETA0
 
         pnorm = jnp.linalg.norm(p)
         delta = jnp.where(
@@ -134,23 +144,32 @@ def minimize_tron(
             jnp.abs(actual)
             <= tolerance * jnp.maximum(jnp.maximum(jnp.abs(s.f), jnp.abs(f_new)), 1e-12)
         )
+        # Precision-limited stop: the model's predicted reduction is below the
+        # float noise floor of f, so no representable progress remains (the
+        # LIBLINEAR "prered <= 0" stop) — converged at machine precision, not
+        # a failure.
+        noise = 4.0 * jnp.finfo(dtype).eps * jnp.maximum(jnp.abs(s.f), 1.0)
+        precision_limited = (~accept) & (pred <= noise)
         stuck = (~accept) & (delta <= 1e-12)
-        converged = grad_conv | f_conv
+        converged = grad_conv | f_conv | precision_limited
         it = s.it + 1
         return _State(
             w=w_new, f=f_new, g=g_new, delta=delta, it=it,
             done=converged | stuck, converged=converged,
+            failed=s.failed | (stuck & ~converged),
             hist=s.hist.at[it].set(f_new),
+            ghist=s.ghist.at[it].set(gnorm),
         )
 
     init = _State(
         w=w0, f=f0, g=g0, delta=jnp.maximum(g0norm, 1.0).astype(dtype),
         it=jnp.zeros((), jnp.int32),
-        done=g0norm <= 1e-14, converged=g0norm <= 1e-14, hist=hist0,
+        done=g0norm <= 1e-14, converged=g0norm <= 1e-14,
+        failed=jnp.zeros((), bool), hist=hist0, ghist=ghist0,
     )
     out = lax.while_loop(cond, body, init)
     return OptResult(
         w=out.w, value=out.f, grad_norm=jnp.linalg.norm(out.g),
-        iterations=out.it, converged=out.converged | out.done,
-        loss_history=out.hist,
+        iterations=out.it, converged=out.converged, failed=out.failed,
+        loss_history=out.hist, grad_norm_history=out.ghist,
     )

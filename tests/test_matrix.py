@@ -1,0 +1,50 @@
+"""SparseRows construction / matvec tests (data.matrix)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_tpu.data.matrix import from_scipy_csr, matvec, rmatvec, weighted_gram
+
+
+def _random_csr(rng, n=50, d=30, density=0.2):
+    return sp.random(n, d, density=density, format="csr",
+                     random_state=np.random.RandomState(0), dtype=np.float32)
+
+
+def test_from_scipy_csr_matches_dense(rng):
+    csr = _random_csr(rng)
+    S = from_scipy_csr(csr)
+    w = rng.normal(size=csr.shape[1]).astype(np.float32)
+    np.testing.assert_allclose(matvec(S, w), csr @ w, rtol=1e-5, atol=1e-5)
+    r = rng.normal(size=csr.shape[0]).astype(np.float32)
+    np.testing.assert_allclose(rmatvec(S, r), csr.T @ r, rtol=1e-5, atol=1e-5)
+
+
+def test_from_scipy_csr_empty_rows(rng):
+    csr = sp.csr_matrix(
+        np.array([[0, 0, 3], [0, 0, 0], [1, 0, 0]], np.float32)
+    )
+    S = from_scipy_csr(csr)
+    w = np.array([1.0, 2.0, 4.0], np.float32)
+    np.testing.assert_allclose(matvec(S, w), [12.0, 0.0, 1.0])
+
+
+def test_from_scipy_csr_truncation_keeps_largest(rng):
+    dense = np.array([[5.0, -9.0, 1.0, 0.0],
+                      [0.0, 2.0, 0.0, 0.0]], np.float32)
+    csr = sp.csr_matrix(dense)
+    with pytest.warns(UserWarning, match="1 rows exceed k=2"):
+        S = from_scipy_csr(csr, k=2)
+    # Row 0 keeps its two largest-|value| entries (-9 at col 1, 5 at col 0).
+    got = np.zeros(4, np.float32)
+    idx = np.asarray(S.indices[0])
+    val = np.asarray(S.values[0])
+    got[idx[val != 0]] = val[val != 0]
+    np.testing.assert_allclose(got, [5.0, -9.0, 0.0, 0.0])
+
+
+def test_weighted_gram_guard():
+    S = from_scipy_csr(sp.identity(3, format="csr", dtype=np.float32))
+    big = S.__class__(S.indices, S.values, 10_000_000)
+    with pytest.raises(ValueError, match="MAX_GRAM_FEATURES"):
+        weighted_gram(big, np.ones(3, np.float32))
