@@ -1,0 +1,32 @@
+from photon_tpu.evaluation.evaluator import (
+    Evaluator,
+    EvaluatorType,
+    default_evaluator,
+    evaluator_suite,
+)
+from photon_tpu.evaluation.grouped import grouped_auc, grouped_precision_at_k
+from photon_tpu.evaluation.metrics import (
+    auc,
+    logistic_loss,
+    poisson_loss,
+    precision_at_k,
+    rmse,
+    smoothed_hinge_loss,
+    squared_loss,
+)
+
+__all__ = [
+    "Evaluator",
+    "EvaluatorType",
+    "default_evaluator",
+    "evaluator_suite",
+    "grouped_auc",
+    "grouped_precision_at_k",
+    "auc",
+    "rmse",
+    "squared_loss",
+    "logistic_loss",
+    "poisson_loss",
+    "smoothed_hinge_loss",
+    "precision_at_k",
+]

@@ -1,0 +1,125 @@
+"""Evaluator objects + factory.
+
+Reference parity: com.linkedin.photon.ml.evaluation.{EvaluatorType,
+EvaluatorFactory, Evaluator} — including `betterThan` comparison direction
+(AUC/P@K: higher is better; the loss metrics: lower is better) used by
+GameEstimator for validation model selection, and the per-task default
+evaluator used when none is configured (TaskType → evaluator mapping in the
+reference's Driver).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+from photon_tpu.evaluation import grouped, metrics
+from photon_tpu.ops.losses import TaskType
+
+
+class EvaluatorType(enum.Enum):
+    AUC = "AUC"
+    RMSE = "RMSE"
+    SQUARED_LOSS = "SQUARED_LOSS"
+    LOGISTIC_LOSS = "LOGISTIC_LOSS"
+    POISSON_LOSS = "POISSON_LOSS"
+    SMOOTHED_HINGE_LOSS = "SMOOTHED_HINGE_LOSS"
+    PRECISION_AT_K = "PRECISION_AT_K"
+    SHARDED_AUC = "SHARDED_AUC"
+    SHARDED_PRECISION_AT_K = "SHARDED_PRECISION_AT_K"
+
+
+_HIGHER_IS_BETTER = {
+    EvaluatorType.AUC,
+    EvaluatorType.PRECISION_AT_K,
+    EvaluatorType.SHARDED_AUC,
+    EvaluatorType.SHARDED_PRECISION_AT_K,
+}
+
+_SHARDED = {EvaluatorType.SHARDED_AUC, EvaluatorType.SHARDED_PRECISION_AT_K}
+
+_METRIC_FNS = {
+    EvaluatorType.AUC: metrics.auc,
+    EvaluatorType.RMSE: metrics.rmse,
+    EvaluatorType.SQUARED_LOSS: metrics.squared_loss,
+    EvaluatorType.LOGISTIC_LOSS: metrics.logistic_loss,
+    EvaluatorType.POISSON_LOSS: metrics.poisson_loss,
+    EvaluatorType.SMOOTHED_HINGE_LOSS: metrics.smoothed_hinge_loss,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    """One metric over (scores, labels, weights[, groups]).
+
+    `k` applies to the P@K evaluators; `num_groups` to the sharded ones
+    (groups are dense int ids, see evaluation.grouped).
+    """
+
+    kind: EvaluatorType
+    k: int = 10
+    num_groups: Optional[int] = None
+
+    @property
+    def higher_is_better(self) -> bool:
+        return self.kind in _HIGHER_IS_BETTER
+
+    @property
+    def needs_groups(self) -> bool:
+        return self.kind in _SHARDED
+
+    def better_than(self, a: float, b: Optional[float]) -> bool:
+        """Is score `a` better than incumbent `b`? (reference: Evaluator.betterThan)"""
+        if b is None or jnp.isnan(b):
+            return True
+        return a > b if self.higher_is_better else a < b
+
+    def evaluate(self, scores, labels, weights=None, groups=None) -> float:
+        if self.needs_groups:
+            if groups is None or self.num_groups is None:
+                raise ValueError(f"{self.kind} requires groups and num_groups")
+            if weights is None:
+                weights = jnp.ones_like(jnp.asarray(scores, jnp.float32))
+            if self.kind is EvaluatorType.SHARDED_AUC:
+                _, _, mean = grouped.grouped_auc(
+                    scores, labels, weights, groups, self.num_groups
+                )
+            else:
+                _, _, mean = grouped.grouped_precision_at_k(
+                    scores, labels, weights, groups, self.num_groups, self.k
+                )
+            return float(mean)
+        if self.kind is EvaluatorType.PRECISION_AT_K:
+            return float(metrics.precision_at_k(scores, labels, self.k, weights))
+        fn = _METRIC_FNS.get(self.kind)
+        if fn is None:
+            raise ValueError(f"unknown evaluator kind: {self.kind}")
+        return float(fn(scores, labels, weights))
+
+
+def default_evaluator(task: TaskType) -> Evaluator:
+    """Per-task default suite head (reference: Driver's TaskType → evaluator)."""
+    if task is TaskType.LOGISTIC_REGRESSION:
+        return Evaluator(EvaluatorType.AUC)
+    if task is TaskType.LINEAR_REGRESSION:
+        return Evaluator(EvaluatorType.RMSE)
+    if task is TaskType.POISSON_REGRESSION:
+        return Evaluator(EvaluatorType.POISSON_LOSS)
+    return Evaluator(EvaluatorType.AUC)
+
+
+def evaluator_suite(task: TaskType) -> list[Evaluator]:
+    """All applicable unsharded evaluators for a task."""
+    if task is TaskType.LOGISTIC_REGRESSION:
+        return [
+            Evaluator(EvaluatorType.AUC),
+            Evaluator(EvaluatorType.LOGISTIC_LOSS),
+            Evaluator(EvaluatorType.PRECISION_AT_K),
+        ]
+    if task is TaskType.LINEAR_REGRESSION:
+        return [Evaluator(EvaluatorType.RMSE), Evaluator(EvaluatorType.SQUARED_LOSS)]
+    if task is TaskType.POISSON_REGRESSION:
+        return [Evaluator(EvaluatorType.POISSON_LOSS)]
+    return [Evaluator(EvaluatorType.AUC), Evaluator(EvaluatorType.SMOOTHED_HINGE_LOSS)]

@@ -1,0 +1,105 @@
+"""Evaluation metrics, jit-safe and weight-aware.
+
+Reference parity: com.linkedin.photon.ml.evaluation.{AreaUnderROCCurveEvaluator,
+RMSEEvaluator, SquaredLossEvaluator, LogisticLossEvaluator, PoissonLossEvaluator,
+SmoothedHingeLossEvaluator, PrecisionAtKEvaluator}.
+
+The reference computes AUC with a Spark sort + sliding aggregation over score
+ties; here the whole metric is one XLA program: sort, tie-group segmentation
+via `segment_sum`/`segment_max`, single reduction. Rows with weight 0 are
+padding and contribute nothing, so metrics compose with the padded static
+shapes used everywhere else in photon-tpu.
+
+Conventions: `scores` are raw margins or mean predictions as each metric
+expects (AUC is rank-based so either works); binary labels are {0, 1}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops.losses import TaskType, loss_fns
+
+
+def _asarrays(scores, labels, weights):
+    scores = jnp.asarray(scores, jnp.float32)
+    labels = jnp.asarray(labels, jnp.float32)
+    if weights is None:
+        weights = jnp.ones_like(scores)
+    else:
+        weights = jnp.asarray(weights, jnp.float32)
+    return scores, labels, weights
+
+
+# ------------------------------------------------------------------------ AUC
+def auc(scores, labels, weights=None) -> jax.Array:
+    """Weighted, tie-aware area under the ROC curve.
+
+    AUC = P(score⁺ > score⁻) + ½ P(score⁺ = score⁻) under the weighted
+    empirical distribution — the same quantity the reference's
+    AreaUnderROCCurveEvaluator computes with its sorted sliding sum.
+    Returns NaN when either class has zero total weight (reference returns
+    an error there; NaN lets callers mask invalid groups).
+    """
+    scores, labels, weights = _asarrays(scores, labels, weights)
+    n = scores.shape[0]
+    order = jnp.argsort(scores)
+    s, y, w = scores[order], labels[order], weights[order]
+    wpos = w * y
+    wneg = w * (1.0 - y)
+    # Tie groups: runs of equal score.
+    new_tie = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    tid = jnp.cumsum(new_tie) - 1
+    cneg = jnp.cumsum(wneg)
+    neg_in_tie = jax.ops.segment_sum(wneg, tid, num_segments=n)
+    tie_cum_end = jax.ops.segment_max(cneg, tid, num_segments=n)
+    neg_below = tie_cum_end[tid] - neg_in_tie[tid]
+    contrib = wpos * (neg_below + 0.5 * neg_in_tie[tid])
+    wp = jnp.sum(wpos)
+    wn = jnp.sum(wneg)
+    return jnp.sum(contrib) / (wp * wn)
+
+
+# --------------------------------------------------------------- loss metrics
+def rmse(scores, labels, weights=None) -> jax.Array:
+    """Weighted root-mean-squared error (reference: RMSEEvaluator; scores are
+    mean predictions for linear regression, i.e. the raw margin)."""
+    scores, labels, weights = _asarrays(scores, labels, weights)
+    d = scores - labels
+    return jnp.sqrt(jnp.sum(weights * d * d) / jnp.sum(weights))
+
+
+def _mean_pointwise_loss(task: TaskType):
+    loss, _, _ = loss_fns(task)
+
+    def metric(scores, labels, weights=None) -> jax.Array:
+        scores, labels, weights = _asarrays(scores, labels, weights)
+        return jnp.sum(weights * loss(scores, labels)) / jnp.sum(weights)
+
+    return metric
+
+
+# Reference evaluators take the raw margin (offset + score) for these.
+logistic_loss = _mean_pointwise_loss(TaskType.LOGISTIC_REGRESSION)
+squared_loss = _mean_pointwise_loss(TaskType.LINEAR_REGRESSION)
+poisson_loss = _mean_pointwise_loss(TaskType.POISSON_REGRESSION)
+smoothed_hinge_loss = _mean_pointwise_loss(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+
+
+# -------------------------------------------------------------- precision@k
+def precision_at_k(scores, labels, k: int, weights=None) -> jax.Array:
+    """Fraction of positives among the k highest-scoring (non-padding) rows.
+
+    Reference: PrecisionAtKEvaluator. Label counting is unweighted (weights
+    only mark padding via weight 0), matching the reference, which computes
+    P@K from labels alone. If fewer than k real rows exist, divides by the
+    number of rows considered.
+    """
+    scores, labels, weights = _asarrays(scores, labels, weights)
+    real = weights > 0.0
+    key = jnp.where(real, scores, -jnp.inf)
+    order = jnp.argsort(-key)
+    topk = order[:k]
+    mask = real[topk].astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(labels[topk] * mask) / denom

@@ -1,0 +1,153 @@
+"""Evaluation metrics vs sklearn / per-group Python loops (SURVEY.md §4)."""
+import numpy as np
+import pytest
+from sklearn.metrics import mean_squared_error, roc_auc_score
+
+from photon_tpu.evaluation import (
+    Evaluator,
+    EvaluatorType,
+    auc,
+    default_evaluator,
+    grouped_auc,
+    grouped_precision_at_k,
+    logistic_loss,
+    precision_at_k,
+    rmse,
+)
+from photon_tpu.ops.losses import TaskType
+
+rng = np.random.default_rng(0)
+
+
+def test_auc_matches_sklearn():
+    n = 500
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    s = rng.normal(size=n).astype(np.float32) + y
+    np.testing.assert_allclose(float(auc(s, y)), roc_auc_score(y, s), atol=1e-6)
+
+
+def test_auc_weighted_with_ties():
+    n = 400
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    s = np.round(rng.normal(size=n) + 0.8 * y, 1).astype(np.float32)  # many ties
+    w = rng.integers(1, 5, size=n).astype(np.float32)
+    expected = roc_auc_score(y, s, sample_weight=w)
+    np.testing.assert_allclose(float(auc(s, y, w)), expected, atol=1e-6)
+
+
+def test_auc_ignores_padding():
+    y = np.array([1, 0, 1, 0, 1], np.float32)
+    s = np.array([0.9, 0.1, 0.8, 0.4, 0.2], np.float32)
+    w = np.array([1, 1, 1, 1, 0], np.float32)  # last row is padding
+    np.testing.assert_allclose(
+        float(auc(s, y, w)), roc_auc_score(y[:4], s[:4]), atol=1e-6
+    )
+
+
+def test_rmse_matches_sklearn():
+    n = 300
+    y = rng.normal(size=n).astype(np.float32)
+    s = y + 0.3 * rng.normal(size=n).astype(np.float32)
+    w = rng.random(n).astype(np.float32) + 0.5
+    expected = np.sqrt(mean_squared_error(y, s, sample_weight=w))
+    np.testing.assert_allclose(float(rmse(s, y, w)), expected, rtol=1e-5)
+
+
+def test_logistic_loss_closed_form():
+    s = np.array([0.0, 2.0, -1.0], np.float32)
+    y = np.array([1.0, 0.0, 1.0], np.float32)
+    expected = np.mean(np.log1p(np.exp(s)) - y * s)
+    np.testing.assert_allclose(float(logistic_loss(s, y)), expected, rtol=1e-5)
+
+
+def test_precision_at_k():
+    s = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+    y = np.array([1, 0, 1, 1, 0], np.float32)
+    np.testing.assert_allclose(float(precision_at_k(s, y, 3)), 2.0 / 3.0, atol=1e-6)
+    # padding rows excluded even when high-scoring
+    w = np.array([0, 1, 1, 1, 1], np.float32)
+    np.testing.assert_allclose(
+        float(precision_at_k(s, y, 3, w)), 2.0 / 3.0, atol=1e-6
+    )
+
+
+def _random_groups(n, num_groups):
+    g = rng.integers(0, num_groups, size=n).astype(np.int32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    s = np.round(rng.normal(size=n) + 0.7 * y, 1).astype(np.float32)
+    w = rng.integers(1, 4, size=n).astype(np.float32)
+    return s, y, w, g
+
+
+def test_grouped_auc_matches_python_loop():
+    num_groups = 12
+    s, y, w, g = _random_groups(600, num_groups)
+    per_group, valid, mean = grouped_auc(s, y, w, g, num_groups)
+    per_group, valid = np.asarray(per_group), np.asarray(valid)
+    expected = []
+    for gid in range(num_groups):
+        m = g == gid
+        if m.sum() == 0 or len(np.unique(y[m])) < 2:
+            assert not valid[gid]
+            continue
+        ref = roc_auc_score(y[m], s[m], sample_weight=w[m])
+        assert valid[gid]
+        np.testing.assert_allclose(per_group[gid], ref, atol=1e-5)
+        expected.append(ref)
+    np.testing.assert_allclose(float(mean), np.mean(expected), atol=1e-5)
+
+
+def test_grouped_precision_at_k_matches_python_loop():
+    num_groups, k = 10, 3
+    s, y, w, g = _random_groups(200, num_groups)
+    w[rng.random(len(w)) < 0.1] = 0.0  # some padding
+    per_group, valid, mean = grouped_precision_at_k(s, y, w, g, num_groups, k)
+    per_group, valid = np.asarray(per_group), np.asarray(valid)
+    expected = []
+    for gid in range(num_groups):
+        m = (g == gid) & (w > 0)
+        if m.sum() == 0:
+            assert not valid[gid]
+            continue
+        order = np.argsort(-s[m], kind="stable")[:k]
+        ref = y[m][order].mean()
+        np.testing.assert_allclose(per_group[gid], ref, atol=1e-6)
+        expected.append(ref)
+    np.testing.assert_allclose(float(mean), np.mean(expected), atol=1e-6)
+
+
+def test_evaluator_better_than_direction():
+    assert Evaluator(EvaluatorType.AUC).better_than(0.9, 0.8)
+    assert not Evaluator(EvaluatorType.AUC).better_than(0.7, 0.8)
+    assert Evaluator(EvaluatorType.RMSE).better_than(0.1, 0.2)
+    assert Evaluator(EvaluatorType.RMSE).better_than(0.1, None)
+
+
+def test_default_evaluator_per_task():
+    assert default_evaluator(TaskType.LOGISTIC_REGRESSION).kind is EvaluatorType.AUC
+    assert default_evaluator(TaskType.LINEAR_REGRESSION).kind is EvaluatorType.RMSE
+    assert (
+        default_evaluator(TaskType.POISSON_REGRESSION).kind
+        is EvaluatorType.POISSON_LOSS
+    )
+
+
+def test_sharded_evaluator_object():
+    num_groups = 8
+    s, y, w, g = _random_groups(300, num_groups)
+    ev = Evaluator(EvaluatorType.SHARDED_AUC, num_groups=num_groups)
+    _, _, mean = grouped_auc(s, y, w, g, num_groups)
+    np.testing.assert_allclose(ev.evaluate(s, y, w, g), float(mean), atol=1e-6)
+    with pytest.raises(ValueError):
+        ev.evaluate(s, y, w)  # missing groups
+
+
+def test_grouped_mean_nan_when_no_valid_group():
+    # every group single-class ⇒ metric undefined, not 0.0
+    s = np.array([0.5, 0.6, 0.2, 0.3], np.float32)
+    y = np.array([1, 1, 0, 0], np.float32)
+    w = np.ones(4, np.float32)
+    g = np.array([0, 0, 1, 1], np.int32)
+    _, valid, mean = grouped_auc(s, y, w, g, 2)
+    assert not np.asarray(valid).any()
+    assert np.isnan(float(mean))
