@@ -23,6 +23,16 @@ def _sort_by_group_then_key(groups, key):
     return order1[order2]
 
 
+def _mean_over_valid(per_group, valid):
+    """Unweighted mean over valid groups; NaN when none is valid."""
+    n_valid = jnp.sum(valid.astype(jnp.float32))
+    return jnp.where(
+        n_valid > 0.0,
+        jnp.sum(jnp.where(valid, per_group, 0.0)) / jnp.maximum(n_valid, 1.0),
+        jnp.nan,
+    )
+
+
 def grouped_auc(scores, labels, weights, groups, num_groups: int):
     """(per_group_auc, valid_mask, mean_over_valid).
 
@@ -61,13 +71,7 @@ def grouped_auc(scores, labels, weights, groups, num_groups: int):
     num_g = jax.ops.segment_sum(contrib, g, num_segments=num_groups)
     valid = (wp_g > 0.0) & (wn_g > 0.0)
     per_group = jnp.where(valid, num_g / jnp.where(valid, wp_g * wn_g, 1.0), jnp.nan)
-    n_valid = jnp.sum(valid.astype(jnp.float32))
-    mean = jnp.where(
-        n_valid > 0.0,
-        jnp.sum(jnp.where(valid, per_group, 0.0)) / jnp.maximum(n_valid, 1.0),
-        jnp.nan,  # no valid group ⇒ metric undefined, matching metrics.auc
-    )
-    return per_group, valid, mean
+    return per_group, valid, _mean_over_valid(per_group, valid)
 
 
 def grouped_precision_at_k(scores, labels, weights, groups, num_groups: int, k: int):
@@ -98,10 +102,4 @@ def grouped_precision_at_k(scores, labels, weights, groups, num_groups: int, k: 
     considered = jax.ops.segment_sum(maskf, g, num_segments=num_groups)
     valid = considered > 0.0
     per_group = jnp.where(valid, hits / jnp.where(valid, considered, 1.0), jnp.nan)
-    n_valid = jnp.sum(valid.astype(jnp.float32))
-    mean = jnp.where(
-        n_valid > 0.0,
-        jnp.sum(jnp.where(valid, per_group, 0.0)) / jnp.maximum(n_valid, 1.0),
-        jnp.nan,
-    )
-    return per_group, valid, mean
+    return per_group, valid, _mean_over_valid(per_group, valid)

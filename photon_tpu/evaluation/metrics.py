@@ -18,6 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from photon_tpu.evaluation.grouped import grouped_auc
 from photon_tpu.ops.losses import TaskType, loss_fns
 
 
@@ -40,24 +41,15 @@ def auc(scores, labels, weights=None) -> jax.Array:
     AreaUnderROCCurveEvaluator computes with its sorted sliding sum.
     Returns NaN when either class has zero total weight (reference returns
     an error there; NaN lets callers mask invalid groups).
+
+    Implemented as the one-group case of evaluation.grouped.grouped_auc so
+    the tie-handling math lives in exactly one place.
     """
     scores, labels, weights = _asarrays(scores, labels, weights)
-    n = scores.shape[0]
-    order = jnp.argsort(scores)
-    s, y, w = scores[order], labels[order], weights[order]
-    wpos = w * y
-    wneg = w * (1.0 - y)
-    # Tie groups: runs of equal score.
-    new_tie = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
-    tid = jnp.cumsum(new_tie) - 1
-    cneg = jnp.cumsum(wneg)
-    neg_in_tie = jax.ops.segment_sum(wneg, tid, num_segments=n)
-    tie_cum_end = jax.ops.segment_max(cneg, tid, num_segments=n)
-    neg_below = tie_cum_end[tid] - neg_in_tie[tid]
-    contrib = wpos * (neg_below + 0.5 * neg_in_tie[tid])
-    wp = jnp.sum(wpos)
-    wn = jnp.sum(wneg)
-    return jnp.sum(contrib) / (wp * wn)
+    per_group, _, _ = grouped_auc(
+        scores, labels, weights, jnp.zeros_like(scores, jnp.int32), 1
+    )
+    return per_group[0]
 
 
 # --------------------------------------------------------------- loss metrics

@@ -1,0 +1,48 @@
+from photon_tpu.game.coordinate_descent import (
+    CoordinateDescentResult,
+    coordinate_descent,
+)
+from photon_tpu.game.dataset import (
+    FixedEffectDataset,
+    GameData,
+    RandomEffectDataset,
+    REBlock,
+)
+from photon_tpu.game.estimator import (
+    FixedEffectConfig,
+    GameEstimator,
+    GameFitResult,
+    RandomEffectConfig,
+)
+from photon_tpu.game.fixed_effect import FixedEffectCoordinate
+from photon_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    score_rows,
+)
+from photon_tpu.game.random_effect import RandomEffectCoordinate, RETrainStats
+from photon_tpu.game.scoring import coordinate_scores, predict_mean, score_game
+
+__all__ = [
+    "GameData",
+    "FixedEffectDataset",
+    "RandomEffectDataset",
+    "REBlock",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "RETrainStats",
+    "coordinate_descent",
+    "CoordinateDescentResult",
+    "FixedEffectModel",
+    "RandomEffectModel",
+    "GameModel",
+    "score_rows",
+    "coordinate_scores",
+    "score_game",
+    "predict_mean",
+    "GameEstimator",
+    "GameFitResult",
+    "FixedEffectConfig",
+    "RandomEffectConfig",
+]

@@ -1,0 +1,255 @@
+"""GAME datasets: fixed-effect batches and entity-bucketed random-effect blocks.
+
+Reference parity: com.linkedin.photon.ml.data.{FixedEffectDataset,
+RandomEffectDataset, GameDatum}. The reference partitions random-effect data
+by entity id across Spark executors and trains one Breeze solver per entity.
+On TPU the same structure becomes dense batched tensors:
+
+- entities are bucketed by row count into power-of-two block shapes
+  (bucket m = smallest power of two ≥ the entity's active rows), so a handful
+  of distinct XLA programs covers every entity size;
+- within a bucket, entities are stacked into (E, m, …) arrays — the per-entity
+  solver is `vmap`'d over the leading axis, and that axis is shardable across
+  the mesh's ``data`` axis, which is how per-entity training scales across
+  chips (the Spark-partition analog);
+- rows are padded with weight 0, so every reduction ignores padding.
+
+The reference's active/passive split (`numActiveDataPointsUpperBound`,
+RandomEffectDataset.activeData/passiveData) maps to `active_cap`: each
+entity's first `active_cap` rows (after an optional shuffle) are trained on;
+all rows — active and passive — are scored via the flat per-row layout kept
+alongside the blocks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.data.matrix import Matrix, SparseRows
+
+
+@dataclasses.dataclass(frozen=True)
+class GameData:
+    """Host-side GAME training/scoring data: shared response + per-shard
+    design matrices + per-coordinate entity ids.
+
+    Reference: the GameDatum 4-tuple (response, offset, weight, feature
+    shards) plus per-entity-type id columns.
+    """
+
+    y: np.ndarray  # (n,)
+    weights: np.ndarray  # (n,)
+    offsets: np.ndarray  # (n,) base offsets
+    shards: dict  # feature-shard name -> Matrix (n rows)
+    entity_ids: dict  # entity-type name -> (n,) raw ids (any hashable dtype)
+
+    @property
+    def n(self) -> int:
+        return int(self.y.shape[0])
+
+    @staticmethod
+    def build(y, shards, entity_ids=None, weights=None, offsets=None) -> "GameData":
+        y = np.asarray(y, np.float32)
+        n = y.shape[0]
+        weights = (
+            np.ones(n, np.float32) if weights is None else np.asarray(weights, np.float32)
+        )
+        offsets = (
+            np.zeros(n, np.float32) if offsets is None else np.asarray(offsets, np.float32)
+        )
+        return GameData(y, weights, offsets, dict(shards), dict(entity_ids or {}))
+
+
+def _shard_dim(X: Matrix) -> int:
+    return X.n_features if isinstance(X, SparseRows) else X.shape[1]
+
+
+def _gather_rows(X: Matrix, idx: np.ndarray):
+    """Host-side row gather; returns numpy (dense) or numpy-backed SparseRows."""
+    if isinstance(X, SparseRows):
+        ind = np.asarray(X.indices)[idx]
+        val = np.asarray(X.values)[idx]
+        return ind, val
+    return np.asarray(X)[idx]
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataset:
+    """One feature shard over all rows (reference: FixedEffectDataset)."""
+
+    shard_name: str
+    X: Matrix
+    y: jnp.ndarray
+    weights: jnp.ndarray
+
+    @property
+    def n(self) -> int:
+        return int(self.y.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return _shard_dim(self.X)
+
+    @staticmethod
+    def build(data: GameData, shard_name: str) -> "FixedEffectDataset":
+        X = data.shards[shard_name]
+        if not isinstance(X, SparseRows):
+            X = jnp.asarray(X, jnp.float32)
+        return FixedEffectDataset(
+            shard_name, X, jnp.asarray(data.y), jnp.asarray(data.weights)
+        )
+
+    def batch(self, offsets) -> GLMBatch:
+        return GLMBatch(self.X, self.y, self.weights, jnp.asarray(offsets, jnp.float32))
+
+
+@dataclasses.dataclass(frozen=True)
+class REBlock:
+    """One bucket of entities with identical padded shape (E, m, ...)."""
+
+    m: int  # rows per entity (power of two)
+    entity_index: np.ndarray  # (E,) dense entity ids (host)
+    row_index: jnp.ndarray  # (E, m) int32 original row positions (clamped for padding)
+    y: jnp.ndarray  # (E, m)
+    weights: jnp.ndarray  # (E, m); 0 marks padding
+    X: object  # dense (E, m, d) jnp array, or (indices (E,m,k), values (E,m,k)) pair
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.entity_index.shape[0])
+
+
+def _next_pow2(x: int, floor: int = 4) -> int:
+    m = floor
+    while m < x:
+        m *= 2
+    return m
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """Entity-bucketed random-effect data (reference: RandomEffectDataset).
+
+    `blocks` hold the active training rows; `entity_dense` + the shard give
+    the flat per-row view used for scoring (covers passive rows too).
+    """
+
+    entity_name: str
+    shard_name: str
+    entity_keys: np.ndarray  # (E,) raw keys, dense id = position
+    key_to_index: dict  # raw key -> dense id
+    blocks: list  # list[REBlock]
+    X: Matrix  # flat per-row design matrix (all n rows)
+    entity_dense: np.ndarray  # (n,) dense entity id per row
+    n_active: int  # rows used for training
+    n_passive: int  # rows only scored
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.entity_keys.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return _shard_dim(self.X)
+
+    @staticmethod
+    def build(
+        data: GameData,
+        entity_name: str,
+        shard_name: str,
+        active_cap: Optional[int] = None,
+        min_block_rows: int = 4,
+        seed: int = 0,
+    ) -> "RandomEffectDataset":
+        X = data.shards[shard_name]
+        raw = np.asarray(data.entity_ids[entity_name])
+        keys, entity_dense = np.unique(raw, return_inverse=True)
+        entity_dense = entity_dense.astype(np.int32)
+        n = data.n
+        E = keys.shape[0]
+
+        # Group rows by entity: stable sort keeps original row order per entity.
+        order = np.argsort(entity_dense, kind="stable")
+        counts = np.bincount(entity_dense, minlength=E)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+        if active_cap is not None:
+            # Down-sample each oversized entity's active rows uniformly
+            # (reference: random-effect data config numActiveDataPointsUpperBound).
+            rng = np.random.default_rng(seed)
+            perm = np.concatenate(
+                [starts[e] + rng.permutation(counts[e]) for e in range(E)]
+            ) if (counts > active_cap).any() else np.arange(n)
+            order = order[perm]
+            active_counts = np.minimum(counts, active_cap)
+        else:
+            active_counts = counts
+
+        buckets: dict[int, list[int]] = {}
+        for e in range(E):
+            m = _next_pow2(max(int(active_counts[e]), 1), min_block_rows)
+            buckets.setdefault(m, []).append(e)
+
+        y, w = data.y, data.weights
+        blocks = []
+        for m in sorted(buckets):
+            ents = np.asarray(buckets[m], np.int64)
+            st, ct = starts[ents], active_counts[ents]
+            pos = np.arange(m)
+            mask = pos[None, :] < ct[:, None]  # (E_b, m)
+            # Clamp padding slots to the entity's first row; weight 0 silences them.
+            idx2d = st[:, None] + np.where(mask, pos[None, :], 0)
+            row_idx = order[idx2d]  # (E_b, m) original row positions
+            wb = np.where(mask, w[row_idx], 0.0).astype(np.float32)
+            yb = y[row_idx].astype(np.float32)
+            Xg = _gather_rows(X, row_idx.reshape(-1))
+            if isinstance(X, SparseRows):
+                ind, val = Xg
+                k = ind.shape[-1]
+                Xb = (
+                    jnp.asarray(ind.reshape(len(ents), m, k)),
+                    jnp.asarray(val.reshape(len(ents), m, k) * mask[..., None]),
+                )
+            else:
+                d = Xg.shape[-1]
+                Xb = jnp.asarray(Xg.reshape(len(ents), m, d), jnp.float32)
+            blocks.append(
+                REBlock(
+                    m=m,
+                    entity_index=ents.astype(np.int32),
+                    row_index=jnp.asarray(row_idx.astype(np.int32)),
+                    y=jnp.asarray(yb),
+                    weights=jnp.asarray(wb),
+                    X=Xb,
+                )
+            )
+
+        n_active = int(active_counts.sum())
+        if not isinstance(X, SparseRows):
+            X = jnp.asarray(X, jnp.float32)
+        return RandomEffectDataset(
+            entity_name=entity_name,
+            shard_name=shard_name,
+            entity_keys=keys,
+            key_to_index={k: i for i, k in enumerate(keys.tolist())},
+            blocks=blocks,
+            X=X,
+            entity_dense=entity_dense,
+            n_active=n_active,
+            n_passive=n - n_active,
+        )
+
+    def block_batch(self, block: REBlock, offsets_full) -> GLMBatch:
+        """Batched (E, m, ...) GLMBatch for one bucket, offsets gathered from
+        the full per-row offset vector (other coordinates' scores)."""
+        offs = jnp.asarray(offsets_full, jnp.float32)[block.row_index]
+        if isinstance(self.X, SparseRows):
+            ind, val = block.X
+            Xb = SparseRows(ind, val, self.X.n_features)
+        else:
+            Xb = block.X
+        return GLMBatch(Xb, block.y, block.weights, offs)

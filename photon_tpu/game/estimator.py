@@ -1,0 +1,211 @@
+"""GameEstimator: train GAME models over candidate configurations and select
+the best on validation data.
+
+Reference parity: com.linkedin.photon.ml.estimators.GameEstimator — fit()
+takes a sequence of per-coordinate optimization configurations, trains one
+GameModel per configuration (warm-starting each from the previous one when
+enabled), evaluates each on the validation set, and the driver selects the
+best by the task's primary evaluator.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_tpu.evaluation.evaluator import Evaluator, default_evaluator
+from photon_tpu.game.coordinate_descent import (
+    CoordinateDescentResult,
+    coordinate_descent,
+)
+from photon_tpu.game.dataset import FixedEffectDataset, GameData, RandomEffectDataset
+from photon_tpu.game.fixed_effect import FixedEffectCoordinate
+from photon_tpu.game.model import GameModel
+from photon_tpu.game.random_effect import RandomEffectCoordinate
+from photon_tpu.game.scoring import score_game
+from photon_tpu.models.variance import VarianceComputationType
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectConfig:
+    """Reference: FixedEffectCoordinateConfiguration (shard + optimizer)."""
+
+    feature_shard: str
+    optimizer: OptimizerConfig = OptimizerConfig()
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectConfig:
+    """Reference: RandomEffectCoordinateConfiguration (entity type, shard,
+    optimizer, active-data cap)."""
+
+    entity_name: str
+    feature_shard: str
+    optimizer: OptimizerConfig = OptimizerConfig()
+    active_cap: Optional[int] = None
+
+
+CoordinateConfig = FixedEffectConfig | RandomEffectConfig
+
+
+@dataclasses.dataclass
+class GameFitResult:
+    """One (configuration → model) outcome (reference: fit()'s result tuples)."""
+
+    model: GameModel
+    descent: CoordinateDescentResult
+    configs: dict  # name -> CoordinateConfig actually used
+    validation_score: Optional[float] = None
+
+
+@dataclasses.dataclass
+class GameEstimator:
+    """Reference: estimators.GameEstimator."""
+
+    task: TaskType
+    coordinate_configs: dict  # name -> CoordinateConfig (insertion order = default update sequence)
+    update_sequence: Optional[list] = None
+    n_sweeps: int = 2
+    mesh: Optional[Mesh] = None
+    variance: VarianceComputationType = VarianceComputationType.NONE
+    locked: frozenset = frozenset()
+    warm_start: bool = True
+    evaluator: Optional[Evaluator] = None
+    # entity-id column for sharded (per-entity) validation evaluators;
+    # defaults to the first random-effect coordinate's entity type.
+    evaluator_entity: Optional[str] = None
+
+    @staticmethod
+    def _dataset_key(cfg: CoordinateConfig) -> tuple:
+        """Fields that change the dataset (not just the solve)."""
+        if isinstance(cfg, FixedEffectConfig):
+            return ("fixed", cfg.feature_shard)
+        return ("random", cfg.entity_name, cfg.feature_shard, cfg.active_cap)
+
+    @staticmethod
+    def _build_dataset(data: GameData, cfg: CoordinateConfig):
+        if isinstance(cfg, FixedEffectConfig):
+            return FixedEffectDataset.build(data, cfg.feature_shard)
+        return RandomEffectDataset.build(
+            data, cfg.entity_name, cfg.feature_shard, active_cap=cfg.active_cap
+        )
+
+    def _build_coordinates(self, datasets: dict, configs: dict) -> dict:
+        coords = {}
+        for name, cfg in configs.items():
+            if isinstance(cfg, FixedEffectConfig):
+                coords[name] = FixedEffectCoordinate(
+                    datasets[name], self.task, cfg.optimizer,
+                    mesh=self.mesh, variance=self.variance,
+                )
+            else:
+                coords[name] = RandomEffectCoordinate(
+                    datasets[name], self.task, cfg.optimizer,
+                    mesh=self.mesh, variance=self.variance,
+                )
+        return coords
+
+    def fit(
+        self,
+        data: GameData,
+        validation: Optional[GameData] = None,
+        config_grid: Optional[list] = None,
+        initial_models: Optional[dict] = None,
+    ) -> list:
+        """Train one GameModel per candidate configuration.
+
+        `config_grid`: list of {name -> CoordinateConfig} overrides — one
+        GameModel is trained per entry (reference: one
+        GameOptimizationConfiguration per model). None trains a single model
+        with `coordinate_configs`. Successive models warm-start from the
+        previous one when `warm_start` (reference: GameEstimator warm start
+        across regularization weights). Datasets are cached per
+        (shard, entity, active_cap) so overrides that change only the
+        optimizer reuse the bucketed blocks.
+        """
+        grid = config_grid or [self.coordinate_configs]
+        evaluator = self.evaluator or default_evaluator(self.task)
+        dataset_cache: dict = {}
+
+        results: list[GameFitResult] = []
+        prev_models = dict(initial_models or {})
+        for overrides in grid:
+            configs = {**self.coordinate_configs, **overrides}
+            datasets = {}
+            for name, cfg in configs.items():
+                key = self._dataset_key(cfg)
+                if key not in dataset_cache:
+                    dataset_cache[key] = self._build_dataset(data, cfg)
+                datasets[name] = dataset_cache[key]
+            coords = self._build_coordinates(datasets, configs)
+            descent = coordinate_descent(
+                coords,
+                data.y,
+                data.weights,
+                data.offsets,
+                self.task,
+                update_sequence=self.update_sequence,
+                n_sweeps=self.n_sweeps,
+                locked=self.locked,
+                initial_models=prev_models,
+            )
+            result = GameFitResult(descent.model, descent, configs)
+            if validation is not None:
+                scores = score_game(descent.model, validation)
+                result.validation_score = self._evaluate(
+                    evaluator, scores, validation
+                )
+            results.append(result)
+            if self.warm_start:
+                prev_models = dict(descent.model.coordinates)
+        return results
+
+    def _evaluate(self, evaluator: Evaluator, scores, validation: GameData) -> float:
+        """Run the validation evaluator; sharded evaluators group by the
+        estimator's `evaluator_entity` (default: the first random-effect
+        coordinate's entity type), as the reference's per-entity validation
+        evaluators do."""
+        if not evaluator.needs_groups:
+            return evaluator.evaluate(scores, validation.y, validation.weights)
+        entity = self.evaluator_entity
+        if entity is None:
+            for cfg in self.coordinate_configs.values():
+                if isinstance(cfg, RandomEffectConfig):
+                    entity = cfg.entity_name
+                    break
+        if entity is None or entity not in validation.entity_ids:
+            raise ValueError(
+                f"sharded evaluator {evaluator.kind} needs an entity id column; "
+                f"set evaluator_entity to one of {list(validation.entity_ids)}"
+            )
+        _, groups = np.unique(
+            np.asarray(validation.entity_ids[entity]), return_inverse=True
+        )
+        ev = dataclasses.replace(evaluator, num_groups=int(groups.max()) + 1)
+        return ev.evaluate(scores, validation.y, validation.weights, groups)
+
+    def best_model(self, results: list) -> GameFitResult:
+        """Pick by validation metric with the evaluator's direction
+        (reference: GameTrainingDriver.selectBestModel); falls back to the
+        final training objective when no validation data was given."""
+        evaluator = self.evaluator or default_evaluator(self.task)
+        best = None
+        for r in results:
+            if r.validation_score is not None:
+                if best is None or evaluator.better_than(
+                    r.validation_score, best.validation_score
+                ):
+                    best = r
+            else:
+                if best is None or (
+                    r.descent.objective_history[-1]
+                    < best.descent.objective_history[-1]
+                ):
+                    best = r
+        if best is None:
+            raise ValueError("no fit results to select from")
+        return best

@@ -1,0 +1,54 @@
+"""Fixed-effect coordinate: one distributed GLM solve over all rows.
+
+Reference parity: com.linkedin.photon.ml.algorithm.FixedEffectCoordinate —
+trainModel broadcasts coefficients and treeAggregates gradients; here the
+whole solve is `train_glm`'s single SPMD program over the mesh's data axis
+(one psum per iteration over the ICI).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from photon_tpu.game.dataset import FixedEffectDataset
+from photon_tpu.game.model import FixedEffectModel
+from photon_tpu.models.training import train_glm
+from photon_tpu.models.variance import VarianceComputationType
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.tracker import OptResult
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinate:
+    """Reference: algorithm.FixedEffectCoordinate."""
+
+    dataset: FixedEffectDataset
+    task: TaskType
+    config: OptimizerConfig
+    mesh: Optional[Mesh] = None
+    variance: VarianceComputationType = VarianceComputationType.NONE
+
+    def train(
+        self, offsets_full, warm_start: Optional[FixedEffectModel] = None
+    ) -> tuple[FixedEffectModel, OptResult]:
+        """Solve with the other coordinates' scores as offsets
+        (reference: FixedEffectCoordinate.trainModel on updated offsets)."""
+        w0 = None if warm_start is None else warm_start.model.weights
+        model, res = train_glm(
+            self.dataset.batch(offsets_full),
+            self.task,
+            self.config,
+            mesh=self.mesh,
+            w0=w0,
+            variance=self.variance,
+        )
+        return FixedEffectModel(model, self.dataset.shard_name), res
+
+    def score(self, model: FixedEffectModel) -> jax.Array:
+        """Margin contribution of this coordinate alone (no offsets) —
+        reference: FixedEffectCoordinate.score / updateOffsets."""
+        return model.score(self.dataset.X)

@@ -1,0 +1,112 @@
+"""GAME model containers.
+
+Reference parity: com.linkedin.photon.ml.model.{GameModel, FixedEffectModel,
+RandomEffectModel, Coefficients}. The reference stores a RandomEffectModel as
+an RDD of (entityId -> GeneralizedLinearModel); here it is one dense
+(num_entities, d) coefficient matrix + a key→row index — scoring a batch of
+rows is a single gather + rowwise dot instead of a per-entity join.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.matrix import Matrix, SparseRows, matvec
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.ops.losses import TaskType, mean_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Reference: model.FixedEffectModel (one GLM + its feature shard)."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str
+
+    @property
+    def task(self) -> TaskType:
+        return self.model.task
+
+    def score(self, X: Matrix) -> jax.Array:
+        return self.model.score(X)
+
+
+def score_rows(X: Matrix, coeff_rows: jax.Array) -> jax.Array:
+    """Rowwise margin x_i · c_i with a per-row coefficient vector (n, d)."""
+    if isinstance(X, SparseRows):
+        gathered = jnp.take_along_axis(coeff_rows, X.indices, axis=1)
+        return jnp.einsum("nk,nk->n", X.values, gathered)
+    return jnp.einsum("nd,nd->n", X, coeff_rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient matrix (reference: model.RandomEffectModel).
+
+    Row i of `coefficients` belongs to `entity_keys[i]`; entities unseen at
+    training time score 0 (the reference's behavior for missing REModels).
+    """
+
+    entity_name: str
+    feature_shard: str
+    task: TaskType
+    coefficients: jax.Array  # (E, d)
+    entity_keys: np.ndarray  # (E,) raw keys
+    key_to_index: dict
+    variances: Optional[jax.Array] = None  # (E, d) or None
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.coefficients.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.coefficients.shape[1])
+
+    def dense_ids(self, raw_ids: np.ndarray) -> np.ndarray:
+        """Raw entity keys → dense row ids; unseen keys map to E (zero row)."""
+        E = self.n_entities
+        return np.asarray(
+            [self.key_to_index.get(k, E) for k in np.asarray(raw_ids).tolist()],
+            np.int32,
+        )
+
+    def coeffs_for(self, dense_ids) -> jax.Array:
+        """(n, d) per-row coefficients; id == E selects the zero row."""
+        padded = jnp.concatenate(
+            [self.coefficients, jnp.zeros((1, self.dim), self.coefficients.dtype)]
+        )
+        return padded[jnp.asarray(dense_ids)]
+
+    def score(self, X: Matrix, dense_ids) -> jax.Array:
+        return score_rows(X, self.coeffs_for(dense_ids))
+
+    def model_for(self, key) -> GeneralizedLinearModel:
+        """Single entity's GLM view (reference: RandomEffectModel.getModel)."""
+        i = self.key_to_index[key]
+        var = None if self.variances is None else self.variances[i]
+        return GeneralizedLinearModel(Coefficients(self.coefficients[i], var), self.task)
+
+
+CoordinateModel = Union[FixedEffectModel, RandomEffectModel]
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered coordinate-name → model map (reference: model.GameModel)."""
+
+    coordinates: dict  # name -> CoordinateModel (insertion-ordered)
+    task: TaskType
+
+    def __getitem__(self, name: str) -> CoordinateModel:
+        return self.coordinates[name]
+
+    def names(self):
+        return list(self.coordinates)
+
+    def mean(self, total_score: jax.Array) -> jax.Array:
+        return mean_fn(self.task)(total_score)

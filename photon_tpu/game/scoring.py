@@ -1,0 +1,43 @@
+"""Batch scoring of GAME models.
+
+Reference parity: com.linkedin.photon.ml.transformers.GameTransformer and
+data.scoring.{CoordinateDataScores, ModelDataScores} — transform new data by
+summing every coordinate's contribution plus the base offset. Each
+coordinate's pass is one gather + matmul/rowwise-dot XLA program; there is no
+per-entity join.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.game.dataset import GameData
+from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+
+
+def coordinate_scores(model: GameModel, data: GameData) -> dict:
+    """Per-coordinate margin contributions on `data`."""
+    out = {}
+    for name, cm in model.coordinates.items():
+        if isinstance(cm, FixedEffectModel):
+            out[name] = cm.score(data.shards[cm.feature_shard])
+        elif isinstance(cm, RandomEffectModel):
+            ids = cm.dense_ids(data.entity_ids[cm.entity_name])
+            out[name] = cm.score(data.shards[cm.feature_shard], ids)
+        else:
+            raise TypeError(f"unknown coordinate model type: {type(cm)}")
+    return out
+
+
+def score_game(model: GameModel, data: GameData) -> jax.Array:
+    """Total raw score: base offsets + Σ coordinate margins
+    (reference: GameScoringDriver's scoreGameModel)."""
+    total = jnp.asarray(data.offsets, jnp.float32)
+    for s in coordinate_scores(model, data).values():
+        total = total + s
+    return total
+
+
+def predict_mean(model: GameModel, data: GameData) -> jax.Array:
+    """Mean response via the task's inverse link (reference: computeMean)."""
+    return model.mean(score_game(model, data))
