@@ -1,0 +1,69 @@
+"""Headline benchmark: logistic-GLM training throughput on one chip.
+
+Metric (SURVEY.md §6): rows·iters/sec/chip for the distributed L-BFGS
+logistic solve (the hot path under every GAME fixed-effect update;
+reference: DistributedGLMLossFunction + Breeze LBFGS on a 64-executor
+Spark cluster). The baseline is the documented Spark-derived estimate of
+1.0e6 rows·iters/sec *cluster-wide* (64 executors x 4 cores); vs_baseline
+is ours (one chip) divided by that whole-cluster number.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+
+BASELINE_CLUSTER_ROWS_ITERS_PER_SEC = 1.0e6
+
+N_ROWS = 1 << 19  # 524288
+N_FEATURES = 256
+MAX_ITERS = 40
+
+
+def make_problem(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    w_true = rng.normal(size=N_FEATURES).astype(np.float32) / np.sqrt(N_FEATURES)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=N_ROWS) < p).astype(np.float32)
+    return make_batch(X, y)
+
+
+def run_once(batch, config):
+    model, res = train_glm(batch, TaskType.LOGISTIC_REGRESSION, config)
+    jax.block_until_ready(model.weights)
+    return res
+
+
+def main() -> None:
+    config = OptimizerConfig(max_iters=MAX_ITERS, tolerance=0.0,
+                             reg=l2(), reg_weight=1.0)
+    batch = make_problem()
+    run_once(batch, config)  # warm-up: compile + autotune
+    t0 = time.perf_counter()
+    res = run_once(batch, config)
+    dt = time.perf_counter() - t0
+    iters = int(res.iterations)
+    value = N_ROWS * iters / dt
+    print(json.dumps({
+        "metric": "logistic_glm_rows_iters_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "rows*iters/sec/chip",
+        "vs_baseline": round(value / BASELINE_CLUSTER_ROWS_ITERS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,9 +70,11 @@ def coordinate_descent(
 
     # Scores of any pre-existing models participate as offsets from the start
     # (reference: CoordinateDescent seeds offsets from the initial GameModel).
+    # This covers ALL coordinates with models — including ones left out of a
+    # caller-supplied update_sequence (e.g. locked, score-only coordinates).
     scores = {
         name: coordinates[name].score(models[name])
-        for name in update_sequence
+        for name in coordinates
         if name in models
     }
     zero = jnp.zeros((n,), jnp.float32)
@@ -96,6 +98,9 @@ def coordinate_descent(
             objective_history.append(_total_objective(task, y, weights, total))
 
     ordered = {name: models[name] for name in update_sequence}
+    for name in coordinates:  # score-only coordinates outside the sequence
+        if name in models and name not in ordered:
+            ordered[name] = models[name]
     return CoordinateDescentResult(
         GameModel(ordered, task), objective_history, coordinate_stats
     )

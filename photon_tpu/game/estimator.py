@@ -94,19 +94,30 @@ class GameEstimator:
             data, cfg.entity_name, cfg.feature_shard, active_cap=cfg.active_cap
         )
 
-    def _build_coordinates(self, datasets: dict, configs: dict) -> dict:
+    def _build_coordinates(self, datasets: dict, configs: dict,
+                           cache: Optional[dict] = None) -> dict:
+        """Coordinates are cached by (dataset key, optimizer config) so a
+        config_grid sweep that only changes OTHER coordinates reuses this
+        one's jit-compiled (vmapped) solver instead of recompiling it."""
         coords = {}
         for name, cfg in configs.items():
+            key = (self._dataset_key(cfg), cfg.optimizer)
+            if cache is not None and key in cache:
+                coords[name] = cache[key]
+                continue
             if isinstance(cfg, FixedEffectConfig):
-                coords[name] = FixedEffectCoordinate(
+                coord = FixedEffectCoordinate(
                     datasets[name], self.task, cfg.optimizer,
                     mesh=self.mesh, variance=self.variance,
                 )
             else:
-                coords[name] = RandomEffectCoordinate(
+                coord = RandomEffectCoordinate(
                     datasets[name], self.task, cfg.optimizer,
                     mesh=self.mesh, variance=self.variance,
                 )
+            if cache is not None:
+                cache[key] = coord
+            coords[name] = coord
         return coords
 
     def fit(
@@ -130,6 +141,7 @@ class GameEstimator:
         grid = config_grid or [self.coordinate_configs]
         evaluator = self.evaluator or default_evaluator(self.task)
         dataset_cache: dict = {}
+        coord_cache: dict = {}
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})
@@ -141,7 +153,7 @@ class GameEstimator:
                 if key not in dataset_cache:
                     dataset_cache[key] = self._build_dataset(data, cfg)
                 datasets[name] = dataset_cache[key]
-            coords = self._build_coordinates(datasets, configs)
+            coords = self._build_coordinates(datasets, configs, coord_cache)
             descent = coordinate_descent(
                 coords,
                 data.y,
@@ -201,10 +213,14 @@ class GameEstimator:
                 ):
                     best = r
             else:
-                if best is None or (
-                    r.descent.objective_history[-1]
-                    < best.descent.objective_history[-1]
-                ):
+                obj = (r.descent.objective_history[-1]
+                       if r.descent.objective_history else float("inf"))
+                best_obj = (
+                    best.descent.objective_history[-1]
+                    if best is not None and best.descent.objective_history
+                    else float("inf")
+                )
+                if best is None or obj < best_obj:
                     best = r
         if best is None:
             raise ValueError("no fit results to select from")

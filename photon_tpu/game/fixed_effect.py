@@ -37,7 +37,10 @@ class FixedEffectCoordinate:
     ) -> tuple[FixedEffectModel, OptResult]:
         """Solve with the other coordinates' scores as offsets
         (reference: FixedEffectCoordinate.trainModel on updated offsets)."""
-        w0 = None if warm_start is None else warm_start.model.weights
+        w0 = None
+        if (warm_start is not None
+                and warm_start.model.weights.shape[0] == self.dataset.dim):
+            w0 = warm_start.model.weights
         model, res = train_glm(
             self.dataset.batch(offsets_full),
             self.task,

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.matrix import Matrix, SparseRows, matvec
+from photon_tpu.data.matrix import Matrix, SparseRows
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.ops.losses import TaskType, mean_fn
 
@@ -68,12 +68,22 @@ class RandomEffectModel:
         return int(self.coefficients.shape[1])
 
     def dense_ids(self, raw_ids: np.ndarray) -> np.ndarray:
-        """Raw entity keys → dense row ids; unseen keys map to E (zero row)."""
-        E = self.n_entities
-        return np.asarray(
-            [self.key_to_index.get(k, E) for k in np.asarray(raw_ids).tolist()],
-            np.int32,
-        )
+        """Raw entity keys → dense row ids; unseen keys map to E (zero row).
+
+        Vectorized via searchsorted — entity_keys comes from np.unique and is
+        sorted, so the lookup is O(n log E) numpy, not an O(n) Python loop.
+        """
+        raw = np.asarray(raw_ids)
+        keys = np.asarray(self.entity_keys)
+        if raw.dtype != keys.dtype and not (
+            np.issubdtype(raw.dtype, np.number)
+            and np.issubdtype(keys.dtype, np.number)
+        ):
+            raw = raw.astype(keys.dtype)
+        pos = np.searchsorted(keys, raw)
+        pos_c = np.clip(pos, 0, len(keys) - 1)
+        found = keys[pos_c] == raw
+        return np.where(found, pos_c, self.n_entities).astype(np.int32)
 
     def coeffs_for(self, dense_ids) -> jax.Array:
         """(n, d) per-row coefficients; id == E selects the zero row."""

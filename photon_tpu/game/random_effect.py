@@ -27,7 +27,7 @@ from photon_tpu.models.training import make_objective, solve
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.optim.config import OptimizerConfig
-from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple, replicated
+from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple
 
 
 def _pad_axis0(tree, target: int):
