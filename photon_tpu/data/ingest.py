@@ -1,0 +1,143 @@
+"""Avro training records → GameData (device-ready arrays).
+
+Reference parity: com.linkedin.photon.ml.data.avro.AvroDataReader — reads
+TrainingExampleAvro-shaped records (response/offset/weight + feature-bag
+arrays of NameTermValue + entity-id columns) and materializes one design
+matrix per configured feature shard. The reference produces per-partition
+RDDs; here the output is host numpy/jnp arrays ready for `jax.device_put`
+onto the mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data.avro_io import read_avro
+from photon_tpu.data.feature_bags import (
+    FeatureShardConfig,
+    NameTermValue,
+    build_design_matrix,
+    build_index_map,
+)
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.game.dataset import GameData
+
+# The TrainingExampleAvro shape (reference:
+# photon-ml avro schemas TrainingExampleAvro.avsc), trimmed to the fields the
+# trainer consumes. Used by tests/drivers to write fixtures.
+NAME_TERM_VALUE_SCHEMA = {
+    "type": "record",
+    "name": "NameTermValueAvro",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+
+def training_example_schema(
+    feature_bags: Sequence[str] = ("features",),
+    entity_fields: Sequence[str] = (),
+) -> dict:
+    """Schema for GAME training records with the given bag/id columns."""
+    fields = [
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "uid", "type": ["null", "string"], "default": None},
+    ]
+    for e in entity_fields:
+        fields.append({"name": e, "type": ["null", "string"], "default": None})
+    for i, bag in enumerate(feature_bags):
+        fields.append({
+            "name": bag,
+            "type": {"type": "array",
+                     "items": NAME_TERM_VALUE_SCHEMA if i == 0
+                     else "NameTermValueAvro"},
+        })
+    return {"type": "record", "name": "TrainingExampleAvro", "fields": fields}
+
+
+@dataclasses.dataclass(frozen=True)
+class GameDataConfig:
+    """What to extract from records (reference: GameTrainingDriver's
+    input-data-format + feature-shard configurations)."""
+
+    shards: dict  # shard name -> FeatureShardConfig
+    entity_fields: Sequence[str] = ()
+    response_field: str = "response"
+    offset_field: str = "offset"
+    weight_field: str = "weight"
+
+
+def _to_ntv(bag_entries) -> list:
+    out = []
+    for e in bag_entries or ():
+        if isinstance(e, NameTermValue):
+            out.append(e)
+        else:
+            out.append(NameTermValue(e["name"], e.get("term", ""),
+                                     float(e["value"])))
+    return out
+
+
+def records_to_game_data(
+    records: Sequence[dict],
+    config: GameDataConfig,
+    index_maps: Optional[dict] = None,
+    sparse_k: Optional[int] = None,
+) -> tuple[GameData, dict]:
+    """Decoded Avro records → (GameData, per-shard IndexMaps).
+
+    index_maps: shard name -> frozen IndexMap to reuse (scoring path);
+    missing maps are built from the records (training path).
+    """
+    n = len(records)
+    y = np.empty(n, np.float32)
+    offsets = np.zeros(n, np.float32)
+    weights = np.ones(n, np.float32)
+    entity_ids: dict = {e: np.empty(n, object) for e in config.entity_fields}
+
+    # One normalization pass: bag dict-entries → NameTermValue
+    bag_names = sorted({b for cfg in config.shards.values() for b in cfg.bags})
+    norm_records: list = []
+    for i, rec in enumerate(records):
+        y[i] = float(rec[config.response_field])
+        off = rec.get(config.offset_field)
+        if off is not None:
+            offsets[i] = float(off)
+        wt = rec.get(config.weight_field)
+        if wt is not None:
+            weights[i] = float(wt)
+        for e in config.entity_fields:
+            v = rec.get(e)
+            if v is None:
+                raise ValueError(f"record {i} missing entity id {e!r}")
+            entity_ids[e][i] = str(v)
+        norm_records.append({b: _to_ntv(rec.get(b)) for b in bag_names})
+
+    index_maps = dict(index_maps or {})
+    shards = {}
+    for shard_name, shard_cfg in config.shards.items():
+        imap = index_maps.get(shard_name)
+        if imap is None:
+            imap = build_index_map(norm_records, shard_cfg)
+            index_maps[shard_name] = imap
+        shards[shard_name] = build_design_matrix(
+            norm_records, shard_cfg, imap, k=sparse_k)
+
+    ids = {e: np.asarray([str(v) for v in col]) for e, col in entity_ids.items()}
+    return GameData(y, weights, offsets, shards, ids), index_maps
+
+
+def read_game_data(
+    path,
+    config: GameDataConfig,
+    index_maps: Optional[dict] = None,
+    sparse_k: Optional[int] = None,
+) -> tuple[GameData, dict]:
+    """Avro file/dir → GameData (reference: AvroDataReader.readMerged)."""
+    return records_to_game_data(read_avro(path), config, index_maps, sparse_k)

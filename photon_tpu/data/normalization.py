@@ -1,0 +1,155 @@
+"""Feature normalization.
+
+Reference parity: com.linkedin.photon.ml.normalization.{NormalizationType,
+NormalizationContext} — NONE, SCALE_WITH_MAX_MAGNITUDE,
+SCALE_WITH_STANDARD_DEVIATION, STANDARDIZATION. The reference never
+materializes normalized data: it keeps `factors` and `shiftsAndIntercept`
+and folds them into every loss/gradient evaluation, so sparse data stays
+sparse. photon-tpu does the same, TPU-style: the Objective applies
+``w ↦ factors∘w`` and subtracts ``(shifts·(factors∘w))`` inside the fused
+margin computation (see ops.objective), so normalization costs one
+elementwise multiply fused into the matvec — no second copy of X in HBM.
+
+Training therefore happens in *normalized* coefficient space (which is also
+what the L2 penalty sees — the reference's "regularization in scaled space"
+behavior), and `to_original_space` converts the trained coefficients back,
+folding the shift correction into the intercept.
+
+STANDARDIZATION (shifts ≠ 0) requires an intercept column, as in the
+reference (NormalizationContext requires the intercept for shift modes).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu.data.matrix import Matrix, SparseRows
+
+
+class NormalizationType(enum.Enum):
+    NONE = "none"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    STANDARDIZATION = "standardization"
+
+
+def _column_stats(X: Matrix) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(mean, std, max|x|) per column; sparse stats count implicit zeros,
+    matching the reference's BasicStatisticalSummary over full vectors."""
+    if isinstance(X, SparseRows):
+        n, d = X.shape
+        idx = np.asarray(X.indices).reshape(-1)
+        val = np.asarray(X.values).reshape(-1)
+        s1 = np.zeros(d, np.float64)
+        s2 = np.zeros(d, np.float64)
+        mx = np.zeros(d, np.float64)
+        np.add.at(s1, idx, val)
+        np.add.at(s2, idx, val * val)
+        np.maximum.at(mx, idx, np.abs(val))
+        mean = s1 / n
+        var = np.maximum(s2 / n - mean * mean, 0.0)
+        return mean, np.sqrt(var), mx
+    Xn = np.asarray(X, np.float64)
+    return Xn.mean(0), Xn.std(0), np.abs(Xn).max(0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Per-feature factors/shifts; margin math lives in ops.objective."""
+
+    norm_type: NormalizationType
+    factors: Optional[np.ndarray] = None  # (d,) multiply
+    shifts: Optional[np.ndarray] = None  # (d,) subtract (pre-factor)
+    intercept_index: Optional[int] = None
+
+    def __post_init__(self):
+        if self.shifts is not None and self.intercept_index is None:
+            raise ValueError(
+                "shifts require an intercept_index — the shift correction "
+                "folds into the intercept coefficient (reference: "
+                "NormalizationContext shift modes require the intercept)"
+            )
+
+    @staticmethod
+    def no_op() -> "NormalizationContext":
+        return NormalizationContext(NormalizationType.NONE)
+
+    @staticmethod
+    def build(
+        X: Matrix,
+        norm_type: NormalizationType,
+        intercept_index: Optional[int] = -1,
+    ) -> "NormalizationContext":
+        """Compute factors/shifts from a design matrix (reference:
+        NormalizationContext(normalizationType, summary, interceptId))."""
+        if norm_type is NormalizationType.NONE:
+            return NormalizationContext.no_op()
+        mean, std, mx = _column_stats(X)
+        d = mean.shape[0]
+        if intercept_index is not None and intercept_index < 0:
+            intercept_index += d
+
+        if norm_type is NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+            denom, shifts = mx, None
+        elif norm_type is NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+            denom, shifts = std, None
+        elif norm_type is NormalizationType.STANDARDIZATION:
+            if intercept_index is None:
+                raise ValueError(
+                    "STANDARDIZATION requires an intercept column "
+                    "(reference: NormalizationContext shift modes)"
+                )
+            denom, shifts = std, mean.astype(np.float32)
+        else:
+            raise ValueError(norm_type)
+
+        # Zero-variance / all-zero columns keep factor 1 (reference guards
+        # against dividing by zero the same way).
+        factors = np.where(denom > 0, 1.0 / np.maximum(denom, 1e-30), 1.0)
+        factors = factors.astype(np.float32)
+        if intercept_index is not None and 0 <= intercept_index < d:
+            factors[intercept_index] = 1.0
+            if shifts is not None:
+                shifts[intercept_index] = 0.0
+        return NormalizationContext(norm_type, factors, shifts, intercept_index)
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # ------------------------------------------------- coefficient transforms
+    def to_original_space(self, w: np.ndarray) -> np.ndarray:
+        """Normalized-space coefficients → original-space (reference:
+        modelToOriginalSpace): scale by factors; the shift correction
+        -(shifts·(factors∘w)) folds into the intercept coefficient."""
+        w = np.asarray(w, np.float32)
+        if self.is_identity:
+            return w
+        out = w * self.factors if self.factors is not None else w.copy()
+        if self.shifts is not None:
+            out[self.intercept_index] -= float(np.dot(self.shifts, out))
+        return out
+
+    def to_normalized_space(self, w_orig: np.ndarray) -> np.ndarray:
+        """Inverse of `to_original_space` (reference: modelToTransformedSpace);
+        used to warm-start a normalized solve from an original-space model."""
+        w_orig = np.asarray(w_orig, np.float32)
+        if self.is_identity:
+            return w_orig
+        w = w_orig.copy()
+        if self.shifts is not None:
+            w[self.intercept_index] += float(np.dot(self.shifts, w))
+        if self.factors is not None:
+            w = np.where(self.factors != 0, w / self.factors, w)
+        return w.astype(np.float32)
+
+    def variances_to_original_space(self, var: np.ndarray) -> np.ndarray:
+        """Diagonal variances scale by factors² (intercept covariance with the
+        shift correction is dropped — diagonal approximation)."""
+        var = np.asarray(var, np.float32)
+        if self.factors is None:
+            return var
+        return var * (self.factors * self.factors)

@@ -75,11 +75,15 @@ class RandomEffectModel:
         """
         raw = np.asarray(raw_ids)
         keys = np.asarray(self.entity_keys)
-        if raw.dtype != keys.dtype and not (
-            np.issubdtype(raw.dtype, np.number)
-            and np.issubdtype(keys.dtype, np.number)
-        ):
-            raw = raw.astype(keys.dtype)
+        if raw.dtype.kind != keys.dtype.kind:
+            # Cross-kind lookup (e.g. int ids vs str keys): promote to str
+            # rather than casting into keys' dtype — a fixed-width unicode
+            # cast would TRUNCATE unseen longer ids into colliding with real
+            # entities. Same-kind strings compare fine across widths.
+            if keys.dtype.kind in "US":
+                raw = raw.astype(np.str_)
+            else:
+                raw = raw.astype(keys.dtype)
         pos = np.searchsorted(keys, raw)
         pos_c = np.clip(pos, 0, len(keys) - 1)
         found = keys[pos_c] == raw

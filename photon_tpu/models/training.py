@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh
 
 from photon_tpu.data.dataset import GLMBatch, pad_batch
@@ -44,6 +45,7 @@ def make_objective(
     prior_mean=None,
     prior_precision=None,
     intercept_index: Optional[int] = -1,
+    normalization=None,
 ) -> Objective:
     """Build the smooth objective for one coordinate's solve.
 
@@ -52,10 +54,20 @@ def make_objective(
     photon_tpu's design-matrix builders (``data.feature_bags``) append the
     intercept as the LAST column; callers building their own X with a
     different layout must pass the actual index (or None for no intercept).
+
+    normalization: optional data.normalization.NormalizationContext; its
+    factors/shifts are folded into the objective's margin so the solve runs
+    in normalized coefficient space without materializing normalized data.
     """
     reg_mask = None
     if not config.regularize_intercept and intercept_index is not None:
         reg_mask = jnp.ones((n_features,), jnp.float32).at[intercept_index].set(0.0)
+    norm_factors = norm_shifts = None
+    if normalization is not None and not normalization.is_identity:
+        if normalization.factors is not None:
+            norm_factors = jnp.asarray(normalization.factors, jnp.float32)
+        if normalization.shifts is not None:
+            norm_shifts = jnp.asarray(normalization.shifts, jnp.float32)
     return Objective(
         task=task,
         l2=config.reg.l2_weight(config.reg_weight),
@@ -63,6 +75,8 @@ def make_objective(
         reg_mask=reg_mask,
         prior_mean=prior_mean,
         prior_precision=prior_precision,
+        norm_factors=norm_factors,
+        norm_shifts=norm_shifts,
     )
 
 
@@ -109,18 +123,40 @@ def train_glm(
     variance: VarianceComputationType = VarianceComputationType.NONE,
     prior_mean=None,
     prior_precision=None,
+    normalization=None,
 ) -> tuple[GeneralizedLinearModel, OptResult]:
     """Full-batch distributed GLM training (DistributedOptimizationProblem.run).
 
     With a mesh, examples are sharded across the ``data`` axis and the whole
     solve compiles to one SPMD program; without one it runs single-device.
+
+    With a NormalizationContext, the solve runs in normalized coefficient
+    space (factors/shifts fused into the objective; X untouched) and the
+    returned model's coefficients/variances are converted BACK to original
+    space, so scoring raw features works directly. ``w0`` and priors, when
+    given, are interpreted in original space too.
     """
     d = (batch.X.n_features if isinstance(batch.X, SparseRows)
          else batch.X.shape[1])
+    norm = normalization if (normalization is not None
+                             and not normalization.is_identity) else None
     if w0 is None:
         w0 = jnp.zeros((d,), jnp.float32)
+    elif norm is not None:
+        w0 = jnp.asarray(norm.to_normalized_space(np.asarray(w0)))
+    if norm is not None and prior_mean is not None:
+        prior_mean = jnp.asarray(norm.to_normalized_space(np.asarray(prior_mean)))
+    if norm is not None and prior_precision is not None:
+        # Diagonal prior in original space ↦ normalized space: the penalty
+        # τ_j(w_orig − μ_orig)_j² with w_orig_j = f_j·w_norm_j becomes
+        # (τ_j f_j²)(w_norm − μ_norm)_j² (intercept/shift coupling dropped —
+        # same diagonal approximation as variances_to_original_space).
+        f = np.asarray(norm.factors) if norm.factors is not None else 1.0
+        prior_precision = jnp.asarray(
+            np.asarray(prior_precision, np.float32) * f * f)
     obj = make_objective(task, config, d,
-                         prior_mean=prior_mean, prior_precision=prior_precision)
+                         prior_mean=prior_mean, prior_precision=prior_precision,
+                         normalization=norm)
 
     if mesh is not None:
         n_dev = mesh.devices.size
@@ -135,5 +171,10 @@ def train_glm(
         return res, var
 
     res, var = _run(batch, w0)
-    model = GeneralizedLinearModel(Coefficients(res.w, var), task)
+    w_out = res.w
+    if norm is not None:
+        w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))
+        if var is not None:
+            var = jnp.asarray(norm.variances_to_original_space(np.asarray(var)))
+    model = GeneralizedLinearModel(Coefficients(w_out, var), task)
     return model, res

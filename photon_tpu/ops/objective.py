@@ -43,6 +43,14 @@ class Objective:
     prior_mean / prior_precision: informative-prior (incremental training)
     parameters; the L2 term becomes 0.5 Σ_j (l2 + τ_j)(w_j - μ_j)² with μ=0,
     τ=0 when absent. Reference: function.PriorDistribution.
+
+    norm_factors / norm_shifts: feature normalization folded into the margin
+    (reference: NormalizationContext factors/shiftsAndIntercept applied inside
+    every loss evaluation so sparse X stays sparse). The margin becomes
+    z = X(f∘w) − (s·(f∘w)) + offset, i.e. the solve runs in normalized
+    coefficient space — which is also the space the L2 penalty sees, matching
+    the reference's regularization-under-normalization semantics. Convert
+    trained coefficients back with NormalizationContext.to_original_space.
     """
 
     task: TaskType
@@ -51,6 +59,8 @@ class Objective:
     reg_mask: Optional[jax.Array] = None
     prior_mean: Optional[jax.Array] = None
     prior_precision: Optional[jax.Array] = None
+    norm_factors: Optional[jax.Array] = None
+    norm_shifts: Optional[jax.Array] = None
 
     # ---------------------------------------------------------------- helpers
     def _psum(self, x):
@@ -58,8 +68,34 @@ class Objective:
             return x
         return lax.psum(x, self.axis_name)
 
+    def _eff_w(self, w):
+        """Normalized-space coefficients as seen by the data: f∘w."""
+        return w if self.norm_factors is None else w * self.norm_factors
+
+    def _margin_of_eff(self, wt, batch: GLMBatch):
+        z = matvec(batch.X, wt) + batch.offsets
+        if self.norm_shifts is not None:
+            z = z - jnp.dot(self.norm_shifts, wt)
+        return z
+
     def _margin(self, w, batch: GLMBatch):
-        return matvec(batch.X, w) + batch.offsets
+        return self._margin_of_eff(self._eff_w(w), batch)
+
+    def _backprop(self, batch: GLMBatch, g):
+        """∂z/∂w pulled back over a per-row cotangent g: f∘(Xᵀg − s·Σg).
+        Returns the LOCAL (pre-psum) pieces (Xᵀg, Σg); Σg is only computed
+        (and later psum'd) when a shift term exists."""
+        gX = rmatvec(batch.X, g)
+        gsum = jnp.sum(g) if self.norm_shifts is not None else None
+        return gX, gsum
+
+    def _finish_backprop(self, gX, gsum=None):
+        out = gX
+        if self.norm_shifts is not None:
+            out = out - self.norm_shifts * gsum
+        if self.norm_factors is not None:
+            out = out * self.norm_factors
+        return out
 
     def _reg_terms(self, w):
         """(value, grad) of the smooth regularizer at w."""
@@ -87,38 +123,68 @@ class Objective:
     def value_and_grad(self, w, batch: GLMBatch):
         loss, d1, _ = loss_fns(self.task)
         z = self._margin(w, batch)
+        g = batch.weights * d1(z, batch.y)
         local_value = jnp.sum(batch.weights * loss(z, batch.y))
-        local_grad = rmatvec(batch.X, batch.weights * d1(z, batch.y))
+        gX, gsum = self._backprop(batch, g)
         value = self._psum(local_value)
-        grad = self._psum(local_grad)
+        grad = self._finish_backprop(
+            self._psum(gX), None if gsum is None else self._psum(gsum))
         rv, rg = self._reg_terms(w)
         return value + rv, grad + rg
 
     def hvp(self, w, batch: GLMBatch, v):
-        """Hessian-vector product: X^T diag(weight · d2) X v + reg·v.
+        """Hessian-vector product: Jᵀ diag(weight · d2) J v + reg·v, where
+        J = ∂z/∂w (= X when unnormalized).
 
         Reference: TwiceDiffFunction.hessianVector — computed the same way
         (Gauss-Newton form is exact for GLMs) per partition + treeAggregate.
         """
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
-        Xv = matvec(batch.X, v)
-        local = rmatvec(batch.X, batch.weights * d2(z, batch.y) * Xv)
-        hv = self._psum(local)
+        dz = self._margin_of_eff(self._eff_w(v), batch._replace(
+            offsets=jnp.zeros_like(batch.offsets)))
+        g = batch.weights * d2(z, batch.y) * dz
+        gX, gsum = self._backprop(batch, g)
+        hv = self._finish_backprop(
+            self._psum(gX), None if gsum is None else self._psum(gsum))
         return hv + self._reg_hess_diag(w) * v
 
     def hess_diag(self, w, batch: GLMBatch):
         """diag(H). Reference: TwiceDiffFunction.hessianDiagonal (used for
-        VarianceComputationType.SIMPLE coefficient variances)."""
+        VarianceComputationType.SIMPLE coefficient variances).
+
+        With normalization, H_jj = f_j² Σ_i w2_i (x_ij − s_j)², expanded into
+        segment-sum pieces so sparse X never densifies.
+        """
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
-        local = sq_rmatvec(batch.X, batch.weights * d2(z, batch.y))
-        return self._psum(local) + self._reg_hess_diag(w)
+        w2 = batch.weights * d2(z, batch.y)
+        diag = self._psum(sq_rmatvec(batch.X, w2))
+        if self.norm_shifts is not None:
+            xw2 = self._psum(rmatvec(batch.X, w2))
+            w2sum = self._psum(jnp.sum(w2))
+            s = self.norm_shifts
+            diag = diag - 2.0 * s * xw2 + s * s * w2sum
+        if self.norm_factors is not None:
+            diag = diag * self.norm_factors * self.norm_factors
+        return diag + self._reg_hess_diag(w)
 
     def full_hessian(self, w, batch: GLMBatch):
         """Dense (d, d) Hessian. Reference: TwiceDiffFunction.hessianMatrix
-        (VarianceComputationType.FULL); only for small feature spaces."""
+        (VarianceComputationType.FULL); only for small feature spaces.
+
+        With normalization: F(G − s qᵀ − q sᵀ + (Σw2) s sᵀ)F with
+        G = Xᵀdiag(w2)X, q = Xᵀw2, F = diag(factors).
+        """
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
-        H = self._psum(weighted_gram(batch.X, batch.weights * d2(z, batch.y)))
+        w2 = batch.weights * d2(z, batch.y)
+        H = self._psum(weighted_gram(batch.X, w2))
+        if self.norm_shifts is not None:
+            q = self._psum(rmatvec(batch.X, w2))
+            w2sum = self._psum(jnp.sum(w2))
+            s = self.norm_shifts
+            H = H - jnp.outer(s, q) - jnp.outer(q, s) + w2sum * jnp.outer(s, s)
+        if self.norm_factors is not None:
+            H = H * jnp.outer(self.norm_factors, self.norm_factors)
         return H + jnp.diag(self._reg_hess_diag(w))

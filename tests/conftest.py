@@ -19,8 +19,10 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", False)
-# The axon TPU plugin ignores JAX_PLATFORMS-based filtering; pin the default
-# device to CPU explicitly so tests run on the virtual 8-device mesh.
+# The axon TPU plugin overrides JAX_PLATFORMS env filtering with its own
+# jax_platforms='axon,cpu'; force plain CPU *before* any backend init so the
+# suite never touches (or blocks on) the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
 _cpu_devices = jax.devices("cpu")
 jax.config.update("jax_default_device", _cpu_devices[0])
 

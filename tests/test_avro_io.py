@@ -1,0 +1,268 @@
+"""Avro container IO, record ingestion, and model save/load round trips
+(SURVEY.md §4 'Avro reader vs hand-built fixtures')."""
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.avro_io import (
+    AvroContainerReader,
+    read_avro,
+    read_datum,
+    parse_schema,
+    write_avro,
+)
+from photon_tpu.data.feature_bags import FeatureShardConfig
+from photon_tpu.data.ingest import (
+    GameDataConfig,
+    read_game_data,
+    records_to_game_data,
+    training_example_schema,
+)
+from photon_tpu.data.model_io import (
+    load_game_model,
+    load_glm_avro,
+    save_game_model,
+    save_glm_avro,
+)
+from photon_tpu.data.index_map import IndexMap, feature_key
+
+
+RICH_SCHEMA = {
+    "type": "record",
+    "name": "Rich",
+    "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "score", "type": "double"},
+        {"name": "tag", "type": ["null", "string"], "default": None},
+        {"name": "nested", "type": {
+            "type": "record", "name": "Inner",
+            "fields": [{"name": "v", "type": "float"}],
+        }},
+        {"name": "arr", "type": {"type": "array", "items": "Inner"}},
+        {"name": "m", "type": {"type": "map", "values": "int"}},
+        {"name": "flag", "type": "boolean"},
+    ],
+}
+
+
+def _rich_records(n=500):
+    return [
+        {
+            "id": i,
+            "score": i * 0.5,
+            "tag": None if i % 3 else f"tag{i}",
+            "nested": {"v": float(i)},
+            "arr": [{"v": float(j)} for j in range(i % 4)],
+            "m": {f"k{j}": j for j in range(i % 3)},
+            "flag": bool(i % 2),
+        }
+        for i in range(n)
+    ]
+
+
+class TestContainerRoundTrip:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_round_trip(self, tmp_path, codec):
+        p = tmp_path / "t.avro"
+        recs = _rich_records()
+        write_avro(p, recs, RICH_SCHEMA, codec=codec, block_records=128)
+        out = read_avro(p)
+        assert len(out) == len(recs)
+        for a, b in zip(out, recs):
+            assert a["id"] == b["id"]
+            assert a["score"] == pytest.approx(b["score"])
+            assert a["tag"] == b["tag"]
+            assert a["nested"]["v"] == pytest.approx(b["nested"]["v"])
+            assert len(a["arr"]) == len(b["arr"])
+            assert a["m"] == b["m"]
+            assert a["flag"] == b["flag"]
+
+    def test_directory_read(self, tmp_path):
+        recs = _rich_records(100)
+        write_avro(tmp_path / "part-0.avro", recs[:50], RICH_SCHEMA)
+        write_avro(tmp_path / "part-1.avro", recs[50:], RICH_SCHEMA)
+        (tmp_path / "ignore.txt").write_text("x")
+        out = read_avro(tmp_path)
+        assert [r["id"] for r in out] == list(range(100))
+
+    def test_codec_reported(self, tmp_path):
+        p = tmp_path / "t.avro"
+        write_avro(p, _rich_records(5), RICH_SCHEMA, codec="deflate")
+        assert AvroContainerReader(p).codec == "deflate"
+
+    def test_writer_does_not_mutate_schema(self, tmp_path):
+        """parse_schema must not expand named-type references inside the
+        caller's dict — the serialized schema would redefine the named type
+        (rejected by standard Avro readers) and the shared constant would be
+        corrupted for later calls."""
+        import copy
+        import json
+
+        schema = training_example_schema(feature_bags=("f1", "f2"))
+        before = copy.deepcopy(schema)
+        p = tmp_path / "t.avro"
+        write_avro(p, [], schema)
+        assert schema == before  # caller's dict untouched
+        written = AvroContainerReader(p).metadata["avro.schema"].decode()
+        assert written.count('"NameTermValueAvro"') == 2  # def once + ref once
+        assert json.loads(written) == before
+
+
+class TestHandBuiltFixture:
+    """Reader vs bytes encoded by hand from the Avro spec (not our writer)."""
+
+    @staticmethod
+    def _zigzag(n: int) -> bytes:
+        n = (n << 1) ^ (n >> 63)
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes((b7 | 0x80,))
+            else:
+                return out + bytes((b7,))
+
+    def test_known_bytes(self, tmp_path):
+        z = self._zigzag
+        schema = (b'{"type":"record","name":"R","fields":['
+                  b'{"name":"a","type":"long"},'
+                  b'{"name":"s","type":"string"},'
+                  b'{"name":"d","type":"double"}]}')
+        sync = bytes(range(16))
+        # record (a=-3, s="hi", d=1.5): zigzag(-3)=5 -> b"\x05"
+        body = z(-3) + z(2) + b"hi" + struct.pack("<d", 1.5)
+        blob = (
+            b"Obj\x01"
+            + z(2)  # 2 metadata entries
+            + z(len(b"avro.schema")) + b"avro.schema" + z(len(schema)) + schema
+            + z(len(b"avro.codec")) + b"avro.codec" + z(4) + b"null"
+            + z(0)  # end metadata map
+            + sync
+            + z(1) + z(len(body)) + body + sync  # one block, one record
+        )
+        p = tmp_path / "hand.avro"
+        p.write_bytes(blob)
+        (rec,) = read_avro(p)
+        assert rec == {"a": -3, "s": "hi", "d": 1.5}
+
+    def test_negative_array_block_count(self):
+        """Writers may emit (-count, bytesize) array blocks; spec-required."""
+        schema = parse_schema(
+            {"type": "array", "items": "long"})
+        z = self._zigzag
+        items = z(7) + z(9)
+        payload = z(-2) + z(len(items)) + items + z(0)
+        assert read_datum(io.BytesIO(payload), schema) == [7, 9]
+
+
+class TestIngest:
+    def _write_fixture(self, tmp_path, n=40):
+        rng = np.random.default_rng(5)
+        schema = training_example_schema(
+            feature_bags=("global", "per_user"), entity_fields=("userId",))
+        records = []
+        for i in range(n):
+            records.append({
+                "response": float(i % 2),
+                "offset": 0.25 if i == 0 else None,
+                "weight": 2.0 if i == 1 else None,
+                "uid": str(i),
+                "userId": f"u{i % 5}",
+                "global": [
+                    {"name": "age", "term": "", "value": float(20 + i % 30)},
+                    {"name": "ctr", "term": "7d", "value": float(rng.uniform())},
+                ],
+                "per_user": [
+                    {"name": "hist", "term": "", "value": float(rng.uniform())},
+                ],
+            })
+        p = tmp_path / "train.avro"
+        write_avro(p, records, schema)
+        return p
+
+    def test_read_game_data(self, tmp_path):
+        p = self._write_fixture(tmp_path)
+        cfg = GameDataConfig(
+            shards={
+                "fixed": FeatureShardConfig(bags=("global",)),
+                "user": FeatureShardConfig(bags=("per_user",)),
+            },
+            entity_fields=("userId",),
+        )
+        data, imaps = read_game_data(p, cfg)
+        assert data.n == 40
+        assert data.offsets[0] == pytest.approx(0.25)
+        assert data.weights[1] == pytest.approx(2.0)
+        assert data.weights[0] == pytest.approx(1.0)
+        assert set(np.unique(data.entity_ids["userId"])) == {f"u{i}" for i in range(5)}
+        assert data.shards["fixed"].shape == (40, 3)  # age, ctr#7d, intercept
+        assert data.shards["user"].shape == (40, 2)  # hist, intercept
+        # frozen maps reused on a second read (scoring path): same columns
+        data2, _ = read_game_data(p, cfg, index_maps=imaps)
+        np.testing.assert_allclose(
+            np.asarray(data2.shards["fixed"]), np.asarray(data.shards["fixed"]))
+
+
+class TestModelIO:
+    def test_glm_avro_round_trip(self, tmp_path):
+        imap = IndexMap()
+        imap.build([feature_key("a", ""), feature_key("b", "x"),
+                    feature_key("c", ""), "(INTERCEPT)"]).freeze()
+        w = np.array([0.5, 0.0, -1.25, 2.0], np.float32)  # b#x is zero
+        var = np.array([0.1, 0.0, 0.2, 0.3], np.float32)
+        p = tmp_path / "glm.avro"
+        save_glm_avro(p, w, imap, var)
+        w2, var2 = load_glm_avro(p, imap)
+        np.testing.assert_allclose(w2, w)
+        np.testing.assert_allclose(var2, var)
+
+    def test_game_model_round_trip(self, tmp_path):
+        """Save + load a trained GAME model; scores must be identical."""
+        import jax.numpy as jnp
+
+        from photon_tpu.game.dataset import GameData
+        from photon_tpu.game.estimator import (
+            FixedEffectConfig, GameEstimator, RandomEffectConfig)
+        from photon_tpu.game.scoring import score_game
+        from photon_tpu.ops.losses import TaskType
+        from photon_tpu.optim.config import OptimizerConfig
+        from photon_tpu.optim.regularization import l2
+
+        rng = np.random.default_rng(11)
+        n, dF, dR, E = 160, 4, 2, 6
+        Xf = rng.normal(size=(n, dF)).astype(np.float32)
+        Xf[:, -1] = 1.0
+        Xr = rng.normal(size=(n, dR)).astype(np.float32)
+        ids = np.asarray([f"e{int(i)}" for i in rng.integers(0, E, n)])
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        data = GameData.build(y, shards={"f": Xf, "r": Xr},
+                              entity_ids={"user": ids})
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "fixed": FixedEffectConfig("f", OptimizerConfig(
+                    max_iters=15, reg=l2(), reg_weight=0.1)),
+                "per_user": RandomEffectConfig("user", "r", OptimizerConfig(
+                    max_iters=10, reg=l2(), reg_weight=1.0)),
+            },
+            n_sweeps=1,
+        )
+        model = est.fit(data)[0].model
+
+        imF = IndexMap()
+        imF.build([f"f{j}" for j in range(dF - 1)] + ["(INTERCEPT)"]).freeze()
+        imR = IndexMap()
+        imR.build([f"r{j}" for j in range(dR)]).freeze()
+        out = tmp_path / "game_model"
+        save_game_model(out, model, {"fixed": imF, "per_user": imR})
+        loaded, imaps = load_game_model(out)
+
+        assert loaded.task == model.task
+        assert loaded.names() == model.names()
+        s0 = np.asarray(score_game(model, data))
+        s1 = np.asarray(score_game(loaded, data))
+        np.testing.assert_allclose(s1, s0, rtol=1e-5, atol=1e-6)
+        assert imaps["fixed"].n_features == dF

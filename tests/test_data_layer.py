@@ -1,0 +1,291 @@
+"""Data-layer tests: index map, feature bags, normalization, validators,
+down-sampling (SURVEY.md §4 'normalization round-trips; index-map
+round-trips')."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.data.feature_bags import (
+    FeatureShardConfig,
+    NameTermValue,
+    build_design_matrix,
+    build_shard,
+)
+from photon_tpu.data.index_map import (
+    DELIMITER,
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+)
+from photon_tpu.data.matrix import SparseRows, from_scipy_csr
+from photon_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+)
+from photon_tpu.data.sampling import binary_down_sample, default_down_sample
+from photon_tpu.data.validators import (
+    DataValidationType,
+    validate_glm_data,
+)
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.ops.objective import Objective
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig
+
+
+# --------------------------------------------------------------- index map
+class TestIndexMap:
+    def test_build_freeze_lookup(self):
+        m = IndexMap()
+        a = m.index_of(feature_key("age", ""))
+        b = m.index_of(feature_key("clicks", "7d"))
+        assert (a, b) == (0, 1)
+        assert m.index_of(feature_key("age", "")) == 0  # idempotent
+        icpt = m.index_of(INTERCEPT_KEY)
+        assert icpt == m.intercept_id == len(m) - 1  # intercept last
+        m.freeze()
+        assert m.index_of("never-seen") == IndexMap.NULL_ID
+        assert m.get(feature_key("clicks", "7d")) == 1
+
+    def test_intercept_stays_last_after_growth(self):
+        m = IndexMap()
+        m.index_of("f0")
+        m.index_of(INTERCEPT_KEY)
+        m.index_of("f1")  # grows past the intercept
+        assert m.intercept_id == 2
+        assert m.keys_in_order() == ["f0", "f1", INTERCEPT_KEY]
+
+    def test_save_load_round_trip(self, tmp_path):
+        m = IndexMap()
+        m.build([feature_key("a", "x"), feature_key("b", ""), INTERCEPT_KEY])
+        p = tmp_path / "imap.tsv"
+        m.save(p)
+        m2 = IndexMap.load(p)
+        assert m2.frozen and m2.has_intercept
+        assert len(m2) == len(m)
+        for k in m.keys_in_order():
+            assert m2.get(k) == m.get(k)
+        assert DELIMITER in m.keys_in_order()[0]  # delimiter survived escaping
+
+
+# ------------------------------------------------------------ feature bags
+def _records():
+    return [
+        {"global": [NameTermValue("age", "", 30.0), NameTermValue("ctr", "7d", 0.1)]},
+        {"global": [NameTermValue("age", "", 40.0)],
+         "extra": [NameTermValue("dev", "ios", 1.0)]},
+        {"global": [NameTermValue("ctr", "7d", 0.2),
+                    NameTermValue("ctr", "7d", 0.3)]},  # duplicate sums
+    ]
+
+
+class TestFeatureBags:
+    def test_dense_shard_with_intercept(self):
+        cfg = FeatureShardConfig(bags=("global",))
+        X, imap = build_shard(_records(), cfg)
+        assert X.shape == (3, 3)  # age, ctr#7d, intercept
+        icpt = imap.intercept_id
+        np.testing.assert_allclose(np.asarray(X)[:, icpt], 1.0)
+        age = imap.get(feature_key("age", ""))
+        ctr = imap.get(feature_key("ctr", "7d"))
+        np.testing.assert_allclose(np.asarray(X)[:, age], [30.0, 40.0, 0.0])
+        np.testing.assert_allclose(np.asarray(X)[:, ctr], [0.1, 0.0, 0.5])
+
+    def test_multi_bag_merge(self):
+        cfg = FeatureShardConfig(bags=("global", "extra"), has_intercept=False)
+        X, imap = build_shard(_records(), cfg)
+        assert X.shape == (3, 3)
+        dev = imap.get(feature_key("dev", "ios"))
+        np.testing.assert_allclose(np.asarray(X)[:, dev], [0.0, 1.0, 0.0])
+
+    def test_sparse_path_matches_dense(self):
+        cfg_d = FeatureShardConfig(bags=("global", "extra"), dense_threshold=1024)
+        cfg_s = FeatureShardConfig(bags=("global", "extra"), dense_threshold=1)
+        Xd, imap = build_shard(_records(), cfg_d)
+        Xs = build_design_matrix(_records(), cfg_s, imap)
+        assert isinstance(Xs, SparseRows)
+        dense_from_sparse = np.zeros(Xd.shape, np.float32)
+        idx, val = np.asarray(Xs.indices), np.asarray(Xs.values)
+        for i in range(Xd.shape[0]):
+            np.add.at(dense_from_sparse[i], idx[i], val[i])
+        np.testing.assert_allclose(dense_from_sparse, np.asarray(Xd))
+
+    def test_frozen_map_drops_unseen(self):
+        cfg = FeatureShardConfig(bags=("global",), has_intercept=False)
+        _, imap = build_shard(_records()[:1], cfg)  # only age, ctr
+        X = build_design_matrix(
+            [{"global": [NameTermValue("brand-new", "", 5.0),
+                         NameTermValue("age", "", 25.0)]}], cfg, imap)
+        row = np.asarray(X)[0]
+        assert row[imap.get(feature_key("age", ""))] == 25.0
+        assert np.count_nonzero(row) == 1  # unseen feature dropped
+
+
+# ---------------------------------------------------------- normalization
+def _logit_problem(rng, n=2000, d=8, scale=None):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    if scale is not None:
+        X *= scale  # wildly different column scales
+    X[:, -1] = 1.0  # intercept last
+    w = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y
+
+
+class TestNormalization:
+    def test_stats_modes(self, rng):
+        X = rng.normal(size=(500, 4)).astype(np.float32) * [1, 10, 100, 1]
+        X[:, -1] = 1.0
+        ctx = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.SCALE_WITH_STANDARD_DEVIATION)
+        np.testing.assert_allclose(
+            ctx.factors[:-1], 1.0 / X[:, :-1].std(0), rtol=1e-5)
+        assert ctx.factors[-1] == 1.0  # intercept untouched
+        ctx2 = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.SCALE_WITH_MAX_MAGNITUDE)
+        np.testing.assert_allclose(
+            ctx2.factors[:-1], 1.0 / np.abs(X[:, :-1]).max(0), rtol=1e-5)
+        ctx3 = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.STANDARDIZATION)
+        np.testing.assert_allclose(ctx3.shifts[:-1], X[:, :-1].mean(0), rtol=1e-4,
+                                   atol=1e-6)
+        assert ctx3.shifts[-1] == 0.0
+
+    def test_sparse_stats_match_dense(self, rng):
+        import scipy.sparse as sp
+
+        Xd = rng.normal(size=(200, 6)).astype(np.float32)
+        Xd[Xd < 0.3] = 0.0  # sparsify; implicit zeros must count in stats
+        Xs = from_scipy_csr(sp.csr_matrix(Xd))
+        for t in (NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+                  NormalizationType.SCALE_WITH_MAX_MAGNITUDE):
+            cd = NormalizationContext.build(jnp.asarray(Xd), t,
+                                            intercept_index=None)
+            cs = NormalizationContext.build(Xs, t, intercept_index=None)
+            np.testing.assert_allclose(cs.factors, cd.factors, rtol=1e-4)
+
+    def test_normalized_objective_grad_matches_autodiff(self, rng):
+        X, y = _logit_problem(rng, n=300, d=6, scale=np.float32([1, 5, 50, 0.1, 2, 1]))
+        ctx = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.STANDARDIZATION)
+        obj = Objective(
+            task=TaskType.LOGISTIC_REGRESSION, l2=0.5,
+            norm_factors=jnp.asarray(ctx.factors),
+            norm_shifts=jnp.asarray(ctx.shifts),
+        )
+        batch = make_batch(X, y)
+        w = jnp.asarray(rng.normal(size=6), jnp.float32)
+        v, g = obj.value_and_grad(w, batch)
+        g_auto = jax.grad(lambda w: obj.value_and_grad(w, batch)[0])(w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_auto),
+                                   rtol=1e-4, atol=1e-4)
+        # HVP against autodiff too (shift + factor chain rule)
+        vdir = jnp.asarray(rng.normal(size=6), jnp.float32)
+        hv = obj.hvp(w, batch, vdir)
+        hv_auto = jax.jvp(
+            lambda w: jax.grad(lambda u: obj.value_and_grad(u, batch)[0])(w),
+            (w,), (vdir,))[1]
+        np.testing.assert_allclose(np.asarray(hv), np.asarray(hv_auto),
+                                   rtol=1e-3, atol=1e-3)
+        # Hessian diagonal matches the dense Hessian's diagonal
+        H = obj.full_hessian(w, batch)
+        hd = obj.hess_diag(w, batch)
+        np.testing.assert_allclose(np.asarray(hd), np.asarray(jnp.diag(H)),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_training_under_standardization_matches_materialized(self, rng):
+        """train_glm(normalization=...) on raw X == train_glm on explicitly
+        standardized X with coefficients mapped back (no regularization, so
+        the two parameterizations have identical optima)."""
+        scale = np.float32([100.0, 0.01, 1.0, 10.0, 1.0, 1.0])
+        X, y = _logit_problem(rng, n=2000, d=6, scale=scale)
+        ctx = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.STANDARDIZATION)
+        cfg = OptimizerConfig(max_iters=200, tolerance=1e-12)
+        m_norm, _ = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                              cfg, normalization=ctx)
+        Xstd = X.copy()
+        Xstd[:, :-1] = (X[:, :-1] - X[:, :-1].mean(0)) / X[:, :-1].std(0)
+        m_mat, _ = train_glm(make_batch(Xstd, y), TaskType.LOGISTIC_REGRESSION,
+                             cfg)
+        w_mat_orig = ctx.to_original_space(np.asarray(m_mat.weights))
+        np.testing.assert_allclose(np.asarray(m_norm.weights), w_mat_orig,
+                                   rtol=2e-2, atol=2e-3)
+        # and the normalized solve beats the raw solve's conditioning:
+        # same data, badly scaled — raw solve needs far more iterations.
+
+    def test_shifts_without_intercept_rejected(self):
+        with pytest.raises(ValueError, match="intercept_index"):
+            NormalizationContext(
+                NormalizationType.STANDARDIZATION,
+                factors=np.ones(3, np.float32),
+                shifts=np.zeros(3, np.float32),
+            )
+
+    def test_coefficient_space_round_trip(self, rng):
+        X, _ = _logit_problem(rng, n=100, d=5)
+        ctx = NormalizationContext.build(
+            jnp.asarray(X), NormalizationType.STANDARDIZATION)
+        w = rng.normal(size=5).astype(np.float32)
+        np.testing.assert_allclose(
+            ctx.to_normalized_space(ctx.to_original_space(w)), w,
+            rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------------- validators
+class TestValidators:
+    def test_passes_clean_data(self, rng):
+        X = rng.normal(size=(50, 3))
+        y = (rng.uniform(size=50) < 0.5).astype(np.float32)
+        validate_glm_data(y, X=X, task=TaskType.LOGISTIC_REGRESSION)
+
+    def test_catches_all_failures_at_once(self):
+        y = np.array([0.0, 1.0, 2.0, np.nan])
+        X = np.array([[1.0], [np.inf], [0.0], [0.0]])
+        w = np.array([1.0, -1.0, 1.0, 1.0])
+        with pytest.raises(ValueError) as e:
+            validate_glm_data(y, X=X, weights=w,
+                              task=TaskType.LOGISTIC_REGRESSION)
+        msg = str(e.value)
+        assert "non-finite labels" in msg
+        assert "non-binary labels" in msg
+        assert "non-finite feature" in msg
+        assert "negative or non-finite weights" in msg
+
+    def test_poisson_negative_labels(self):
+        with pytest.raises(ValueError, match="negative labels"):
+            validate_glm_data(np.array([1.0, -2.0]),
+                              task=TaskType.POISSON_REGRESSION)
+
+    def test_disabled_skips(self):
+        validate_glm_data(np.array([np.nan]), mode=DataValidationType.DISABLED)
+
+
+# ---------------------------------------------------------------- sampling
+class TestDownSampling:
+    def test_default_preserves_total_weight_in_expectation(self, rng):
+        n, rate = 20000, 0.3
+        idx, w = default_down_sample(n, rate, seed=1)
+        assert abs(w.sum() - n) / n < 0.05  # unbiased: E[sum w] = n
+        assert len(idx) < n * 0.4
+
+    def test_binary_keeps_all_positives(self, rng):
+        y = (rng.uniform(size=10000) < 0.1).astype(np.float32)
+        idx, w = binary_down_sample(y, 0.2, seed=2)
+        kept_y = y[idx]
+        assert kept_y.sum() == y.sum()  # every positive survives
+        np.testing.assert_allclose(w[kept_y > 0], 1.0)  # positive weights untouched
+        np.testing.assert_allclose(w[kept_y == 0], 1.0 / 0.2)
+        # negative effective mass preserved in expectation
+        neg_mass = w[kept_y == 0].sum()
+        assert abs(neg_mass - (y == 0).sum()) / (y == 0).sum() < 0.05
+
+    def test_rate_validation(self):
+        with pytest.raises(ValueError):
+            default_down_sample(10, 0.0)
+        with pytest.raises(ValueError):
+            binary_down_sample(np.zeros(4), 1.5)

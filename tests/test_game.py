@@ -369,3 +369,25 @@ def test_initial_models_honored_without_warm_start(rng):
     r = est2.fit(data, initial_models=dict(pretrained))[0]
     # warm-started solve converges almost immediately from the optimum
     assert r.descent.coordinate_stats["fixed"][0].iterations <= 3
+
+
+def test_unseen_longer_entity_id_maps_to_zero_row():
+    """Unseen ids longer than every training key must NOT truncate into a
+    real entity's row (fixed-width unicode cast bug)."""
+    from photon_tpu.game.model import RandomEffectModel
+
+    keys = np.asarray(["abc", "xyz"])  # dtype <U3
+    m = RandomEffectModel(
+        entity_name="e", feature_shard="s", task=TaskType.LOGISTIC_REGRESSION,
+        coefficients=jnp.ones((2, 2)), entity_keys=keys,
+        key_to_index={"abc": 0, "xyz": 1},
+    )
+    ids = m.dense_ids(np.asarray(["abcde", "abc", "zzz", "xyz"]))
+    np.testing.assert_array_equal(ids, [2, 0, 2, 1])
+    # integer raw ids against string keys still resolve by string value
+    m2 = RandomEffectModel(
+        entity_name="e", feature_shard="s", task=TaskType.LOGISTIC_REGRESSION,
+        coefficients=jnp.ones((2, 2)), entity_keys=np.asarray(["1", "2"]),
+        key_to_index={"1": 0, "2": 1},
+    )
+    np.testing.assert_array_equal(m2.dense_ids(np.asarray([2, 7, 1])), [1, 2, 0])
