@@ -74,8 +74,9 @@ def save_glm_avro(path, weights, imap: IndexMap, variances=None) -> None:
     keys = imap.keys_in_order()
     records = []
     for j, key in enumerate(keys):
-        if w[j] == 0.0:
-            continue  # sparse-by-name: zeros are implicit
+        if w[j] == 0.0 and (var is None or var[j] == 0.0):
+            continue  # sparse-by-name: zeros are implicit — but an L1-zeroed
+            # coefficient with a real variance must still round-trip
         name, term = _split_key(key)
         records.append({
             "name": name, "term": term, "value": float(w[j]),

@@ -125,26 +125,36 @@ class NormalizationContext:
         """Normalized-space coefficients → original-space (reference:
         modelToOriginalSpace): scale by factors; the shift correction
         -(shifts·(factors∘w)) folds into the intercept coefficient."""
-        w = np.asarray(w, np.float32)
-        if self.is_identity:
-            return w
-        out = w * self.factors if self.factors is not None else w.copy()
-        if self.shifts is not None:
-            out[self.intercept_index] -= float(np.dot(self.shifts, out))
-        return out
+        return self.rows_to_original_space(np.asarray(w)[None, :])[0]
 
     def to_normalized_space(self, w_orig: np.ndarray) -> np.ndarray:
         """Inverse of `to_original_space` (reference: modelToTransformedSpace);
         used to warm-start a normalized solve from an original-space model."""
-        w_orig = np.asarray(w_orig, np.float32)
+        return self.rows_to_normalized_space(np.asarray(w_orig)[None, :])[0]
+
+    def rows_to_original_space(self, W: np.ndarray) -> np.ndarray:
+        """Vectorized to_original_space over (E, d) coefficient rows — the
+        per-entity random-effect path (one row per entity, same context)."""
+        W = np.asarray(W, np.float32)
         if self.is_identity:
-            return w_orig
-        w = w_orig.copy()
+            return W
+        out = W * self.factors[None, :] if self.factors is not None else W.copy()
         if self.shifts is not None:
-            w[self.intercept_index] += float(np.dot(self.shifts, w))
+            out[:, self.intercept_index] -= out @ self.shifts
+        return out
+
+    def rows_to_normalized_space(self, W_orig: np.ndarray) -> np.ndarray:
+        """Inverse of rows_to_original_space over (E, d) rows."""
+        W_orig = np.asarray(W_orig, np.float32)
+        if self.is_identity:
+            return W_orig
+        W = W_orig.copy()
+        if self.shifts is not None:
+            W[:, self.intercept_index] += W @ self.shifts
         if self.factors is not None:
-            w = np.where(self.factors != 0, w / self.factors, w)
-        return w.astype(np.float32)
+            W = np.where(self.factors[None, :] != 0,
+                         W / self.factors[None, :], W)
+        return W.astype(np.float32)
 
     def variances_to_original_space(self, var: np.ndarray) -> np.ndarray:
         """Diagonal variances scale by factors² (intercept covariance with the

@@ -1,0 +1,13 @@
+"""End-to-end drivers (reference: com.linkedin.photon.ml.cli.game)."""
+from photon_tpu.drivers.train import (
+    CoordinateSpec,
+    TrainingOutput,
+    TrainingParams,
+    run_training,
+)
+from photon_tpu.drivers.score import ScoringOutput, ScoringParams, run_scoring
+
+__all__ = [
+    "CoordinateSpec", "TrainingParams", "TrainingOutput", "run_training",
+    "ScoringParams", "ScoringOutput", "run_scoring",
+]

@@ -1,0 +1,320 @@
+"""GAME training driver: Avro files in → validated best model out.
+
+Reference parity: com.linkedin.photon.ml.cli.game.training.GameTrainingDriver
+(scopt CLI → feature shards → coordinate configs → GameEstimator.fit over the
+regularization grid → validation model selection → save best model to HDFS).
+Here the same pipeline is a dataclass config + `run_training()`, with a JSON
+CLI (`python -m photon_tpu.drivers.train --config job.json`).
+
+Hyperparameter search: the reference's grid mode maps to the cartesian
+product of each coordinate's `reg_weights`; its Bayesian mode
+(HyperparameterTuner) maps to `tuning_iters > 0`, which runs the GP tuner
+over log-scaled reg-weight ranges using the validation evaluator as the
+objective.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.data.feature_bags import FeatureShardConfig
+from photon_tpu.data.ingest import GameDataConfig, read_game_data
+from photon_tpu.data.model_io import save_game_model
+from photon_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+)
+from photon_tpu.data.sampling import binary_down_sample, default_down_sample
+from photon_tpu.data.validators import DataValidationType, validate_game_data
+from photon_tpu.game.dataset import GameData
+from photon_tpu.game.estimator import (
+    FixedEffectConfig,
+    GameEstimator,
+    GameFitResult,
+    RandomEffectConfig,
+)
+from photon_tpu.models.variance import VarianceComputationType
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+from photon_tpu.utils.logging import photon_logger
+from photon_tpu.utils.timing import PhaseTimers
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateSpec:
+    """JSON-friendly description of one coordinate (reference:
+    CoordinateConfiguration in the driver's config language)."""
+
+    feature_shard: str
+    entity_name: Optional[str] = None  # None → fixed effect
+    optimizer: str = "lbfgs"  # lbfgs | owlqn | tron
+    max_iters: int = 100
+    tolerance: float = 1e-7
+    reg_type: str = "none"  # none | l1 | l2 | elastic_net
+    reg_weight: float = 0.0
+    reg_weights: Optional[Sequence[float]] = None  # grid-search values
+    reg_alpha: float = 0.5  # elastic-net mixing
+    regularize_intercept: bool = True
+    active_cap: Optional[int] = None  # random-effect active-data bound
+
+    def reg_context(self) -> reg.RegularizationContext:
+        t = self.reg_type.lower()
+        if t == "none":
+            return reg.NONE
+        if t == "l1":
+            return reg.l1()
+        if t == "l2":
+            return reg.l2()
+        if t == "elastic_net":
+            return reg.elastic_net(self.reg_alpha)
+        raise ValueError(f"unknown reg_type {self.reg_type!r}")
+
+    def optimizer_config(self, reg_weight: Optional[float] = None) -> OptimizerConfig:
+        return OptimizerConfig(
+            optimizer=OptimizerType[self.optimizer.upper()],
+            max_iters=self.max_iters,
+            tolerance=self.tolerance,
+            reg=self.reg_context(),
+            reg_weight=self.reg_weight if reg_weight is None else float(reg_weight),
+            regularize_intercept=self.regularize_intercept,
+        )
+
+    def coordinate_config(self, reg_weight: Optional[float] = None):
+        opt = self.optimizer_config(reg_weight)
+        if self.entity_name is None:
+            return FixedEffectConfig(self.feature_shard, opt)
+        return RandomEffectConfig(
+            self.entity_name, self.feature_shard, opt, active_cap=self.active_cap
+        )
+
+
+@dataclasses.dataclass
+class TrainingParams:
+    """Reference: GameTrainingDriver's scopt parameter set."""
+
+    train_path: str
+    output_dir: str
+    task: str = "LOGISTIC_REGRESSION"
+    validation_path: Optional[str] = None
+    feature_shards: dict = dataclasses.field(default_factory=dict)
+    # shard name -> {"bags": [...], "has_intercept": bool}
+    coordinates: dict = dataclasses.field(default_factory=dict)
+    # coordinate name -> CoordinateSpec (or its dict form)
+    entity_fields: Sequence[str] = ()
+    update_sequence: Optional[Sequence[str]] = None
+    n_sweeps: int = 2
+    normalization: str = "none"  # applied to every shard (reference: one flag)
+    data_validation: str = "validate_full"
+    variance_type: str = "none"
+    down_sampling_rate: Optional[float] = None  # binary tasks: negatives only
+    sparse_k: Optional[int] = None
+    warm_start: bool = True
+    evaluator_entity: Optional[str] = None
+    # Bayesian reg-weight search (0 → grid over reg_weights lists instead)
+    tuning_iters: int = 0
+    tuning_range: tuple = (1e-4, 1e4)
+    seed: int = 0
+
+    def __post_init__(self):
+        self.coordinates = {
+            k: (v if isinstance(v, CoordinateSpec) else CoordinateSpec(**v))
+            for k, v in self.coordinates.items()
+        }
+        self.feature_shards = {
+            k: (v if isinstance(v, FeatureShardConfig)
+                else FeatureShardConfig(
+                    bags=tuple(v["bags"]),
+                    has_intercept=v.get("has_intercept", True),
+                    dense_threshold=v.get("dense_threshold", 1024),
+                ))
+            for k, v in self.feature_shards.items()
+        }
+
+
+@dataclasses.dataclass
+class TrainingOutput:
+    best: GameFitResult
+    results: list
+    model_dir: str
+    timings: dict
+
+
+def _apply_down_sampling(data: GameData, task: TaskType, rate: float,
+                         seed: int) -> GameData:
+    """Reference: the driver's DownSampler applied to training data."""
+    if task in (TaskType.LOGISTIC_REGRESSION,
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        idx, w = binary_down_sample(data.y, rate, data.weights, seed)
+    else:
+        idx, w = default_down_sample(data.n, rate, data.weights, seed)
+    shards = {}
+    for name, X in data.shards.items():
+        from photon_tpu.data.matrix import SparseRows
+
+        if isinstance(X, SparseRows):
+            shards[name] = SparseRows(X.indices[idx], X.values[idx],
+                                      X.n_features)
+        else:
+            shards[name] = np.asarray(X)[idx]
+    return GameData(
+        y=data.y[idx], weights=w, offsets=data.offsets[idx], shards=shards,
+        entity_ids={k: np.asarray(v)[idx] for k, v in data.entity_ids.items()},
+    )
+
+
+def _config_grid(coordinates: dict) -> Optional[list]:
+    """Cartesian product over every coordinate's reg_weights list."""
+    names = [n for n, s in coordinates.items() if s.reg_weights]
+    if not names:
+        return None
+    combos = itertools.product(*(coordinates[n].reg_weights for n in names))
+    return [
+        {n: coordinates[n].coordinate_config(wt) for n, wt in zip(names, combo)}
+        for combo in combos
+    ]
+
+
+def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
+    """The full reference pipeline: read → validate → (down-sample) → train
+    over the config grid / tuner → select best on validation → save."""
+    log = photon_logger("photon_tpu.train", params.output_dir)
+    timers = PhaseTimers()
+    task = TaskType[params.task]
+
+    with timers("read"):
+        data_cfg = GameDataConfig(
+            shards=params.feature_shards, entity_fields=tuple(params.entity_fields)
+        )
+        data, index_maps = read_game_data(
+            params.train_path, data_cfg, sparse_k=params.sparse_k)
+        validation = None
+        if params.validation_path:
+            validation, _ = read_game_data(
+                params.validation_path, data_cfg, index_maps=index_maps,
+                sparse_k=params.sparse_k)
+    log.info("read %d training rows, %d shards", data.n, len(data.shards))
+
+    with timers("validate"):
+        mode = DataValidationType(params.data_validation)
+        validate_game_data(data, task, mode)
+        if validation is not None:
+            validate_game_data(validation, task, mode)
+
+    if params.down_sampling_rate is not None:
+        with timers("down_sample"):
+            n0 = data.n
+            data = _apply_down_sampling(
+                data, task, params.down_sampling_rate, params.seed)
+            log.info("down-sampled %d -> %d rows", n0, data.n)
+
+    norm_type = NormalizationType(params.normalization)
+    normalization = {}
+    if norm_type is not NormalizationType.NONE:
+        for name, spec in params.coordinates.items():
+            shard_cfg = params.feature_shards[spec.feature_shard]
+            icpt = -1 if shard_cfg.has_intercept else None
+            if norm_type is NormalizationType.STANDARDIZATION and icpt is None:
+                raise ValueError(
+                    f"standardization requires an intercept in shard "
+                    f"{spec.feature_shard!r}"
+                )
+            normalization[name] = NormalizationContext.build(
+                data.shards[spec.feature_shard], norm_type, intercept_index=icpt)
+
+    estimator = GameEstimator(
+        task=task,
+        coordinate_configs={
+            n: s.coordinate_config() for n, s in params.coordinates.items()
+        },
+        update_sequence=(list(params.update_sequence)
+                         if params.update_sequence else None),
+        n_sweeps=params.n_sweeps,
+        mesh=mesh,
+        variance=VarianceComputationType[params.variance_type.upper()],
+        warm_start=params.warm_start,
+        evaluator_entity=params.evaluator_entity,
+        normalization=normalization,
+    )
+
+    with timers("train"):
+        if params.tuning_iters > 0:
+            results = _tune(estimator, params, data, validation, log)
+        else:
+            results = estimator.fit(
+                data, validation=validation,
+                config_grid=_config_grid(params.coordinates))
+    best = estimator.best_model(results)
+    if best.validation_score is not None:
+        log.info("best validation score: %.6f", best.validation_score)
+
+    with timers("save"):
+        model_dir = os.path.join(params.output_dir, "best_model")
+        save_game_model(
+            model_dir, best.model,
+            {n: index_maps[params.coordinates[n].feature_shard]
+             for n in best.model.names()},
+        )
+    log.info("timings: %s", timers.summary())
+    return TrainingOutput(best, results, model_dir, timers.summary())
+
+
+def _tune(estimator: GameEstimator, params: TrainingParams, data,
+          validation, log) -> list:
+    """GP search over log reg weights of every regularized coordinate
+    (reference: HyperparameterTuner driven by GameEstimator evaluations)."""
+    from photon_tpu.evaluation.evaluator import default_evaluator
+    from photon_tpu.tuning import SearchRange, SearchSpace, tune
+
+    if validation is None:
+        raise ValueError("tuning_iters > 0 requires validation_path")
+    names = [n for n, s in params.coordinates.items()
+             if s.reg_type.lower() != "none"]
+    if not names:
+        raise ValueError("tuning requires at least one regularized coordinate")
+    evaluator = estimator.evaluator or default_evaluator(estimator.task)
+    lo, hi = params.tuning_range
+    space = SearchSpace([SearchRange(lo, hi, log_scale=True)] * len(names))
+    results: list = []
+
+    def evaluate(x) -> float:
+        overrides = {
+            n: params.coordinates[n].coordinate_config(w)
+            for n, w in zip(names, x)
+        }
+        r = estimator.fit(data, validation=validation, config_grid=[overrides])[0]
+        results.append(r)
+        score = r.validation_score
+        # tuner minimizes; flip metrics where higher is better (AUC, P@K)
+        return -score if evaluator.higher_is_better else score
+
+    outcome = tune(evaluate, space, n_iters=params.tuning_iters,
+                   seed=params.seed)
+    log.info("tuner best reg weights: %s -> %.6f",
+             dict(zip(names, outcome.best_x)), outcome.best_y)
+    return results
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="photon-tpu GAME training driver")
+    p.add_argument("--config", required=True, help="JSON TrainingParams file")
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        params = TrainingParams(**json.load(f))
+    out = run_training(params)
+    print(json.dumps({
+        "model_dir": out.model_dir,
+        "validation_score": out.best.validation_score,
+        "n_models": len(out.results),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,20 @@ class RandomEffectConfig:
 CoordinateConfig = FixedEffectConfig | RandomEffectConfig
 
 
+def _last_column_is_intercept(X) -> bool:
+    """True when the design matrix's last column is constant 1 (the
+    data.feature_bags intercept-last convention)."""
+    from photon_tpu.data.matrix import SparseRows
+
+    if isinstance(X, SparseRows):
+        d = X.n_features
+        ind, val = np.asarray(X.indices), np.asarray(X.values)
+        hit = (ind == d - 1) & (val != 0.0)
+        return bool(hit.any(axis=1).all() and (val[hit] == 1.0).all())
+    col = np.asarray(X)[:, -1]
+    return bool((col == 1.0).all())
+
+
 @dataclasses.dataclass
 class GameFitResult:
     """One (configuration → model) outcome (reference: fit()'s result tuples)."""
@@ -75,6 +89,26 @@ class GameEstimator:
     locked: frozenset = frozenset()
     warm_start: bool = True
     evaluator: Optional[Evaluator] = None
+    # Per-coordinate feature normalization (reference: the driver's
+    # normalization applied per feature shard): coordinate name → either a
+    # NormalizationType (context computed from that coordinate's design
+    # matrix; intercept assumed LAST column per data.feature_bags) or a
+    # prebuilt NormalizationContext.
+    normalization: dict = dataclasses.field(default_factory=dict)
+    # Per-training-data caches of bucketed datasets and jit-compiled
+    # coordinates, persisted ACROSS fit() calls so a tuner loop that fits the
+    # same data repeatedly reuses bucketing and compiled solvers. Keyed by the
+    # GameData object's identity; the entry keeps a strong reference to the
+    # data so an id() is never reused while cached.
+    _caches: dict = dataclasses.field(default_factory=dict, init=False,
+                                      repr=False)
+
+    def _caches_for(self, data) -> tuple[dict, dict]:
+        entry = self._caches.get(id(data))
+        if entry is None or entry[0] is not data:
+            entry = (data, {}, {})
+            self._caches[id(data)] = entry
+        return entry[1], entry[2]
     # entity-id column for sharded (per-entity) validation evaluators;
     # defaults to the first random-effect coordinate's entity type.
     evaluator_entity: Optional[str] = None
@@ -105,20 +139,53 @@ class GameEstimator:
             if cache is not None and key in cache:
                 coords[name] = cache[key]
                 continue
+            norm = self._normalization_for(name, datasets[name])
             if isinstance(cfg, FixedEffectConfig):
                 coord = FixedEffectCoordinate(
                     datasets[name], self.task, cfg.optimizer,
                     mesh=self.mesh, variance=self.variance,
+                    normalization=norm,
                 )
             else:
                 coord = RandomEffectCoordinate(
                     datasets[name], self.task, cfg.optimizer,
                     mesh=self.mesh, variance=self.variance,
+                    normalization=norm,
                 )
             if cache is not None:
                 cache[key] = coord
             coords[name] = coord
         return coords
+
+    def _normalization_for(self, name: str, dataset):
+        """Resolve this coordinate's NormalizationContext (build from the
+        dataset's design matrix when a bare NormalizationType was given)."""
+        from photon_tpu.data.normalization import (
+            NormalizationContext,
+            NormalizationType,
+        )
+
+        spec = self.normalization.get(name)
+        if spec is None:
+            return None
+        if isinstance(spec, NormalizationContext):
+            return spec
+        if isinstance(spec, NormalizationType):
+            # Detect the intercept-last convention rather than assuming it:
+            # treating a real feature as the intercept would silently corrupt
+            # factor/shift handling for shards built with has_intercept=False.
+            icpt = -1 if _last_column_is_intercept(dataset.X) else None
+            if spec is NormalizationType.STANDARDIZATION and icpt is None:
+                raise ValueError(
+                    f"normalization[{name!r}]: STANDARDIZATION requires an "
+                    "intercept column (all-ones, last) in the feature shard"
+                )
+            return NormalizationContext.build(dataset.X, spec,
+                                              intercept_index=icpt)
+        raise TypeError(
+            f"normalization[{name!r}] must be a NormalizationType or "
+            f"NormalizationContext, got {type(spec)}"
+        )
 
     def fit(
         self,
@@ -140,8 +207,7 @@ class GameEstimator:
         """
         grid = config_grid or [self.coordinate_configs]
         evaluator = self.evaluator or default_evaluator(self.task)
-        dataset_cache: dict = {}
-        coord_cache: dict = {}
+        dataset_cache, coord_cache = self._caches_for(data)
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})

@@ -31,6 +31,10 @@ class FixedEffectCoordinate:
     config: OptimizerConfig
     mesh: Optional[Mesh] = None
     variance: VarianceComputationType = VarianceComputationType.NONE
+    # data.normalization.NormalizationContext for this coordinate's shard;
+    # train_glm runs the solve in normalized space and returns original-space
+    # coefficients, so score() below needs no changes.
+    normalization: Optional[object] = None
 
     def train(
         self, offsets_full, warm_start: Optional[FixedEffectModel] = None
@@ -48,6 +52,7 @@ class FixedEffectCoordinate:
             mesh=self.mesh,
             w0=w0,
             variance=self.variance,
+            normalization=self.normalization,
         )
         return FixedEffectModel(model, self.dataset.shard_name), res
 

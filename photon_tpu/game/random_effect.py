@@ -62,9 +62,14 @@ class RandomEffectCoordinate:
     config: OptimizerConfig
     mesh: Optional[Mesh] = None
     variance: VarianceComputationType = VarianceComputationType.NONE
+    # Shard-level NormalizationContext shared by every entity's solve; the
+    # vmapped objective runs in normalized space and coefficients convert
+    # back per entity row below.
+    normalization: Optional[object] = None
 
     def __post_init__(self):
-        obj = make_objective(self.task, self.config, self.dataset.dim)
+        obj = make_objective(self.task, self.config, self.dataset.dim,
+                             normalization=self.normalization)
 
         def one(batch, w0):
             res = solve(obj, batch, w0, self.config)
@@ -80,11 +85,18 @@ class RandomEffectCoordinate:
     ) -> tuple[RandomEffectModel, RETrainStats]:
         ds = self.dataset
         E, d = ds.n_entities, ds.dim
+        norm = (self.normalization
+                if self.normalization is not None
+                and not self.normalization.is_identity else None)
         coeffs = (
             np.array(warm_start.coefficients, np.float32)
             if warm_start is not None and warm_start.coefficients.shape == (E, d)
             else np.zeros((E, d), np.float32)
         )
+        if norm is not None:
+            # warm-start coefficients live in original space; the solve
+            # runs in normalized space
+            coeffs = norm.rows_to_normalized_space(coeffs)
         variances = (
             np.zeros((E, d), np.float32)
             if self.variance is not VarianceComputationType.NONE
@@ -109,6 +121,10 @@ class RandomEffectCoordinate:
             n_conv += int(np.asarray(res.converged)[:e_real].sum())
             n_fail += int(np.asarray(res.failed)[:e_real].sum())
             total_iters += int(np.asarray(res.iterations)[:e_real].sum())
+        if norm is not None:
+            coeffs = norm.rows_to_original_space(coeffs)
+            if variances is not None:
+                variances = norm.variances_to_original_space(variances)
         model = RandomEffectModel(
             entity_name=ds.entity_name,
             feature_shard=ds.shard_name,

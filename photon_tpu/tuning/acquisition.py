@@ -1,0 +1,28 @@
+"""Acquisition functions over a fitted GP.
+
+Reference parity: com.linkedin.photon.ml.hyperparameter.criteria.
+{ExpectedImprovement, ConfidenceBound}. Minimization convention throughout
+(the reference minimizes the evaluation function; AUC-like metrics are
+negated by the tuner before they get here).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.stats as jstats
+
+from photon_tpu.tuning.gp import GaussianProcess
+
+
+def expected_improvement(gp: GaussianProcess, Xq, best_y: float) -> jnp.ndarray:
+    """EI(x) = E[max(best_y − f(x), 0)] (reference: ExpectedImprovement)."""
+    mean, std = gp.predict(Xq)
+    std = jnp.maximum(std, 1e-12)
+    z = (best_y - mean) / std
+    return std * (z * jstats.norm.cdf(z) + jstats.norm.pdf(z))
+
+
+def lower_confidence_bound(gp: GaussianProcess, Xq, beta: float = 2.0) -> jnp.ndarray:
+    """LCB(x) = μ(x) − β·σ(x); SMALLER is better (reference: ConfidenceBound).
+    Returned negated so that, like EI, the best candidate MAXIMIZES it."""
+    mean, std = gp.predict(Xq)
+    return -(mean - beta * std)

@@ -1,0 +1,150 @@
+"""Gaussian-process surrogate for Bayesian hyperparameter search.
+
+Reference parity: com.linkedin.photon.ml.hyperparameter.estimators.
+{GaussianProcessEstimator, GaussianProcessModel} and kernels.{RBF, Matern52,
+StationaryKernel}. The reference fits a GP to (hyperparameter → validation
+metric) observations, sampling kernel hyperparameters; here kernel
+hyperparameters (log amplitude, log lengthscales, log noise) are fitted by
+maximizing the exact log marginal likelihood with the in-house L-BFGS — the
+whole fit is one jit'd program over (n, n) matrices (n = observations,
+tiny: ≤ hundreds).
+
+All inputs are assumed pre-scaled to [0, 1]^d (search.py handles ranges and
+log-scaling), matching the reference's normalized search space.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.optim.lbfgs import minimize_lbfgs
+
+JITTER = 1e-6
+# f32 Cholesky of a near-noiseless kernel Gram goes unstable; floor the
+# fitted noise at NOISE_FLOOR × amplitude (y is standardized, so this is a
+# ~1% noise floor — still effectively interpolating).
+NOISE_FLOOR = 1e-4
+
+
+def _sqdist(X1, X2, inv_lengthscales):
+    a = X1 * inv_lengthscales
+    b = X2 * inv_lengthscales
+    return jnp.maximum(
+        jnp.sum(a * a, -1)[:, None]
+        - 2.0 * a @ b.T
+        + jnp.sum(b * b, -1)[None, :],
+        0.0,
+    )
+
+
+def rbf_kernel(X1, X2, amplitude, inv_lengthscales):
+    """Reference: kernels.RBF."""
+    return amplitude * jnp.exp(-0.5 * _sqdist(X1, X2, inv_lengthscales))
+
+
+def matern52_kernel(X1, X2, amplitude, inv_lengthscales):
+    """Reference: kernels.Matern52."""
+    r = jnp.sqrt(_sqdist(X1, X2, inv_lengthscales) + 1e-12)
+    s = jnp.sqrt(5.0) * r
+    return amplitude * (1.0 + s + s * s / 3.0) * jnp.exp(-s)
+
+
+KERNELS: dict[str, Callable] = {"rbf": rbf_kernel, "matern52": matern52_kernel}
+
+
+@dataclasses.dataclass(frozen=True)
+class GaussianProcess:
+    """Fitted GP posterior (reference: GaussianProcessModel)."""
+
+    X: jnp.ndarray  # (n, d) observed points
+    y_mean: float
+    y_std: float
+    alpha: jnp.ndarray  # K⁻¹ y_centered
+    L: jnp.ndarray  # chol(K + σ²I)
+    amplitude: float
+    inv_lengthscales: jnp.ndarray
+    noise: float
+    kernel_name: str = "matern52"
+
+    def predict(self, Xq) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Posterior mean and stddev at query points (n_q, d)."""
+        kern = KERNELS[self.kernel_name]
+        Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
+                  self.amplitude, self.inv_lengthscales)
+        mean = Kq @ self.alpha
+        v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
+        var = jnp.maximum(
+            self.amplitude + self.noise - jnp.sum(v * v, axis=0), JITTER
+        )
+        return (mean * self.y_std + self.y_mean,
+                jnp.sqrt(var) * self.y_std)
+
+
+def _nll_builder(X, y, kernel_name):
+    kern = KERNELS[kernel_name]
+    n, d = X.shape
+
+    def nll_vg(theta):
+        def nll(theta):
+            amp = jnp.exp(theta[0])
+            inv_ls = jnp.exp(-theta[1:1 + d])
+            noise = jnp.exp(theta[-1]) + NOISE_FLOOR * amp
+            K = kern(X, X, amp, inv_ls) + noise * jnp.eye(n)
+            L = jnp.linalg.cholesky(K)
+            a = jax.scipy.linalg.cho_solve((L, True), y)
+            return (0.5 * y @ a
+                    + jnp.sum(jnp.log(jnp.diagonal(L)))
+                    + 0.5 * n * jnp.log(2.0 * jnp.pi))
+
+        return jax.value_and_grad(nll)(theta)
+
+    return nll_vg
+
+
+def fit_gp(
+    X,
+    y,
+    kernel: str = "matern52",
+    max_iters: int = 60,
+) -> GaussianProcess:
+    """Fit kernel hyperparameters by exact marginal-likelihood maximization
+    (reference samples them; direct optimization is cheaper and determin-
+    istic). Observations are standardized internally."""
+    X = jnp.asarray(np.asarray(X, np.float32))
+    y_raw = np.asarray(y, np.float32)
+    y_mean = float(y_raw.mean())
+    y_std = float(y_raw.std()) or 1.0
+    y = jnp.asarray((y_raw - y_mean) / y_std)
+    n, d = X.shape
+
+    theta0 = jnp.zeros((d + 2,), jnp.float32)  # log amp, log ls_i, log noise
+    theta0 = theta0.at[-1].set(-4.0)
+    res = minimize_lbfgs(_nll_builder(X, y, kernel), theta0,
+                         max_iters=max_iters, tolerance=1e-9)
+    theta = res.w
+    if not bool(jnp.isfinite(theta).all()):
+        theta = theta0  # hyperparameter fit diverged; prior defaults
+
+    kern = KERNELS[kernel]
+
+    def _posterior(theta):
+        amp = float(jnp.exp(theta[0]))
+        inv_ls = jnp.exp(-theta[1:1 + d])
+        noise = float(jnp.exp(theta[-1])) + NOISE_FLOOR * amp
+        K = kern(X, X, amp, inv_ls) + noise * jnp.eye(n)
+        L = jnp.linalg.cholesky(K)
+        alpha = jax.scipy.linalg.cho_solve((L, True), y)
+        return amp, inv_ls, noise, L, alpha
+
+    amp, inv_ls, noise, L, alpha = _posterior(theta)
+    if not bool(jnp.isfinite(alpha).all()):
+        amp, inv_ls, noise, L, alpha = _posterior(theta0)
+    return GaussianProcess(
+        X=X, y_mean=y_mean, y_std=y_std, alpha=alpha, L=L,
+        amplitude=amp, inv_lengthscales=inv_ls, noise=noise,
+        kernel_name=kernel,
+    )

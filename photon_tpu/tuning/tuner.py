@@ -1,0 +1,92 @@
+"""Bayesian hyperparameter tuner loop.
+
+Reference parity: com.linkedin.photon.ml.HyperparameterTuner /
+hyperparameter.search.{GaussianProcessSearch, RandomSearch} and the
+EvaluationFunction protocol: evaluate(candidate) → metric, minimized. The
+GAME driver plugs in "train a model with these reg weights, return
+validation loss / negated AUC".
+
+Loop: seed with Sobol points → fit GP on all observations → draw a fresh
+candidate pool → evaluate the EI-argmax → repeat.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.tuning.acquisition import expected_improvement
+from photon_tpu.tuning.gp import fit_gp
+from photon_tpu.tuning.search import SearchSpace, candidates
+
+
+@dataclasses.dataclass
+class TuningResult:
+    best_x: np.ndarray  # original-space hyperparameters
+    best_y: float
+    xs: np.ndarray  # (n, d) all evaluated points, original space
+    ys: np.ndarray  # (n,)
+
+    def history(self) -> np.ndarray:
+        """Running best metric after each evaluation."""
+        return np.minimum.accumulate(self.ys)
+
+
+def tune(
+    evaluate: Callable[[np.ndarray], float],
+    space: SearchSpace,
+    n_iters: int = 20,
+    n_seed: int = 5,
+    n_candidates: int = 512,
+    method: str = "gp",
+    kernel: str = "matern52",
+    seed: int = 0,
+    initial_observations: Optional[Sequence[tuple]] = None,
+) -> TuningResult:
+    """Minimize `evaluate` over `space` (reference: HyperparameterTuner.tune).
+
+    method: "gp" (Bayesian, the reference's GaussianProcessSearch),
+    "random" or "sobol" (the reference's RandomSearch fallback).
+    initial_observations: optional [(x_original, y)] to warm-start the GP
+    (the reference seeds from prior runs' observations).
+    """
+    if n_iters < 1:
+        raise ValueError("n_iters must be >= 1")
+    xs_unit: list = []
+    ys: list = []
+    for x0, y0 in initial_observations or ():
+        xs_unit.append(space.to_unit(np.asarray(x0, np.float64)))
+        ys.append(float(y0))
+
+    if method in ("random", "sobol"):
+        pool = candidates(space, n_iters, "sobol" if method == "sobol" else "random",
+                          seed=seed)
+        for u in pool:
+            xs_unit.append(u)
+            ys.append(float(evaluate(space.from_unit(u))))
+    elif method == "gp":
+        n_seed = min(max(n_seed, 2), n_iters)
+        for u in candidates(space, n_seed, "sobol", seed=seed):
+            xs_unit.append(u)
+            ys.append(float(evaluate(space.from_unit(u))))
+        for it in range(n_iters - n_seed):
+            gp = fit_gp(np.asarray(xs_unit, np.float32), np.asarray(ys), kernel)
+            pool = candidates(space, n_candidates, "sobol", seed=seed + 1000 + it)
+            ei = np.asarray(expected_improvement(
+                gp, pool.astype(np.float32), float(np.min(ys))))
+            u = pool[int(np.argmax(ei))]
+            xs_unit.append(u)
+            ys.append(float(evaluate(space.from_unit(u))))
+    else:
+        raise ValueError(f"unknown tuning method {method!r}")
+
+    xs_unit_arr = np.asarray(xs_unit)
+    ys_arr = np.asarray(ys)
+    best = int(np.argmin(ys_arr))
+    return TuningResult(
+        best_x=space.from_unit(xs_unit_arr[best]),
+        best_y=float(ys_arr[best]),
+        xs=space.from_unit(xs_unit_arr),
+        ys=ys_arr,
+    )

@@ -1,0 +1,5 @@
+"""Utility helpers (reference: com.linkedin.photon.ml.util)."""
+from photon_tpu.utils.logging import photon_logger
+from photon_tpu.utils.timing import PhaseTimers, Timer
+
+__all__ = ["photon_logger", "PhaseTimers", "Timer"]

@@ -1,0 +1,48 @@
+"""Logging setup.
+
+Reference parity: com.linkedin.photon.ml.util.PhotonLogger — a logger that
+writes both to the console and to a per-run log file under the output
+directory, with the driver's standard format.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def photon_logger(
+    name: str = "photon_tpu",
+    output_dir: Optional[str] = None,
+    level: int = logging.INFO,
+) -> logging.Logger:
+    """Console logger, plus a file handler at <output_dir>/<name>.log when an
+    output dir is given (reference: PhotonLogger writes to HDFS logs dir)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.propagate = False  # avoid duplicates via a configured root logger
+    fmt = logging.Formatter(_FORMAT)
+    have_stream = any(
+        isinstance(h, logging.StreamHandler)
+        and not isinstance(h, logging.FileHandler)
+        for h in logger.handlers
+    )
+    if not have_stream:
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+    if output_dir is not None:
+        os.makedirs(output_dir, exist_ok=True)
+        path = os.path.join(output_dir, f"{name}.log")
+        if not any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == os.path.abspath(path)
+            for h in logger.handlers
+        ):
+            fh = logging.FileHandler(path)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    return logger

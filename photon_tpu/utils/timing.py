@@ -1,0 +1,56 @@
+"""Timing utilities.
+
+Reference parity: com.linkedin.photon.ml.util.Timer — a start/stop timer the
+drivers wrap around each training phase, plus a `Timed` context manager and a
+per-phase accumulator for the driver's end-of-run summary.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class Timer:
+    """Reference: util.Timer (start/stop/durationSeconds)."""
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self._elapsed: float = 0.0
+
+    def start(self) -> "Timer":
+        if self._t0 is not None:
+            raise RuntimeError("timer already running")
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        if self._t0 is None:
+            raise RuntimeError("timer not running")
+        self._elapsed += time.perf_counter() - self._t0
+        self._t0 = None
+        return self._elapsed
+
+    @property
+    def seconds(self) -> float:
+        if self._t0 is not None:
+            return self._elapsed + (time.perf_counter() - self._t0)
+        return self._elapsed
+
+    def __enter__(self) -> "Timer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class PhaseTimers:
+    """Named phase accumulator (the driver's 'timed { ... }' blocks)."""
+
+    def __init__(self):
+        self.timers: dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        return self.timers.setdefault(name, Timer())
+
+    def summary(self) -> dict[str, float]:
+        return {k: t.seconds for k, t in self.timers.items()}

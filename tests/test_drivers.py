@@ -1,0 +1,194 @@
+"""Driver end-to-end tests: Avro files → trained model dir → scored output
+(SURVEY.md §4 'driver end-to-end from Avro files to scored output')."""
+import json
+
+import numpy as np
+import pytest
+
+from photon_tpu.data.avro_io import read_avro, write_avro
+from photon_tpu.data.ingest import training_example_schema
+from photon_tpu.drivers import (
+    CoordinateSpec,
+    ScoringParams,
+    TrainingParams,
+    run_scoring,
+    run_training,
+)
+from photon_tpu.utils.timing import PhaseTimers, Timer
+
+
+def _write_game_avro(path, n, seed=0, n_users=8):
+    rng = np.random.default_rng(seed)
+    user = rng.integers(0, n_users, n)
+    age = rng.normal(0, 1, n)
+    ctr = rng.normal(0, 1, n)
+    u_eff = np.linspace(-1.5, 1.5, n_users)[np.argsort(rng.uniform(size=n_users))]
+    margin = 1.2 * age - 0.8 * ctr + u_eff[user]
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
+    schema = training_example_schema(
+        feature_bags=("global", "puser"), entity_fields=("userId",))
+    records = [{
+        "response": float(y[i]),
+        "offset": None, "weight": None, "uid": f"row{i}",
+        "userId": f"u{user[i]}",
+        "global": [
+            {"name": "age", "term": "", "value": float(age[i])},
+            {"name": "ctr", "term": "", "value": float(ctr[i])},
+        ],
+        "puser": [{"name": "bias", "term": "", "value": 1.0}],
+    } for i in range(n)]
+    write_avro(path, records, schema)
+    return y
+
+
+FEATURE_SHARDS = {
+    "fixedShard": {"bags": ["global"], "has_intercept": True},
+    "userShard": {"bags": ["puser"], "has_intercept": False},
+}
+COORDINATES = {
+    "fixed": {"feature_shard": "fixedShard", "reg_type": "l2",
+              "reg_weight": 0.5, "max_iters": 40},
+    "perUser": {"feature_shard": "userShard", "entity_name": "userId",
+                "reg_type": "l2", "reg_weight": 2.0, "max_iters": 20},
+}
+
+
+@pytest.fixture(scope="module")
+def job_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("game_job")
+    y_train = _write_game_avro(root / "train.avro", 600, seed=1)
+    y_val = _write_game_avro(root / "validation.avro", 300, seed=2)
+    return root, y_train, y_val
+
+
+class TestTrainingDriver:
+    def test_end_to_end_with_grid(self, job_dirs):
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(root / "out"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates={
+                **COORDINATES,
+                "fixed": {**COORDINATES["fixed"], "reg_weights": [0.1, 10.0]},
+            },
+            entity_fields=["userId"],
+            n_sweeps=2,
+        )
+        out = run_training(params)
+        assert len(out.results) == 2  # one model per grid point
+        assert out.best.validation_score is not None
+        assert out.best.validation_score > 0.7  # AUC on planted signal
+        # model dir is loadable and complete
+        from photon_tpu.data.model_io import load_game_model
+
+        model, imaps = load_game_model(out.model_dir)
+        assert set(model.names()) == {"fixed", "perUser"}
+        assert "read" in out.timings and "train" in out.timings
+
+    def test_scoring_driver_round_trip(self, job_dirs):
+        root, _, y_val = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(root / "out2"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+        )
+        tr = run_training(params)
+        sc = run_scoring(ScoringParams(
+            model_dir=tr.model_dir,
+            data_path=str(root / "validation.avro"),
+            output_dir=str(root / "scored"),
+            feature_shards=FEATURE_SHARDS,
+            entity_fields=["userId"],
+        ))
+        assert sc.metric == pytest.approx(tr.best.validation_score, abs=1e-6)
+        written = read_avro(sc.output_path)
+        assert len(written) == 300
+        assert written[0]["uid"] == "row0"
+        probs = np.asarray([r["predictionScore"] for r in written])
+        assert ((probs > 0) & (probs < 1)).all()  # sigmoid applied
+        np.testing.assert_allclose(
+            [r["label"] for r in written], y_val, atol=1e-6)
+
+    def test_normalization_and_downsampling_modes(self, job_dirs, tmp_path):
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(tmp_path / "out_norm"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+            normalization="scale_with_standard_deviation",
+            down_sampling_rate=0.5,
+        )
+        out = run_training(params)
+        assert out.best.validation_score > 0.65
+
+    def test_cli_json_config(self, job_dirs, tmp_path, capsys):
+        root, *_ = job_dirs
+        cfg = {
+            "train_path": str(root / "train.avro"),
+            "validation_path": str(root / "validation.avro"),
+            "output_dir": str(tmp_path / "cli_out"),
+            "feature_shards": FEATURE_SHARDS,
+            "coordinates": COORDINATES,
+            "entity_fields": ["userId"],
+            "n_sweeps": 1,
+        }
+        cfg_path = tmp_path / "job.json"
+        cfg_path.write_text(json.dumps(cfg))
+        from photon_tpu.drivers.train import main
+
+        main(["--config", str(cfg_path)])
+        printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert printed["n_models"] == 1
+        assert printed["validation_score"] > 0.65
+
+    def test_gp_tuning_mode(self, job_dirs, tmp_path):
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(tmp_path / "out_tune"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+            tuning_iters=4,
+            tuning_range=(1e-3, 1e3),
+        )
+        out = run_training(params)
+        assert len(out.results) == 4  # one fit per tuner evaluation
+        assert out.best.validation_score == pytest.approx(
+            max(r.validation_score for r in out.results))
+
+
+class TestTimers:
+    def test_timer_accumulates(self):
+        t = Timer()
+        with t:
+            pass
+        first = t.seconds
+        with t:
+            pass
+        assert t.seconds >= first
+        with pytest.raises(RuntimeError):
+            t.stop()
+
+    def test_phase_timers(self):
+        timers = PhaseTimers()
+        with timers("a"):
+            pass
+        with timers("a"):
+            pass
+        with timers("b"):
+            pass
+        s = timers.summary()
+        assert set(s) == {"a", "b"} and s["a"] >= 0

@@ -391,3 +391,36 @@ def test_unseen_longer_entity_id_maps_to_zero_row():
         key_to_index={"1": 0, "2": 1},
     )
     np.testing.assert_array_equal(m2.dense_ids(np.asarray([2, 7, 1])), [1, 2, 0])
+
+
+def test_estimator_normalization_detects_intercept():
+    """Estimator-level normalization must not treat a real feature column as
+    the intercept on shards built without one."""
+    from photon_tpu.data.normalization import NormalizationType
+    from photon_tpu.game.estimator import _last_column_is_intercept
+
+    rng = np.random.default_rng(0)
+    X_no = rng.normal(size=(50, 3)).astype(np.float32)  # no intercept
+    X_yes = X_no.copy(); X_yes[:, -1] = 1.0
+    assert not _last_column_is_intercept(X_no)
+    assert _last_column_is_intercept(jnp.asarray(X_yes))
+
+    y = (rng.uniform(size=50) < 0.5).astype(np.float32)
+    data = GameData.build(y, shards={"s": X_no}, entity_ids={})
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": FixedEffectConfig("s", OptimizerConfig(max_iters=5))},
+        n_sweeps=1,
+        normalization={"fixed": NormalizationType.STANDARDIZATION},
+    )
+    with pytest.raises(ValueError, match="intercept"):
+        est.fit(data)
+    # scale-only mode works without an intercept, and normalizes EVERY column
+    est2 = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": FixedEffectConfig("s", OptimizerConfig(max_iters=20))},
+        n_sweeps=1,
+        normalization={"fixed": NormalizationType.SCALE_WITH_STANDARD_DEVIATION},
+    )
+    r = est2.fit(data)[0]
+    assert np.isfinite(np.asarray(r.model["fixed"].model.weights)).all()

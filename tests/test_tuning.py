@@ -1,0 +1,130 @@
+"""Hyperparameter-tuning tests (SURVEY.md §4 'GP tuner improves over random
+on a synthetic bowl')."""
+import numpy as np
+import pytest
+
+from photon_tpu.tuning import (
+    SearchRange,
+    SearchSpace,
+    candidates,
+    expected_improvement,
+    fit_gp,
+    tune,
+)
+
+
+class TestSearchSpace:
+    def test_linear_and_log_mapping(self):
+        space = SearchSpace([
+            SearchRange(0.0, 10.0),
+            SearchRange(1e-4, 1e2, log_scale=True),
+        ])
+        U = np.array([[0.0, 0.0], [1.0, 1.0], [0.5, 0.5]])
+        X = space.from_unit(U)
+        np.testing.assert_allclose(X[0], [0.0, 1e-4], rtol=1e-6)
+        np.testing.assert_allclose(X[1], [10.0, 1e2], rtol=1e-6)
+        np.testing.assert_allclose(X[2, 1], 1e-1, rtol=1e-6)  # log midpoint
+        np.testing.assert_allclose(space.to_unit(X), U, atol=1e-9)
+
+    def test_invalid_ranges(self):
+        with pytest.raises(ValueError):
+            SearchRange(1.0, 1.0)
+        with pytest.raises(ValueError):
+            SearchRange(0.0, 1.0, log_scale=True)
+
+    def test_candidate_methods(self):
+        space = SearchSpace([SearchRange(0, 1), SearchRange(0, 1)])
+        for method in ("sobol", "random"):
+            C = candidates(space, 64, method, seed=3)
+            assert C.shape == (64, 2)
+            assert (C >= 0).all() and (C <= 1).all()
+        G = candidates(space, 0, "grid", points_per_dim=4)
+        assert G.shape == (16, 2)
+
+    def test_sobol_better_spread_than_random(self):
+        """Sobol's low-discrepancy property: max nearest-neighbor gap is
+        smaller than iid uniform's on the same budget."""
+        space = SearchSpace([SearchRange(0, 1)] * 2)
+        S = candidates(space, 128, "sobol", seed=0)
+        R = candidates(space, 128, "random", seed=0)
+
+        def max_nn_gap(P):
+            d = np.linalg.norm(P[:, None] - P[None, :], axis=-1)
+            np.fill_diagonal(d, np.inf)
+            return d.min(1).max()
+
+        assert max_nn_gap(S) < max_nn_gap(R)
+
+
+class TestGP:
+    def test_posterior_interpolates_noiseless_data(self, rng):
+        X = rng.uniform(size=(30, 2)).astype(np.float32)
+        y = np.sin(3 * X[:, 0]) + X[:, 1] ** 2
+        gp = fit_gp(X, y)
+        mean, std = gp.predict(X)
+        assert float(np.abs(np.asarray(mean) - y).max()) < 0.05
+        # predictive uncertainty grows away from the data
+        far = np.full((1, 2), 5.0, np.float32)
+        _, std_far = gp.predict(far)
+        assert float(std_far[0]) > float(np.asarray(std).mean()) * 2
+
+    @pytest.mark.parametrize("kernel", ["rbf", "matern52"])
+    def test_kernels_predict_held_out(self, rng, kernel):
+        X = rng.uniform(size=(60, 1)).astype(np.float32)
+        y = np.sin(6 * X[:, 0])
+        gp = fit_gp(X, y, kernel=kernel)
+        Xq = np.linspace(0.05, 0.95, 17, dtype=np.float32)[:, None]
+        mean, _ = gp.predict(Xq)
+        np.testing.assert_allclose(
+            np.asarray(mean), np.sin(6 * Xq[:, 0]), atol=0.1)
+
+    def test_expected_improvement_prefers_promising_region(self, rng):
+        X = np.array([[0.1], [0.5], [0.9]], np.float32)
+        y = np.array([1.0, 0.2, 1.0], np.float32)  # minimum near 0.5
+        gp = fit_gp(X, y)
+        Xq = np.linspace(0, 1, 101, dtype=np.float32)[:, None]
+        ei = np.asarray(expected_improvement(gp, Xq, float(y.min())))
+        assert (ei >= -1e-9).all()
+        assert 0.25 < Xq[int(np.argmax(ei)), 0] < 0.75
+
+
+class TestTuner:
+    @staticmethod
+    def _bowl(x):
+        """Minimum 0.0 at (0.3, 1.0-in-log-space)."""
+        return float((x[0] - 0.3) ** 2 + (np.log10(x[1]) - 0.0) ** 2)
+
+    def _space(self):
+        return SearchSpace([
+            SearchRange(0.0, 1.0),
+            SearchRange(1e-3, 1e3, log_scale=True),
+        ])
+
+    def test_gp_beats_random_on_bowl(self):
+        budget = 18
+        space = self._space()
+        gp_best = [
+            tune(self._bowl, space, n_iters=budget, method="gp", seed=s).best_y
+            for s in range(3)
+        ]
+        rnd_best = [
+            tune(self._bowl, space, n_iters=budget, method="random", seed=s).best_y
+            for s in range(3)
+        ]
+        assert np.mean(gp_best) < np.mean(rnd_best)
+        assert np.mean(gp_best) < 0.05  # actually found the basin
+
+    def test_history_monotone_and_shapes(self):
+        space = self._space()
+        r = tune(self._bowl, space, n_iters=8, method="sobol", seed=1)
+        assert r.xs.shape == (8, 2) and r.ys.shape == (8,)
+        h = r.history()
+        assert (np.diff(h) <= 1e-12).all()
+        assert r.best_y == pytest.approx(h[-1])
+
+    def test_warm_start_observations(self):
+        space = self._space()
+        # seed the GP with the near-optimum; it must not get worse
+        r = tune(self._bowl, space, n_iters=6, method="gp",
+                 initial_observations=[(np.array([0.3, 1.0]), 0.0)])
+        assert r.best_y <= 1e-9
