@@ -120,6 +120,14 @@ class TrainingParams:
     tuning_iters: int = 0
     tuning_range: tuple = (1e-4, 1e4)
     seed: int = 0
+    # Incremental training (reference: --initial-model + PriorDistribution):
+    # warm-start every coordinate from the saved model; coordinates listed in
+    # incremental_coordinates also use it as an informative prior.
+    initial_model_dir: Optional[str] = None
+    incremental_coordinates: Sequence[str] = ()
+    # Partial retraining (reference: partialRetrainLockedCoordinates): listed
+    # coordinates keep the initial model and only contribute scores.
+    locked_coordinates: Sequence[str] = ()
 
     def __post_init__(self):
         self.coordinates = {
@@ -227,6 +235,16 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             normalization[name] = NormalizationContext.build(
                 data.shards[spec.feature_shard], norm_type, intercept_index=icpt)
 
+    initial_models = None
+    if params.initial_model_dir:
+        from photon_tpu.data.model_io import load_game_model
+
+        with timers("load_initial_model"):
+            initial_game, _ = load_game_model(params.initial_model_dir)
+            initial_models = dict(initial_game.coordinates)
+        log.info("loaded initial model with coordinates %s",
+                 list(initial_models))
+
     estimator = GameEstimator(
         task=task,
         coordinate_configs={
@@ -237,6 +255,8 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         n_sweeps=params.n_sweeps,
         mesh=mesh,
         variance=VarianceComputationType[params.variance_type.upper()],
+        locked=frozenset(params.locked_coordinates),
+        incremental=frozenset(params.incremental_coordinates),
         warm_start=params.warm_start,
         evaluator_entity=params.evaluator_entity,
         normalization=normalization,
@@ -244,11 +264,13 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
     with timers("train"):
         if params.tuning_iters > 0:
-            results = _tune(estimator, params, data, validation, log)
+            results = _tune(estimator, params, data, validation, log,
+                            initial_models)
         else:
             results = estimator.fit(
                 data, validation=validation,
-                config_grid=_config_grid(params.coordinates))
+                config_grid=_config_grid(params.coordinates),
+                initial_models=initial_models)
     best = estimator.best_model(results)
     if best.validation_score is not None:
         log.info("best validation score: %.6f", best.validation_score)
@@ -265,7 +287,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
 
 def _tune(estimator: GameEstimator, params: TrainingParams, data,
-          validation, log) -> list:
+          validation, log, initial_models=None) -> list:
     """GP search over log reg weights of every regularized coordinate
     (reference: HyperparameterTuner driven by GameEstimator evaluations)."""
     from photon_tpu.evaluation.evaluator import default_evaluator
@@ -287,7 +309,8 @@ def _tune(estimator: GameEstimator, params: TrainingParams, data,
             n: params.coordinates[n].coordinate_config(w)
             for n, w in zip(names, x)
         }
-        r = estimator.fit(data, validation=validation, config_grid=[overrides])[0]
+        r = estimator.fit(data, validation=validation, config_grid=[overrides],
+                          initial_models=initial_models)[0]
         results.append(r)
         score = r.validation_score
         # tuner minimizes; flip metrics where higher is better (AUC, P@K)

@@ -49,6 +49,8 @@ def coordinate_descent(
     n_sweeps: int = 1,
     locked: frozenset = frozenset(),
     initial_models: Optional[dict] = None,
+    incremental: frozenset = frozenset(),
+    priors: Optional[dict] = None,
 ) -> CoordinateDescentResult:
     """Run `n_sweeps` passes of the update sequence and return the GameModel.
 
@@ -56,9 +58,19 @@ def coordinate_descent(
     `locked` coordinates must appear in `initial_models`; they are scored but
     never retrained. Unlocked coordinates warm-start from `initial_models`
     when given (the estimator's warm start across regularization weights).
+    `incremental` coordinates additionally use their initial model as an
+    informative Gaussian prior for every retrain (reference: incremental
+    training via PriorDistribution) — the prior stays the ORIGINAL initial
+    model across sweeps, not the previous sweep's update.
     """
     update_sequence = update_sequence or list(coordinates)
     models = dict(initial_models or {})
+    if priors is None:
+        priors = {name: models[name] for name in incremental if name in models}
+    for name in incremental:
+        if name not in priors:
+            raise ValueError(
+                f"incremental coordinate {name!r} needs an initial model")
     for name in locked:
         if name not in models:
             raise ValueError(f"locked coordinate {name!r} needs an initial model")
@@ -90,7 +102,9 @@ def coordinate_descent(
             others = sum(
                 (s for o, s in scores.items() if o != name), start=zero
             )
-            model, stats = coord.train(base + others, warm_start=models.get(name))
+            model, stats = coord.train(base + others,
+                                       warm_start=models.get(name),
+                                       prior=priors.get(name))
             models[name] = model
             scores[name] = coord.score(model)
             coordinate_stats[name].append(stats)

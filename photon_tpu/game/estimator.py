@@ -87,6 +87,9 @@ class GameEstimator:
     mesh: Optional[Mesh] = None
     variance: VarianceComputationType = VarianceComputationType.NONE
     locked: frozenset = frozenset()
+    # Coordinates whose initial model becomes an informative prior
+    # (incremental training); must be present in fit()'s initial_models.
+    incremental: frozenset = frozenset()
     warm_start: bool = True
     evaluator: Optional[Evaluator] = None
     # Per-coordinate feature normalization (reference: the driver's
@@ -211,6 +214,14 @@ class GameEstimator:
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})
+        # Incremental priors come from the USER's initial models and stay
+        # fixed across the whole grid (warm starts move, priors don't).
+        user_priors = {n: prev_models[n] for n in self.incremental
+                       if n in prev_models}
+        missing = self.incremental - set(user_priors)
+        if missing:
+            raise ValueError(
+                f"incremental coordinates {sorted(missing)} need initial_models")
         for overrides in grid:
             configs = {**self.coordinate_configs, **overrides}
             datasets = {}
@@ -230,6 +241,8 @@ class GameEstimator:
                 n_sweeps=self.n_sweeps,
                 locked=self.locked,
                 initial_models=prev_models,
+                incremental=self.incremental,
+                priors=user_priors,
             )
             result = GameFitResult(descent.model, descent, configs)
             if validation is not None:

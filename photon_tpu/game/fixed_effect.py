@@ -37,14 +37,29 @@ class FixedEffectCoordinate:
     normalization: Optional[object] = None
 
     def train(
-        self, offsets_full, warm_start: Optional[FixedEffectModel] = None
+        self,
+        offsets_full,
+        warm_start: Optional[FixedEffectModel] = None,
+        prior: Optional[FixedEffectModel] = None,
     ) -> tuple[FixedEffectModel, OptResult]:
         """Solve with the other coordinates' scores as offsets
-        (reference: FixedEffectCoordinate.trainModel on updated offsets)."""
+        (reference: FixedEffectCoordinate.trainModel on updated offsets).
+
+        ``prior``: a previous run's model whose coefficients/variances become
+        an informative Gaussian prior (incremental training; reference:
+        PriorDistribution built from the initial model)."""
         w0 = None
         if (warm_start is not None
                 and warm_start.model.weights.shape[0] == self.dataset.dim):
             w0 = warm_start.model.weights
+        prior_dist = None
+        if (prior is not None
+                and prior.model.weights.shape[0] == self.dataset.dim):
+            from photon_tpu.optim.prior import PriorDistribution
+
+            coeffs = prior.model.coefficients
+            prior_dist = PriorDistribution.from_coefficients(
+                coeffs.means, coeffs.variances)
         model, res = train_glm(
             self.dataset.batch(offsets_full),
             self.task,
@@ -53,6 +68,7 @@ class FixedEffectCoordinate:
             w0=w0,
             variance=self.variance,
             normalization=self.normalization,
+            prior=prior_dist,
         )
         return FixedEffectModel(model, self.dataset.shard_name), res
 

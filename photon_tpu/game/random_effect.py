@@ -68,6 +68,8 @@ class RandomEffectCoordinate:
     normalization: Optional[object] = None
 
     def __post_init__(self):
+        import dataclasses as _dc
+
         obj = make_objective(self.task, self.config, self.dataset.dim,
                              normalization=self.normalization)
 
@@ -76,13 +78,31 @@ class RandomEffectCoordinate:
             var = compute_variances(obj, res.w, batch, self.variance)
             return res, var
 
+        def one_with_prior(batch, w0, pm, pp):
+            # Per-entity informative prior: the vmapped lanes each carry
+            # their own (mean, precision) — incremental training's
+            # per-entity PriorDistribution (pp == 0 ⇒ no prior for that lane,
+            # e.g. an entity unseen in the previous run).
+            obj_p = _dc.replace(obj, prior_mean=pm, prior_precision=pp)
+            res = solve(obj_p, batch, w0, self.config)
+            var = compute_variances(obj_p, res.w, batch, self.variance)
+            return res, var
+
         # One compile per bucket shape (jax.jit caches on shapes); the vmap
         # batches the entire while_loop solver across entities.
         self._solve_blocks = jax.jit(jax.vmap(one))
+        self._solve_blocks_prior = jax.jit(jax.vmap(one_with_prior))
 
     def train(
-        self, offsets_full, warm_start: Optional[RandomEffectModel] = None
+        self,
+        offsets_full,
+        warm_start: Optional[RandomEffectModel] = None,
+        prior: Optional[RandomEffectModel] = None,
     ) -> tuple[RandomEffectModel, RETrainStats]:
+        """``prior``: a previous run's RandomEffectModel — each entity seen in
+        it gets a Gaussian prior from its old coefficients/variances, aligned
+        by entity KEY (entities new to this dataset get no prior), the
+        reference's per-entity incremental-training semantics."""
         ds = self.dataset
         E, d = ds.n_entities, ds.dim
         norm = (self.normalization
@@ -97,6 +117,24 @@ class RandomEffectCoordinate:
             # warm-start coefficients live in original space; the solve
             # runs in normalized space
             coeffs = norm.rows_to_normalized_space(coeffs)
+
+        prior_means = prior_precs = None
+        if prior is not None and prior.dim == d:
+            pid = prior.dense_ids(ds.entity_keys)  # (E,) rows in the prior
+            seen = (pid < prior.n_entities).astype(np.float32)[:, None]
+            prior_means = np.asarray(prior.coeffs_for(pid), np.float32)
+            if prior.variances is not None:
+                pvar = np.concatenate(
+                    [np.asarray(prior.variances, np.float32),
+                     np.ones((1, d), np.float32)])[pid]
+                prior_precs = seen / np.maximum(pvar, 1e-12)
+            else:
+                prior_precs = seen * np.ones((E, d), np.float32)
+            if norm is not None:
+                prior_means = norm.rows_to_normalized_space(prior_means)
+                if norm.factors is not None:
+                    f = np.asarray(norm.factors)
+                    prior_precs = prior_precs * (f * f)[None, :]
         variances = (
             np.zeros((E, d), np.float32)
             if self.variance is not VarianceComputationType.NONE
@@ -107,6 +145,10 @@ class RandomEffectCoordinate:
             batch = ds.block_batch(block, offsets_full)
             w0 = jnp.asarray(coeffs[block.entity_index])
             e_real = block.n_entities
+            pm = pp = None
+            if prior_means is not None:
+                pm = jnp.asarray(prior_means[block.entity_index])
+                pp = jnp.asarray(prior_precs[block.entity_index])
             if self.mesh is not None:
                 n_dev = self.mesh.devices.size
                 e_pad = pad_to_multiple(e_real, n_dev)
@@ -114,7 +156,15 @@ class RandomEffectCoordinate:
                 w0 = _pad_axis0(w0, e_pad)
                 batch = jax.device_put(batch, data_sharding(self.mesh))
                 w0 = jax.device_put(w0, data_sharding(self.mesh))
-            res, var = self._solve_blocks(batch, w0)
+                if pm is not None:
+                    pm = jax.device_put(_pad_axis0(pm, e_pad),
+                                        data_sharding(self.mesh))
+                    pp = jax.device_put(_pad_axis0(pp, e_pad),
+                                        data_sharding(self.mesh))
+            if pm is not None:
+                res, var = self._solve_blocks_prior(batch, w0, pm, pp)
+            else:
+                res, var = self._solve_blocks(batch, w0)
             coeffs[block.entity_index] = np.asarray(res.w)[:e_real]
             if variances is not None:
                 variances[block.entity_index] = np.asarray(var)[:e_real]

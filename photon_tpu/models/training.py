@@ -46,6 +46,7 @@ def make_objective(
     prior_precision=None,
     intercept_index: Optional[int] = -1,
     normalization=None,
+    prior_full_precision=None,
 ) -> Objective:
     """Build the smooth objective for one coordinate's solve.
 
@@ -75,6 +76,8 @@ def make_objective(
         reg_mask=reg_mask,
         prior_mean=prior_mean,
         prior_precision=prior_precision,
+        prior_full_precision=(None if prior_full_precision is None
+                              else jnp.asarray(prior_full_precision, jnp.float32)),
         norm_factors=norm_factors,
         norm_shifts=norm_shifts,
     )
@@ -123,6 +126,7 @@ def train_glm(
     variance: VarianceComputationType = VarianceComputationType.NONE,
     prior_mean=None,
     prior_precision=None,
+    prior=None,
     normalization=None,
 ) -> tuple[GeneralizedLinearModel, OptResult]:
     """Full-batch distributed GLM training (DistributedOptimizationProblem.run).
@@ -135,11 +139,30 @@ def train_glm(
     returned model's coefficients/variances are converted BACK to original
     space, so scoring raw features works directly. ``w0`` and priors, when
     given, are interpreted in original space too.
+
+    ``prior``: an optim.prior.PriorDistribution (incremental training —
+    reference: PriorDistribution / initial-model priors); shorthand for the
+    prior_mean/prior_precision pair, and the only way to pass a
+    full-covariance precision.
     """
     d = (batch.X.n_features if isinstance(batch.X, SparseRows)
          else batch.X.shape[1])
     norm = normalization if (normalization is not None
                              and not normalization.is_identity) else None
+    prior_full_precision = None
+    if prior is not None:
+        if prior_mean is not None or prior_precision is not None:
+            raise ValueError("pass prior OR prior_mean/prior_precision")
+        prior_mean = jnp.asarray(prior.mean, jnp.float32)
+        if prior.precision_diag is not None:
+            prior_precision = jnp.asarray(prior.precision_diag, jnp.float32)
+        prior_full_precision = prior.precision_full
+        if prior_full_precision is not None and norm is not None:
+            raise ValueError(
+                "full-covariance priors are not supported together with "
+                "normalization (no exact diagonal-space transform exists); "
+                "pre-transform the precision or use a diagonal prior"
+            )
     if w0 is None:
         w0 = jnp.zeros((d,), jnp.float32)
     elif norm is not None:
@@ -156,7 +179,8 @@ def train_glm(
             np.asarray(prior_precision, np.float32) * f * f)
     obj = make_objective(task, config, d,
                          prior_mean=prior_mean, prior_precision=prior_precision,
-                         normalization=norm)
+                         normalization=norm,
+                         prior_full_precision=prior_full_precision)
 
     if mesh is not None:
         n_dev = mesh.devices.size

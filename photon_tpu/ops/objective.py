@@ -59,6 +59,10 @@ class Objective:
     reg_mask: Optional[jax.Array] = None
     prior_mean: Optional[jax.Array] = None
     prior_precision: Optional[jax.Array] = None
+    # Dense (d, d) prior precision (reference: PriorDistribution with a full
+    # covariance, from a previous solve's FULL Hessian). Adds
+    # 0.5·dwᵀ P dw on top of the diagonal terms; small-d only.
+    prior_full_precision: Optional[jax.Array] = None
     norm_factors: Optional[jax.Array] = None
     norm_shifts: Optional[jax.Array] = None
 
@@ -106,12 +110,29 @@ class Objective:
         coeff = (self.l2 + tau) * mask
         value = 0.5 * jnp.sum(coeff * dw * dw)
         grad = coeff * dw
+        if self.prior_full_precision is not None:
+            Pdw = self.prior_full_precision @ dw
+            value = value + 0.5 * jnp.dot(dw, Pdw)
+            grad = grad + Pdw
         return value, grad
 
     def _reg_hess_diag(self, w):
         mask = self.reg_mask if self.reg_mask is not None else 1.0
         tau = self.prior_precision if self.prior_precision is not None else 0.0
-        return (self.l2 + tau) * mask * jnp.ones_like(w)
+        diag = (self.l2 + tau) * mask * jnp.ones_like(w)
+        if self.prior_full_precision is not None:
+            diag = diag + jnp.diagonal(self.prior_full_precision)
+        return diag
+
+    def _reg_hvp(self, w, v):
+        """Regularizer Hessian-vector product (full prior needs P@v, not
+        diag(P)∘v)."""
+        mask = self.reg_mask if self.reg_mask is not None else 1.0
+        tau = self.prior_precision if self.prior_precision is not None else 0.0
+        out = (self.l2 + tau) * mask * v
+        if self.prior_full_precision is not None:
+            out = out + self.prior_full_precision @ v
+        return out
 
     # ------------------------------------------------------------------- API
     def value(self, w, batch: GLMBatch):
@@ -147,7 +168,7 @@ class Objective:
         gX, gsum = self._backprop(batch, g)
         hv = self._finish_backprop(
             self._psum(gX), None if gsum is None else self._psum(gsum))
-        return hv + self._reg_hess_diag(w) * v
+        return hv + self._reg_hvp(w, v)
 
     def hess_diag(self, w, batch: GLMBatch):
         """diag(H). Reference: TwiceDiffFunction.hessianDiagonal (used for
@@ -187,4 +208,9 @@ class Objective:
             H = H - jnp.outer(s, q) - jnp.outer(q, s) + w2sum * jnp.outer(s, s)
         if self.norm_factors is not None:
             H = H * jnp.outer(self.norm_factors, self.norm_factors)
-        return H + jnp.diag(self._reg_hess_diag(w))
+        mask = self.reg_mask if self.reg_mask is not None else 1.0
+        tau = self.prior_precision if self.prior_precision is not None else 0.0
+        H = H + jnp.diag((self.l2 + tau) * mask * jnp.ones_like(w))
+        if self.prior_full_precision is not None:
+            H = H + self.prior_full_precision
+        return H

@@ -1,0 +1,66 @@
+"""Informative priors for incremental training.
+
+Reference parity: com.linkedin.photon.ml.function.PriorDistribution and the
+incremental-training flow (GameTrainingDriver `--initial-model` + prior
+coefficients): the previous run's posterior (coefficient means + variances)
+becomes a Gaussian prior for the next solve, so the objective's L2 term turns
+into 0.5·(w − μ)ᵀ Λ (w − μ) with Λ the prior precision.
+
+Λ is diagonal (1/variances) in the common path — exactly what the reference
+builds from BayesianLinearModelAvro variances — with an optional full
+(d, d) precision for small feature spaces (from VarianceComputationType.FULL
+Hessians).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class PriorDistribution:
+    """Gaussian prior N(mean, Λ⁻¹); exactly one of precision_diag /
+    precision_full is set (both None = no prior)."""
+
+    mean: np.ndarray  # (d,)
+    precision_diag: Optional[np.ndarray] = None  # (d,)
+    precision_full: Optional[np.ndarray] = None  # (d, d)
+
+    def __post_init__(self):
+        if self.precision_diag is not None and self.precision_full is not None:
+            raise ValueError("set precision_diag OR precision_full, not both")
+
+    @property
+    def dim(self) -> int:
+        return int(np.asarray(self.mean).shape[0])
+
+    @staticmethod
+    def from_coefficients(
+        means,
+        variances=None,
+        default_precision: float = 1.0,
+        scale: float = 1.0,
+        min_variance: float = 1e-12,
+    ) -> "PriorDistribution":
+        """Previous model's posterior → prior (reference: the incremental
+        training weight `priorCoefficients` path). Missing variances fall
+        back to `default_precision`; `scale` is the reference's
+        down-weighting of the prior (its incremental-weight multiplier)."""
+        means = np.asarray(means, np.float32)
+        if variances is None:
+            prec = np.full(means.shape, default_precision, np.float32)
+        else:
+            prec = 1.0 / np.maximum(np.asarray(variances, np.float32),
+                                    min_variance)
+        return PriorDistribution(means, precision_diag=prec * scale)
+
+    @staticmethod
+    def from_hessian(means, hessian, scale: float = 1.0) -> "PriorDistribution":
+        """Full-covariance prior from a dense Hessian (the Laplace posterior
+        of the previous solve; VarianceComputationType.FULL analog)."""
+        return PriorDistribution(
+            np.asarray(means, np.float32),
+            precision_full=np.asarray(hessian, np.float32) * scale,
+        )

@@ -1,0 +1,205 @@
+"""Incremental training / informative priors (SURVEY.md §5 checkpoint-resume
+via priors; reference: function.PriorDistribution, --initial-model flow)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.models.training import train_glm
+from photon_tpu.models.variance import VarianceComputationType
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.ops.objective import Objective
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.prior import PriorDistribution
+
+
+class TestPriorDistribution:
+    def test_from_coefficients(self):
+        p = PriorDistribution.from_coefficients(
+            np.array([1.0, 2.0]), np.array([0.5, 0.25]), scale=2.0)
+        np.testing.assert_allclose(p.precision_diag, [4.0, 8.0])
+        assert p.precision_full is None
+
+    def test_both_precisions_rejected(self):
+        with pytest.raises(ValueError):
+            PriorDistribution(np.zeros(2), np.ones(2), np.eye(2))
+
+    def test_missing_variances_default(self):
+        p = PriorDistribution.from_coefficients(np.zeros(3),
+                                                default_precision=7.0)
+        np.testing.assert_allclose(p.precision_diag, 7.0)
+
+
+class TestFullPrecisionObjective:
+    def test_value_grad_hvp_vs_autodiff(self, rng):
+        n, d = 100, 5
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = rng.normal(size=n).astype(np.float32)
+        A = rng.normal(size=(d, d)).astype(np.float32)
+        P = A @ A.T + np.eye(d, dtype=np.float32)
+        mu = rng.normal(size=d).astype(np.float32)
+        obj = Objective(
+            task=TaskType.LINEAR_REGRESSION, l2=0.3,
+            prior_mean=jnp.asarray(mu),
+            prior_full_precision=jnp.asarray(P),
+        )
+        batch = make_batch(X, y)
+        w = jnp.asarray(rng.normal(size=d), jnp.float32)
+        f, g = obj.value_and_grad(w, batch)
+        g_auto = jax.grad(lambda w: obj.value_and_grad(w, batch)[0])(w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_auto),
+                                   rtol=1e-4, atol=1e-3)
+        v = jnp.asarray(rng.normal(size=d), jnp.float32)
+        hv = obj.hvp(w, batch, v)
+        hv_auto = jax.jvp(
+            lambda w: jax.grad(lambda u: obj.value_and_grad(u, batch)[0])(w),
+            (w,), (v,))[1]
+        np.testing.assert_allclose(np.asarray(hv), np.asarray(hv_auto),
+                                   rtol=1e-3, atol=1e-2)
+        H = obj.full_hessian(w, batch)
+        np.testing.assert_allclose(np.asarray(jnp.diag(H)),
+                                   np.asarray(obj.hess_diag(w, batch)),
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_sequential_bayes_equals_joint_for_linear(self, rng):
+        """Stage-1 posterior (full Hessian) as stage-2 prior must reproduce
+        the joint solve exactly for quadratic objectives."""
+        n, d = 400, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = rng.normal(size=d).astype(np.float32)
+        y = (X @ w_true + 0.1 * rng.normal(size=n)).astype(np.float32)
+        X1, y1, X2, y2 = X[:200], y[:200], X[200:], y[200:]
+        lam = 2.0
+        cfg = OptimizerConfig(max_iters=200, tolerance=1e-12,
+                              reg=reg.l2(), reg_weight=lam)
+        m1, _ = train_glm(make_batch(X1, y1), TaskType.LINEAR_REGRESSION, cfg)
+        obj1 = Objective(task=TaskType.LINEAR_REGRESSION, l2=lam)
+        H1 = obj1.full_hessian(m1.weights, make_batch(X1, y1))
+        prior = PriorDistribution.from_hessian(np.asarray(m1.weights),
+                                               np.asarray(H1))
+        cfg2 = OptimizerConfig(max_iters=200, tolerance=1e-12)  # no extra reg
+        m2, _ = train_glm(make_batch(X2, y2), TaskType.LINEAR_REGRESSION,
+                          cfg2, prior=prior)
+        m_joint, _ = train_glm(make_batch(X, y), TaskType.LINEAR_REGRESSION, cfg)
+        np.testing.assert_allclose(np.asarray(m2.weights),
+                                   np.asarray(m_joint.weights),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_strong_diag_prior_pins_solution(self, rng):
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (rng.uniform(size=200) < 0.5).astype(np.float32)
+        mu = np.array([1.0, -1.0, 0.5, 0.0], np.float32)
+        prior = PriorDistribution(mu, precision_diag=np.full(4, 1e6, np.float32))
+        m, _ = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                         OptimizerConfig(max_iters=100), prior=prior)
+        np.testing.assert_allclose(np.asarray(m.weights), mu, atol=5e-3)
+
+    def test_prior_exclusive_with_explicit_args(self, rng):
+        X = rng.normal(size=(10, 2)).astype(np.float32)
+        y = np.zeros(10, np.float32)
+        with pytest.raises(ValueError, match="prior OR"):
+            train_glm(make_batch(X, y), TaskType.LINEAR_REGRESSION,
+                      OptimizerConfig(max_iters=5),
+                      prior=PriorDistribution.from_coefficients(np.zeros(2)),
+                      prior_mean=jnp.zeros(2))
+
+
+class TestGameIncremental:
+    def _data(self, rng, n=300, E=6):
+        from photon_tpu.game.dataset import GameData
+
+        user = rng.integers(0, E, n)
+        Xr = rng.normal(size=(n, 2)).astype(np.float32)
+        u = rng.normal(size=(E, 2)).astype(np.float32)
+        m = np.einsum("nd,nd->n", Xr, u[user])
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float32)
+        return GameData.build(
+            y, shards={"r": Xr},
+            entity_ids={"user": np.asarray([f"u{i}" for i in user])}), u
+
+    def test_random_effect_prior_pins_seen_entities(self, rng):
+        from photon_tpu.game.dataset import RandomEffectDataset
+        from photon_tpu.game.model import RandomEffectModel
+        from photon_tpu.game.random_effect import RandomEffectCoordinate
+
+        data, _ = self._data(rng)
+        ds = RandomEffectDataset.build(data, "user", "r")
+        E, d = ds.n_entities, ds.dim
+        # prior: half the entities, tiny variances (pinned), distinct means
+        keys = ds.entity_keys[: E // 2]
+        pin = np.arange(1, len(keys) + 1, dtype=np.float32)
+        prior_model = RandomEffectModel(
+            entity_name="user", feature_shard="r",
+            task=TaskType.LOGISTIC_REGRESSION,
+            coefficients=jnp.asarray(np.stack([pin, -pin], 1)),
+            entity_keys=np.asarray(keys),
+            key_to_index={k: i for i, k in enumerate(keys.tolist())},
+            variances=jnp.full((len(keys), d), 1e-8),
+        )
+        coord = RandomEffectCoordinate(
+            ds, TaskType.LOGISTIC_REGRESSION,
+            OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=0.1))
+        model, _ = coord.train(np.zeros(data.n, np.float32), prior=prior_model)
+        got = np.asarray(model.coefficients)
+        np.testing.assert_allclose(got[: E // 2, 0], pin, atol=1e-2)
+        np.testing.assert_allclose(got[: E // 2, 1], -pin, atol=1e-2)
+        # unseen entities trained freely — not pinned to zero-prior means
+        assert not np.allclose(got[E // 2:], 0.0)
+
+    def test_estimator_incremental_beats_cold_start_on_new_batch(self, rng):
+        """Second-batch training with first-batch priors must track the
+        pooled solution better than training on the second batch alone."""
+        from photon_tpu.game.estimator import GameEstimator, RandomEffectConfig
+
+        E = 6
+        data1, _ = self._data(rng, n=1200, E=E)
+        data2, _ = self._data(rng, n=60, E=E)  # tiny second batch
+        cfg = {"re": RandomEffectConfig(
+            "user", "r",
+            OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=1.0))}
+
+        est = GameEstimator(TaskType.LOGISTIC_REGRESSION, cfg, n_sweeps=1,
+                            variance=VarianceComputationType.SIMPLE)
+        m1 = est.fit(data1)[0].model
+
+        inc = GameEstimator(TaskType.LOGISTIC_REGRESSION, cfg, n_sweeps=1,
+                            incremental=frozenset({"re"}))
+        m_inc = inc.fit(data2, initial_models=dict(m1.coordinates))[0].model
+        cold = GameEstimator(TaskType.LOGISTIC_REGRESSION, cfg, n_sweeps=1)
+        m_cold = cold.fit(data2)[0].model
+
+        from photon_tpu.game.dataset import GameData
+
+        pooled = GameData.build(
+            np.concatenate([data1.y, data2.y]),
+            shards={"r": np.concatenate(
+                [np.asarray(data1.shards["r"]), np.asarray(data2.shards["r"])])},
+            entity_ids={"user": np.concatenate(
+                [data1.entity_ids["user"], data2.entity_ids["user"]])},
+        )
+        m_pool = est.fit(pooled)[0].model
+
+        def dist(a, b):
+            ka = {k: i for i, k in enumerate(a.entity_keys.tolist())}
+            kb = {k: i for i, k in enumerate(b.entity_keys.tolist())}
+            common = sorted(set(ka) & set(kb))
+            A = np.asarray(a.coefficients)[[ka[k] for k in common]]
+            B = np.asarray(b.coefficients)[[kb[k] for k in common]]
+            return float(np.abs(A - B).mean())
+
+        assert dist(m_inc["re"], m_pool["re"]) < dist(m_cold["re"], m_pool["re"])
+
+    def test_incremental_requires_initial_model(self, rng):
+        from photon_tpu.game.estimator import GameEstimator, RandomEffectConfig
+
+        data, _ = self._data(rng, n=100)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {"re": RandomEffectConfig("user", "r",
+                                      OptimizerConfig(max_iters=5))},
+            incremental=frozenset({"re"}),
+        )
+        with pytest.raises(ValueError, match="initial_models"):
+            est.fit(data)
