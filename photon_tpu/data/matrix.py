@@ -133,6 +133,27 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     return (X * r[:, None]).T @ X
 
 
+def next_pow2(x: int, floor: int = 2) -> int:
+    """Smallest power of two ≥ x (≥ floor) — the static-shape bucket padding
+    used for entity row counts and projected feature dims alike."""
+    m = floor
+    while m < x:
+        m *= 2
+    return m
+
+
+def last_column_is_intercept(X: Matrix) -> bool:
+    """True when the design matrix's last column is constant 1 — the
+    data.feature_bags intercept-last convention."""
+    if isinstance(X, SparseRows):
+        d = X.n_features
+        ind, val = np.asarray(X.indices), np.asarray(X.values)
+        hit = (ind == d - 1) & (val != 0.0)
+        return bool(hit.any(axis=1).all() and (val[hit] == 1.0).all())
+    col = np.asarray(X)[:, -1]
+    return bool((col == 1.0).all())
+
+
 def nnz_stats(X: Matrix) -> tuple[int, int]:
     n, _ = X.shape if isinstance(X, SparseRows) else X.shape
     if isinstance(X, SparseRows):

@@ -21,6 +21,11 @@ from photon_tpu.game.model import (
     RandomEffectModel,
     score_rows,
 )
+from photon_tpu.game.projector import (
+    ProjectionConfig,
+    ProjectorType,
+    RandomProjector,
+)
 from photon_tpu.game.random_effect import RandomEffectCoordinate, RETrainStats
 from photon_tpu.game.scoring import coordinate_scores, predict_mean, score_game
 
@@ -45,4 +50,7 @@ __all__ = [
     "GameFitResult",
     "FixedEffectConfig",
     "RandomEffectConfig",
+    "ProjectionConfig",
+    "ProjectorType",
+    "RandomProjector",
 ]

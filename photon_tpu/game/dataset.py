@@ -117,6 +117,11 @@ class REBlock:
     y: jnp.ndarray  # (E, m)
     weights: jnp.ndarray  # (E, m); 0 marks padding
     X: object  # dense (E, m, d) jnp array, or (indices (E,m,k), values (E,m,k)) pair
+    # Projected-space bucket (reference: RandomEffectDatasetInProjectedSpace):
+    # dim = this bucket's feature dim when projected (X is dense (E, m, dim));
+    # proj = the per-entity index map behind it (INDEX_MAP only).
+    dim: Optional[int] = None
+    proj: Optional[object] = None  # projector.BlockProjection
 
     @property
     def n_entities(self) -> int:
@@ -124,10 +129,44 @@ class REBlock:
 
 
 def _next_pow2(x: int, floor: int = 4) -> int:
-    m = floor
-    while m < x:
-        m *= 2
-    return m
+    from photon_tpu.data.matrix import next_pow2
+
+    return next_pow2(x, floor)
+
+
+def _project_dense(Xd: np.ndarray, icpt) -> tuple:
+    """INDEX_MAP-project a dense (E, m, d) bucket: per-entity active columns
+    only, intercept pinned last."""
+    from photon_tpu.game.projector import (
+        build_index_map_projection,
+        project_dense_block,
+    )
+
+    active = np.any(Xd != 0.0, axis=1)  # (E, d)
+    if icpt is not None:
+        active[:, icpt] = False
+    sets = [np.nonzero(a)[0] for a in active]
+    bp = build_index_map_projection(sets, icpt)
+    return jnp.asarray(project_dense_block(Xd, bp)), bp
+
+
+def _project_sparse(ind3: np.ndarray, val3: np.ndarray, icpt) -> tuple:
+    """INDEX_MAP-project a padded-COO (E, m, k) bucket to per-entity dense
+    (E, m, p) blocks."""
+    from photon_tpu.game.projector import (
+        build_index_map_projection,
+        project_sparse_block,
+    )
+
+    E = ind3.shape[0]
+    sets = []
+    for e in range(E):
+        feats = np.unique(ind3[e][val3[e] != 0.0])
+        if icpt is not None:
+            feats = feats[feats != icpt]
+        sets.append(feats)
+    bp = build_index_map_projection(sets, icpt)
+    return jnp.asarray(project_sparse_block(ind3, val3, bp)), bp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,10 +182,16 @@ class RandomEffectDataset:
     entity_keys: np.ndarray  # (E,) raw keys, dense id = position
     key_to_index: dict  # raw key -> dense id
     blocks: list  # list[REBlock]
-    X: Matrix  # flat per-row design matrix (all n rows)
+    X: Matrix  # flat per-row design matrix (all n rows), FULL feature space
     entity_dense: np.ndarray  # (n,) dense entity id per row
     n_active: int  # rows used for training
     n_passive: int  # rows only scored
+    # Feature-space projection (reference: RandomEffectDatasetInProjectedSpace):
+    # the ProjectionConfig that built the blocks and, for RANDOM, the shared
+    # projector.RandomProjector. INDEX_MAP keeps its per-bucket maps on the
+    # blocks themselves (REBlock.proj).
+    projection: Optional[object] = None  # projector.ProjectionConfig
+    projector: Optional[object] = None  # projector.RandomProjector
 
     @property
     def n_entities(self) -> int:
@@ -164,6 +209,7 @@ class RandomEffectDataset:
         active_cap: Optional[int] = None,
         min_block_rows: int = 4,
         seed: int = 0,
+        projection=None,
     ) -> "RandomEffectDataset":
         X = data.shards[shard_name]
         raw = np.asarray(data.entity_ids[entity_name])
@@ -194,6 +240,23 @@ class RandomEffectDataset:
             m = _next_pow2(max(int(active_counts[e]), 1), min_block_rows)
             buckets.setdefault(m, []).append(e)
 
+        # Optional feature-space projection (reference:
+        # projector.* / RandomEffectDatasetInProjectedSpace).
+        projector_obj = None
+        icpt = None
+        if projection is not None:
+            from photon_tpu.data.matrix import last_column_is_intercept
+            from photon_tpu.game.projector import ProjectorType, RandomProjector
+
+            icpt = _shard_dim(X) - 1 if last_column_is_intercept(X) else None
+            if projection.projector is ProjectorType.RANDOM:
+                projector_obj = RandomProjector.build(
+                    _shard_dim(X),
+                    projection.projected_dim,
+                    keep_intercept=icpt is not None,
+                    seed=projection.seed,
+                )
+
         y, w = data.y, data.weights
         blocks = []
         for m in sorted(buckets):
@@ -207,16 +270,33 @@ class RandomEffectDataset:
             wb = np.where(mask, w[row_idx], 0.0).astype(np.float32)
             yb = y[row_idx].astype(np.float32)
             Xg = _gather_rows(X, row_idx.reshape(-1))
+            E_b = len(ents)
+            block_dim = None
+            block_proj = None
             if isinstance(X, SparseRows):
                 ind, val = Xg
                 k = ind.shape[-1]
-                Xb = (
-                    jnp.asarray(ind.reshape(len(ents), m, k)),
-                    jnp.asarray(val.reshape(len(ents), m, k) * mask[..., None]),
-                )
+                ind3 = ind.reshape(E_b, m, k)
+                val3 = (val.reshape(E_b, m, k) * mask[..., None]).astype(np.float32)
+                if projector_obj is not None:
+                    Xb = jnp.asarray(projector_obj.project_sparse_rows(ind3, val3))
+                    block_dim = projector_obj.dim_out
+                elif projection is not None:
+                    Xb, block_proj = _project_sparse(ind3, val3, icpt)
+                    block_dim = block_proj.dim
+                else:
+                    Xb = (jnp.asarray(ind3), jnp.asarray(val3))
             else:
                 d = Xg.shape[-1]
-                Xb = jnp.asarray(Xg.reshape(len(ents), m, d), jnp.float32)
+                Xd = (Xg.reshape(E_b, m, d) * mask[..., None]).astype(np.float32)
+                if projector_obj is not None:
+                    Xb = jnp.asarray(projector_obj.project_rows(Xd))
+                    block_dim = projector_obj.dim_out
+                elif projection is not None:
+                    Xb, block_proj = _project_dense(Xd, icpt)
+                    block_dim = block_proj.dim
+                else:
+                    Xb = jnp.asarray(Xd)
             blocks.append(
                 REBlock(
                     m=m,
@@ -225,6 +305,8 @@ class RandomEffectDataset:
                     y=jnp.asarray(yb),
                     weights=jnp.asarray(wb),
                     X=Xb,
+                    dim=block_dim,
+                    proj=block_proj,
                 )
             )
 
@@ -241,13 +323,17 @@ class RandomEffectDataset:
             entity_dense=entity_dense,
             n_active=n_active,
             n_passive=n - n_active,
+            projection=projection,
+            projector=projector_obj,
         )
 
     def block_batch(self, block: REBlock, offsets_full) -> GLMBatch:
         """Batched (E, m, ...) GLMBatch for one bucket, offsets gathered from
         the full per-row offset vector (other coordinates' scores)."""
         offs = jnp.asarray(offsets_full, jnp.float32)[block.row_index]
-        if isinstance(self.X, SparseRows):
+        if block.dim is not None:  # projected buckets are always dense
+            Xb = block.X
+        elif isinstance(self.X, SparseRows):
             ind, val = block.X
             Xb = SparseRows(ind, val, self.X.n_features)
         else:

@@ -47,23 +47,15 @@ class RandomEffectConfig:
     feature_shard: str
     optimizer: OptimizerConfig = OptimizerConfig()
     active_cap: Optional[int] = None
+    # Feature-space projection for the per-entity solves (reference:
+    # projector.ProjectorType on the random-effect data configuration).
+    projection: Optional[object] = None  # game.projector.ProjectionConfig
 
 
 CoordinateConfig = FixedEffectConfig | RandomEffectConfig
 
 
-def _last_column_is_intercept(X) -> bool:
-    """True when the design matrix's last column is constant 1 (the
-    data.feature_bags intercept-last convention)."""
-    from photon_tpu.data.matrix import SparseRows
-
-    if isinstance(X, SparseRows):
-        d = X.n_features
-        ind, val = np.asarray(X.indices), np.asarray(X.values)
-        hit = (ind == d - 1) & (val != 0.0)
-        return bool(hit.any(axis=1).all() and (val[hit] == 1.0).all())
-    col = np.asarray(X)[:, -1]
-    return bool((col == 1.0).all())
+from photon_tpu.data.matrix import last_column_is_intercept as _last_column_is_intercept
 
 
 @dataclasses.dataclass
@@ -121,14 +113,16 @@ class GameEstimator:
         """Fields that change the dataset (not just the solve)."""
         if isinstance(cfg, FixedEffectConfig):
             return ("fixed", cfg.feature_shard)
-        return ("random", cfg.entity_name, cfg.feature_shard, cfg.active_cap)
+        return ("random", cfg.entity_name, cfg.feature_shard, cfg.active_cap,
+                cfg.projection)
 
     @staticmethod
     def _build_dataset(data: GameData, cfg: CoordinateConfig):
         if isinstance(cfg, FixedEffectConfig):
             return FixedEffectDataset.build(data, cfg.feature_shard)
         return RandomEffectDataset.build(
-            data, cfg.entity_name, cfg.feature_shard, active_cap=cfg.active_cap
+            data, cfg.entity_name, cfg.feature_shard, active_cap=cfg.active_cap,
+            projection=cfg.projection,
         )
 
     def _build_coordinates(self, datasets: dict, configs: dict,

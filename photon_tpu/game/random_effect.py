@@ -68,10 +68,37 @@ class RandomEffectCoordinate:
     normalization: Optional[object] = None
 
     def __post_init__(self):
+        ds = self.dataset
+        if ds.projection is not None:
+            # Projection composes with neither normalization (the per-entity
+            # factor gather has no shared-vector representation) nor, for
+            # RANDOM, variances/priors (no diagonal transform exists through
+            # a dense Gaussian matrix).
+            if self.normalization is not None and not self.normalization.is_identity:
+                raise ValueError(
+                    "feature-space projection and normalization cannot be "
+                    "combined on a random-effect coordinate; normalize the "
+                    "shard before building the dataset instead"
+                )
+            if ds.projector is not None and self.variance is not VarianceComputationType.NONE:
+                raise ValueError(
+                    "coefficient variances are not defined through a RANDOM "
+                    "projection; use INDEX_MAP projection or no projection"
+                )
+        self._solvers: dict = {}
+
+    def _solver_for(self, dim: int, with_prior: bool):
+        """jit(vmap(solve)) for one projected (or full) feature dim. Cached
+        per dim — INDEX_MAP buckets each carry their own dim."""
         import dataclasses as _dc
 
-        obj = make_objective(self.task, self.config, self.dataset.dim,
-                             normalization=self.normalization)
+        key = (dim, with_prior)
+        fn = self._solvers.get(key)
+        if fn is not None:
+            return fn
+        norm = (self.normalization
+                if self.dataset.projection is None else None)
+        obj = make_objective(self.task, self.config, dim, normalization=norm)
 
         def one(batch, w0):
             res = solve(obj, batch, w0, self.config)
@@ -90,8 +117,9 @@ class RandomEffectCoordinate:
 
         # One compile per bucket shape (jax.jit caches on shapes); the vmap
         # batches the entire while_loop solver across entities.
-        self._solve_blocks = jax.jit(jax.vmap(one))
-        self._solve_blocks_prior = jax.jit(jax.vmap(one_with_prior))
+        fn = jax.jit(jax.vmap(one_with_prior if with_prior else one))
+        self._solvers[key] = fn
+        return fn
 
     def train(
         self,
@@ -118,6 +146,11 @@ class RandomEffectCoordinate:
             # runs in normalized space
             coeffs = norm.rows_to_normalized_space(coeffs)
 
+        if prior is not None and ds.projector is not None:
+            raise ValueError(
+                "per-entity priors cannot be projected through a RANDOM "
+                "projection; use INDEX_MAP projection or no projection"
+            )
         prior_means = prior_precs = None
         if prior is not None and prior.dim == d:
             pid = prior.dense_ids(ds.entity_keys)  # (E,) rows in the prior
@@ -127,7 +160,11 @@ class RandomEffectCoordinate:
                 pvar = np.concatenate(
                     [np.asarray(prior.variances, np.float32),
                      np.ones((1, d), np.float32)])[pid]
-                prior_precs = seen / np.maximum(pvar, 1e-12)
+                # variance ≤ 0 means the dim was never estimated (e.g. outside
+                # an INDEX_MAP-projected entity's active set) — no prior there,
+                # NOT infinite precision
+                prior_precs = np.where(
+                    pvar > 0, seen / np.maximum(pvar, 1e-12), 0.0)
             else:
                 prior_precs = seen * np.ones((E, d), np.float32)
             if norm is not None:
@@ -143,12 +180,29 @@ class RandomEffectCoordinate:
         n_conv = n_fail = total_iters = 0
         for block in ds.blocks:
             batch = ds.block_batch(block, offsets_full)
-            w0 = jnp.asarray(coeffs[block.entity_index])
+            w0_full = coeffs[block.entity_index]
+            # Project warm starts / priors into this bucket's solve space
+            # (reference: ProjectionMatrix.projectCoefficients).
+            if block.proj is not None:  # INDEX_MAP
+                from photon_tpu.game.projector import gather_rows
+
+                w0 = jnp.asarray(gather_rows(w0_full, block.proj))
+                pm = pp = None
+                if prior_means is not None:
+                    pm = jnp.asarray(
+                        gather_rows(prior_means[block.entity_index], block.proj))
+                    pp = jnp.asarray(
+                        gather_rows(prior_precs[block.entity_index], block.proj))
+            elif ds.projector is not None:  # RANDOM
+                w0 = jnp.asarray(ds.projector.project_coeffs(w0_full))
+                pm = pp = None
+            else:
+                w0 = jnp.asarray(w0_full)
+                pm = pp = None
+                if prior_means is not None:
+                    pm = jnp.asarray(prior_means[block.entity_index])
+                    pp = jnp.asarray(prior_precs[block.entity_index])
             e_real = block.n_entities
-            pm = pp = None
-            if prior_means is not None:
-                pm = jnp.asarray(prior_means[block.entity_index])
-                pp = jnp.asarray(prior_precs[block.entity_index])
             if self.mesh is not None:
                 n_dev = self.mesh.devices.size
                 e_pad = pad_to_multiple(e_real, n_dev)
@@ -161,13 +215,27 @@ class RandomEffectCoordinate:
                                         data_sharding(self.mesh))
                     pp = jax.device_put(_pad_axis0(pp, e_pad),
                                         data_sharding(self.mesh))
+            d_solve = block.dim if block.dim is not None else d
+            solver = self._solver_for(d_solve, pm is not None)
             if pm is not None:
-                res, var = self._solve_blocks_prior(batch, w0, pm, pp)
+                res, var = solver(batch, w0, pm, pp)
             else:
-                res, var = self._solve_blocks(batch, w0)
-            coeffs[block.entity_index] = np.asarray(res.w)[:e_real]
-            if variances is not None:
-                variances[block.entity_index] = np.asarray(var)[:e_real]
+                res, var = solver(batch, w0)
+            w_out = np.asarray(res.w)[:e_real]
+            if block.proj is not None:
+                from photon_tpu.game.projector import scatter_rows_into
+
+                scatter_rows_into(coeffs, w_out, block.entity_index, block.proj)
+                if variances is not None:
+                    scatter_rows_into(
+                        variances, np.asarray(var)[:e_real],
+                        block.entity_index, block.proj)
+            elif ds.projector is not None:
+                coeffs[block.entity_index] = ds.projector.back_project(w_out)
+            else:
+                coeffs[block.entity_index] = w_out
+                if variances is not None:
+                    variances[block.entity_index] = np.asarray(var)[:e_real]
             n_conv += int(np.asarray(res.converged)[:e_real].sum())
             n_fail += int(np.asarray(res.failed)[:e_real].sum())
             total_iters += int(np.asarray(res.iterations)[:e_real].sum())
