@@ -1,0 +1,20 @@
+from photon_tpu.diagnostics.bootstrap import BootstrapReport, bootstrap_glm
+from photon_tpu.diagnostics.hosmer_lemeshow import (
+    HosmerLemeshowResult,
+    hosmer_lemeshow,
+)
+from photon_tpu.diagnostics.importance import (
+    FeatureImportanceReport,
+    expected_magnitude_importance,
+    variance_importance,
+)
+
+__all__ = [
+    "BootstrapReport",
+    "bootstrap_glm",
+    "HosmerLemeshowResult",
+    "hosmer_lemeshow",
+    "FeatureImportanceReport",
+    "expected_magnitude_importance",
+    "variance_importance",
+]

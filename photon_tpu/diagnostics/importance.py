@@ -1,0 +1,76 @@
+"""Feature-importance diagnostics.
+
+Reference parity: com.linkedin.photon.ml.diagnostics.featureimportance.
+{ExpectedMagnitudeFeatureImportanceDiagnostic,
+ VarianceFeatureImportanceDiagnostic} — importance of feature j is
+|w_j| · E[|x_j|] (expected contribution magnitude to the margin) or
+|w_j| · σ(x_j) (contribution variability). Both reduce to one weighted
+column-moment pass over X plus an elementwise product, so they run as a
+single XLA reduction even for SparseRows (segment ops over the padded COO).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.matrix import SparseRows
+
+
+class FeatureImportanceReport(NamedTuple):
+    importance: np.ndarray  # (d,)
+    order: np.ndarray  # (d,) feature ids, most important first
+    names: Optional[Sequence[str]]
+
+    def top(self, k: int = 20) -> list[tuple[object, float]]:
+        ids = self.order[:k]
+        label = (lambda j: self.names[j]) if self.names is not None else (lambda j: int(j))
+        return [(label(j), float(self.importance[j])) for j in ids]
+
+
+@jax.jit  # jitted so XLA dead-code-eliminates whichever moment a caller drops
+def _column_moments(X, weights) -> tuple[jax.Array, jax.Array]:
+    """Weighted per-column (E[|x|], Var[x]) in one pass."""
+    w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+    if isinstance(X, SparseRows):
+        d = X.n_features
+        wv = w[:, None] * X.values
+        cols = X.indices.reshape(-1)
+        # Padding slots have value 0 → contribute nothing to any moment.
+        e_abs = jax.ops.segment_sum(jnp.abs(wv).reshape(-1), cols, num_segments=d)
+        e1 = jax.ops.segment_sum(wv.reshape(-1), cols, num_segments=d)
+        e2 = jax.ops.segment_sum((wv * X.values).reshape(-1), cols, num_segments=d)
+        return e_abs, jnp.maximum(e2 - e1 * e1, 0.0)
+    e_abs = w @ jnp.abs(X)
+    e1 = w @ X
+    e2 = w @ (X * X)
+    return e_abs, jnp.maximum(e2 - e1 * e1, 0.0)
+
+
+def _report(importance: jax.Array, names) -> FeatureImportanceReport:
+    imp = np.asarray(importance)
+    return FeatureImportanceReport(imp, np.argsort(-imp), names)
+
+
+def expected_magnitude_importance(
+    w, X, weights=None, names: Optional[Sequence[str]] = None
+) -> FeatureImportanceReport:
+    """|w_j| · E[|x_j|] (ExpectedMagnitudeFeatureImportanceDiagnostic)."""
+    w = jnp.asarray(w, jnp.float32)
+    wts = (jnp.ones((X.shape[0],), jnp.float32) if weights is None
+           else jnp.asarray(weights, jnp.float32))
+    e_abs, _ = _column_moments(X, wts)
+    return _report(jnp.abs(w) * e_abs, names)
+
+
+def variance_importance(
+    w, X, weights=None, names: Optional[Sequence[str]] = None
+) -> FeatureImportanceReport:
+    """|w_j| · σ(x_j) (VarianceFeatureImportanceDiagnostic)."""
+    w = jnp.asarray(w, jnp.float32)
+    wts = (jnp.ones((X.shape[0],), jnp.float32) if weights is None
+           else jnp.asarray(weights, jnp.float32))
+    _, var = _column_moments(X, wts)
+    return _report(jnp.abs(w) * jnp.sqrt(var), names)

@@ -1,0 +1,170 @@
+"""Diagnostics: bootstrap CIs, Hosmer-Lemeshow calibration, feature importance.
+
+Mirrors the reference's diagnostics.* unit tests: statistics checked against
+plain-numpy reimplementations and against planted ground truth.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.data.matrix import SparseRows, from_scipy_csr
+from photon_tpu.diagnostics import (
+    bootstrap_glm,
+    expected_magnitude_importance,
+    hosmer_lemeshow,
+    variance_importance,
+)
+from photon_tpu.evaluation.metrics import logistic_loss
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+
+
+def _logistic_problem(rng, n=3000, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y, w_true
+
+
+class TestBootstrap:
+    def test_ci_covers_truth(self, rng):
+        X, y, w_true = _logistic_problem(rng)
+        cfg = OptimizerConfig(max_iters=60, tolerance=1e-7,
+                              regularize_intercept=True)
+        rep = bootstrap_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                            cfg, n_replicates=24, intercept_index=None)
+        assert rep.coefficients.shape == (24, 6)
+        assert rep.converged.all()
+        # Every replicate differs (Poisson weights actually vary the fit).
+        assert np.std(rep.coefficients, axis=0).min() > 1e-4
+        # 95% CI covers the planted coefficients in nearly all coords.
+        assert rep.contains(w_true).sum() >= 5
+        # Bootstrap mean lands near the truth too.
+        np.testing.assert_allclose(rep.mean, w_true, atol=0.25)
+
+    def test_metric_distribution(self, rng):
+        X, y, _ = _logistic_problem(rng, n=800, d=4)
+        cfg = OptimizerConfig(max_iters=40, regularize_intercept=True)
+        rep = bootstrap_glm(
+            make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg,
+            n_replicates=8, intercept_index=None,
+            metric_fn=lambda w, b: logistic_loss(
+                b.X @ w + b.offsets, b.y, b.weights),
+        )
+        assert rep.metrics.shape == (8,)
+        assert np.isfinite(rep.metrics).all()
+        # Training log-loss on a separable-ish fit stays below chance.
+        assert rep.metrics.mean() < np.log(2.0)
+
+    def test_padding_rows_stay_dead(self, rng):
+        X, y, _ = _logistic_problem(rng, n=200, d=4)
+        w = np.ones(200, np.float32)
+        w[150:] = 0.0  # padding
+        y2 = y.copy()
+        y2[150:] = 99.0  # poison: must never be touched
+        cfg = OptimizerConfig(max_iters=30, regularize_intercept=True)
+        rep = bootstrap_glm(make_batch(X, y2, weights=w),
+                            TaskType.LOGISTIC_REGRESSION, cfg,
+                            n_replicates=4, intercept_index=None)
+        assert np.isfinite(rep.coefficients).all()
+
+
+def _hl_numpy(probs, labels, weights, n_bins=10):
+    order = np.argsort(probs)
+    p, y, w = probs[order], labels[order], weights[order]
+    cumw = np.cumsum(w) - 0.5 * w
+    bins = np.clip((cumw / w.sum() * n_bins).astype(int), 0, n_bins - 1)
+    chi2 = 0.0
+    for g in range(n_bins):
+        m = (bins == g) & (w > 0)
+        if not m.any():
+            continue
+        obs, exp, mass = (w[m] * y[m]).sum(), (w[m] * p[m]).sum(), w[m].sum()
+        chi2 += (obs - exp) ** 2 / max(exp * (1 - exp / mass), 1e-12)
+    return chi2
+
+
+class TestHosmerLemeshow:
+    def test_matches_numpy(self, rng):
+        n = 2000
+        p = rng.uniform(0.05, 0.95, size=n).astype(np.float32)
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        w = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+        res = hosmer_lemeshow(p, y, w)
+        np.testing.assert_allclose(float(res.chi2), _hl_numpy(p, y, w),
+                                   rtol=2e-4)
+        assert res.observed_pos.shape == (10,)
+        np.testing.assert_allclose(float(res.bin_weight.sum()), w.sum(),
+                                   rtol=1e-5)
+
+    def test_calibrated_vs_miscalibrated(self, rng):
+        n = 5000
+        p = rng.uniform(0.05, 0.95, size=n).astype(np.float32)
+        y_good = (rng.uniform(size=n) < p).astype(np.float32)
+        good = hosmer_lemeshow(p, y_good)
+        assert float(good.p_value) > 0.05
+        assert bool(good.well_calibrated)
+        # Systematically over-predicted labels → reject calibration.
+        y_bad = (rng.uniform(size=n) < np.clip(p + 0.2, 0, 1)).astype(np.float32)
+        bad = hosmer_lemeshow(p, y_bad)
+        assert float(bad.p_value) < 1e-4
+        assert float(bad.chi2) > float(good.chi2)
+
+    def test_padding_ignored(self, rng):
+        n = 1000
+        p = rng.uniform(0.1, 0.9, size=n).astype(np.float32)
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        w = np.ones(n, np.float32)
+        base = hosmer_lemeshow(p, y, w)
+        p2 = np.concatenate([p, np.full(100, 0.5, np.float32)])
+        y2 = np.concatenate([y, np.ones(100, np.float32)])
+        w2 = np.concatenate([w, np.zeros(100, np.float32)])
+        padded = hosmer_lemeshow(p2, y2, w2)
+        np.testing.assert_allclose(float(padded.chi2), float(base.chi2),
+                                   rtol=1e-5)
+
+
+class TestFeatureImportance:
+    def test_dense_matches_numpy(self, rng):
+        n, d = 500, 7
+        X = rng.normal(size=(n, d)).astype(np.float32) * rng.uniform(0.5, 3, d)
+        w = rng.normal(size=d).astype(np.float32)
+        wt = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+        wn = wt / wt.sum()
+        rep = expected_magnitude_importance(w, jnp.asarray(X), wt)
+        np.testing.assert_allclose(
+            rep.importance, np.abs(w) * (wn @ np.abs(X)), rtol=1e-4)
+        repv = variance_importance(w, jnp.asarray(X), wt)
+        mu = wn @ X
+        var = wn @ (X * X) - mu * mu
+        np.testing.assert_allclose(
+            repv.importance, np.abs(w) * np.sqrt(np.maximum(var, 0)),
+            rtol=1e-3, atol=1e-5)
+        assert rep.importance[rep.order[0]] == rep.importance.max()
+
+    def test_sparse_matches_dense(self, rng):
+        import scipy.sparse as sp
+        n, d = 300, 20
+        M = sp.random(n, d, density=0.2, random_state=1, format="csr",
+                      dtype=np.float32)
+        X = from_scipy_csr(M)
+        w = rng.normal(size=d).astype(np.float32)
+        dense = expected_magnitude_importance(w, jnp.asarray(M.toarray()))
+        sparse = expected_magnitude_importance(w, X)
+        np.testing.assert_allclose(sparse.importance, dense.importance,
+                                   rtol=1e-4, atol=1e-6)
+        densev = variance_importance(w, jnp.asarray(M.toarray()))
+        sparsev = variance_importance(w, X)
+        np.testing.assert_allclose(sparsev.importance, densev.importance,
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_names_and_top(self, rng):
+        X = rng.normal(size=(100, 3)).astype(np.float32)
+        rep = expected_magnitude_importance(
+            np.array([0.1, 5.0, 1.0], np.float32), jnp.asarray(X),
+            names=["a", "b", "c"])
+        top = rep.top(2)
+        assert top[0][0] == "b"
+        assert len(top) == 2
