@@ -50,7 +50,7 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
     if isinstance(X, SparseRows):
         X = SparseRows(
             jnp.concatenate([X.indices, jnp.zeros((extra, X.indices.shape[1]), jnp.int32)]),
-            jnp.concatenate([X.values, jnp.zeros((extra, X.values.shape[1]), jnp.float32)]),
+            jnp.concatenate([X.values, jnp.zeros((extra, X.values.shape[1]), X.values.dtype)]),
             X.n_features,
         )
     else:
@@ -66,6 +66,20 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
 
 def with_offsets(batch: GLMBatch, offsets) -> GLMBatch:
     return batch._replace(offsets=jnp.asarray(offsets, jnp.float32))
+
+
+def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
+    """Recast feature STORAGE (dense X or SparseRows values) — typically to
+    bfloat16: halves feature HBM traffic and feeds the MXU its native input
+    width, while every contraction still accumulates in f32
+    (data.matrix matvec/rmatvec use preferred_element_type=float32).
+    Labels/weights/offsets and all solver state stay f32."""
+    X = batch.X
+    if isinstance(X, SparseRows):
+        X = SparseRows(X.indices, X.values.astype(dtype), X.n_features)
+    else:
+        X = X.astype(dtype)
+    return batch._replace(X=X)
 
 
 def total_weight(batch: GLMBatch) -> float:

@@ -81,30 +81,44 @@ def from_scipy_csr(csr, k: int | None = None) -> SparseRows:
 
 
 def matvec(X: Matrix, w: jax.Array) -> jax.Array:
-    """X @ w -> (n,). The GLM margin hot path."""
+    """X @ w -> (n,). The GLM margin hot path.
+
+    Mixed precision: when X is stored in bfloat16 (see dataset.cast_features),
+    w is cast to bf16 so the contraction's OPERANDS are bf16 (half the HBM
+    traffic, native MXU input width) while `preferred_element_type=float32`
+    keeps the ACCUMULATION in f32 — the TPU matmul recipe. Output is always
+    f32; everything downstream (losses, solver state) never sees bf16.
+    """
     if isinstance(X, SparseRows):
-        return jnp.einsum("nk,nk->n", X.values, w[X.indices])
-    return X @ w
+        # Sparse runs on the VPU (gather + multiply + reduce), never the MXU:
+        # bf16 is a STORAGE format only — upcast in registers, full-precision
+        # products, f32 accumulation. w/r vectors are small; never downcast.
+        return jnp.einsum("nk,nk->n", X.values.astype(jnp.float32),
+                          w[X.indices])
+    return jnp.matmul(X, w.astype(X.dtype), preferred_element_type=jnp.float32)
 
 
 def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
-    """X^T @ r -> (d,). The gradient aggregation hot path."""
+    """X^T @ r -> (d,). The gradient aggregation hot path (f32 accumulation,
+    bf16-storage aware like matvec)."""
     if isinstance(X, SparseRows):
-        contrib = (X.values * r[:, None]).reshape(-1)
+        contrib = (X.values.astype(jnp.float32) * r[:, None]).reshape(-1)
         return jax.ops.segment_sum(
-            contrib, X.indices.reshape(-1), num_segments=X.n_features
+            contrib, X.indices.reshape(-1), num_segments=X.n_features,
         )
-    return X.T @ r
+    return jnp.matmul(X.T, r.astype(X.dtype), preferred_element_type=jnp.float32)
 
 
 def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """(X∘X)^T @ r -> (d,): Hessian diagonal building block."""
     if isinstance(X, SparseRows):
-        contrib = (X.values * X.values * r[:, None]).reshape(-1)
+        v = X.values.astype(jnp.float32)
+        contrib = (v * v * r[:, None]).reshape(-1)
         return jax.ops.segment_sum(
-            contrib, X.indices.reshape(-1), num_segments=X.n_features
+            contrib, X.indices.reshape(-1), num_segments=X.n_features,
         )
-    return (X * X).T @ r
+    return jnp.matmul((X * X).T, r.astype(X.dtype),
+                      preferred_element_type=jnp.float32)
 
 
 MAX_GRAM_FEATURES = 20_000
@@ -127,10 +141,12 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
                 f"MAX_GRAM_FEATURES={MAX_GRAM_FEATURES}; use hess_diag/"
                 "SIMPLE variances for large feature spaces"
             )
-        rows = jnp.zeros((n, d), X.values.dtype)
-        rows = rows.at[jnp.arange(n)[:, None], X.indices].add(X.values)
+        rows = jnp.zeros((n, d), jnp.float32)
+        rows = rows.at[jnp.arange(n)[:, None], X.indices].add(
+            X.values.astype(jnp.float32))
         return (rows * r[:, None]).T @ rows
-    return (X * r[:, None]).T @ X
+    # Small-d variance path: plain f32 regardless of storage dtype.
+    return (X.astype(jnp.float32) * r[:, None]).T @ X.astype(jnp.float32)
 
 
 def next_pow2(x: int, floor: int = 2) -> int:

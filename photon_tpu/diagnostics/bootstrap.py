@@ -86,11 +86,19 @@ def bootstrap_glm(
     # Replicates that failed their solve (line-search failure / max_iters
     # without convergence) would corrupt the quantiles; CIs and moments use
     # converged replicates only. The full matrix stays available.
-    good = ws[ok] if ok.any() else ws
-    if not ok.all():
+    if ok.any():
+        good = ws[ok]
+        if not ok.all():
+            warnings.warn(
+                f"bootstrap_glm: {int((~ok).sum())}/{n_replicates} replicates "
+                "did not converge; CIs use the converged subset only",
+                stacklevel=2)
+    else:
+        good = ws
         warnings.warn(
-            f"bootstrap_glm: {int((~ok).sum())}/{n_replicates} replicates did "
-            "not converge; CIs use the converged subset only", stacklevel=2)
+            "bootstrap_glm: NO replicate converged; the returned CIs are "
+            "computed from unconverged solves and are not trustworthy — "
+            "raise max_iters or loosen tolerance", stacklevel=2)
     alpha = (1.0 - confidence) / 2.0
     lo, hi = np.quantile(good, [alpha, 1.0 - alpha], axis=0)
     return BootstrapReport(

@@ -10,6 +10,7 @@ single XLA reduction even for SparseRows (segment ops over the padded COO).
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -30,23 +31,28 @@ class FeatureImportanceReport(NamedTuple):
         return [(label(j), float(self.importance[j])) for j in ids]
 
 
-@jax.jit  # jitted so XLA dead-code-eliminates whichever moment a caller drops
-def _column_moments(X, weights) -> tuple[jax.Array, jax.Array]:
-    """Weighted per-column (E[|x|], Var[x]) in one pass."""
+@partial(jax.jit, static_argnames=("which",))
+def _column_moments(X, weights, which: str) -> jax.Array:
+    """One weighted column moment: E[|x|] (which='abs') or Var[x] ('var').
+    Static dispatch so each caller compiles only the passes it uses."""
     w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
     if isinstance(X, SparseRows):
         d = X.n_features
         wv = w[:, None] * X.values
         cols = X.indices.reshape(-1)
         # Padding slots have value 0 → contribute nothing to any moment.
-        e_abs = jax.ops.segment_sum(jnp.abs(wv).reshape(-1), cols, num_segments=d)
+        if which == "abs":
+            return jax.ops.segment_sum(jnp.abs(wv).reshape(-1), cols,
+                                       num_segments=d)
         e1 = jax.ops.segment_sum(wv.reshape(-1), cols, num_segments=d)
-        e2 = jax.ops.segment_sum((wv * X.values).reshape(-1), cols, num_segments=d)
-        return e_abs, jnp.maximum(e2 - e1 * e1, 0.0)
-    e_abs = w @ jnp.abs(X)
+        e2 = jax.ops.segment_sum((wv * X.values).reshape(-1), cols,
+                                 num_segments=d)
+        return jnp.maximum(e2 - e1 * e1, 0.0)
+    if which == "abs":
+        return w @ jnp.abs(X)
     e1 = w @ X
     e2 = w @ (X * X)
-    return e_abs, jnp.maximum(e2 - e1 * e1, 0.0)
+    return jnp.maximum(e2 - e1 * e1, 0.0)
 
 
 def _report(importance: jax.Array, names) -> FeatureImportanceReport:
@@ -61,7 +67,7 @@ def expected_magnitude_importance(
     w = jnp.asarray(w, jnp.float32)
     wts = (jnp.ones((X.shape[0],), jnp.float32) if weights is None
            else jnp.asarray(weights, jnp.float32))
-    e_abs, _ = _column_moments(X, wts)
+    e_abs = _column_moments(X, wts, "abs")
     return _report(jnp.abs(w) * e_abs, names)
 
 
@@ -72,5 +78,5 @@ def variance_importance(
     w = jnp.asarray(w, jnp.float32)
     wts = (jnp.ones((X.shape[0],), jnp.float32) if weights is None
            else jnp.asarray(weights, jnp.float32))
-    _, var = _column_moments(X, wts)
+    var = _column_moments(X, wts, "var")
     return _report(jnp.abs(w) * jnp.sqrt(var), names)

@@ -1,0 +1,130 @@
+"""bf16-storage / f32-accumulation mixed-precision path.
+
+SURVEY.md §1 promises "dense bf16/f32 matmuls on MXU"; these tests pin the
+semantics: feature storage may be bfloat16, every contraction accumulates in
+f32, and every public output (margins, gradients, fitted coefficients) is f32
+and close to the pure-f32 result.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import scipy.sparse as sp
+
+from photon_tpu.data.dataset import cast_features, make_batch, pad_batch
+from photon_tpu.data.matrix import (
+    SparseRows,
+    from_scipy_csr,
+    matvec,
+    rmatvec,
+    sq_rmatvec,
+)
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig
+
+
+class TestMixedPrecisionOps:
+    def test_dense_matvec_accumulates_f32(self, rng):
+        import ml_dtypes
+
+        X = rng.normal(size=(512, 64)).astype(np.float32)
+        w = rng.normal(size=64).astype(np.float32)
+        out = matvec(jnp.asarray(X, jnp.bfloat16), jnp.asarray(w))
+        assert out.dtype == jnp.float32
+        # Against the f64 product of bf16-ROUNDED operands: any deviation is
+        # accumulation error, which f32 accumulation keeps at ~1e-6 relative —
+        # bf16 accumulation would sit at ~1e-2.
+        X16 = X.astype(ml_dtypes.bfloat16).astype(np.float64)
+        w16 = w.astype(ml_dtypes.bfloat16).astype(np.float64)
+        exact_rounded = X16 @ w16
+        np.testing.assert_allclose(np.asarray(out), exact_rounded,
+                                   rtol=1e-5, atol=1e-4)
+        # And the end-to-end error vs the unrounded product is operand-level.
+        np.testing.assert_allclose(np.asarray(out), X @ w, atol=0.2)
+
+    def test_dense_rmatvec_and_sq(self, rng):
+        X = rng.normal(size=(256, 32)).astype(np.float32)
+        r = rng.normal(size=256).astype(np.float32)
+        Xb = jnp.asarray(X, jnp.bfloat16)
+        out = rmatvec(Xb, jnp.asarray(r))
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), X.T @ r, rtol=0.05,
+                                   atol=0.05)
+        out2 = sq_rmatvec(Xb, jnp.asarray(r))
+        assert out2.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out2), (X * X).T @ r, rtol=0.05,
+                                   atol=0.08)
+
+    def test_sparse_bf16_matches_f32(self, rng):
+        M = sp.random(200, 50, density=0.15, random_state=0, format="csr",
+                      dtype=np.float32)
+        X = from_scipy_csr(M)
+        Xb = SparseRows(X.indices, X.values.astype(jnp.bfloat16), X.n_features)
+        w = rng.normal(size=50).astype(np.float32)
+        r = rng.normal(size=200).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(Xb, jnp.asarray(w))),
+            np.asarray(matvec(X, jnp.asarray(w))), rtol=0.05, atol=0.02)
+        np.testing.assert_allclose(
+            np.asarray(rmatvec(Xb, jnp.asarray(r))),
+            np.asarray(rmatvec(X, jnp.asarray(r))), rtol=0.05, atol=0.02)
+        assert matvec(Xb, jnp.asarray(w)).dtype == jnp.float32
+
+    def test_f32_path_unchanged(self, rng):
+        X = rng.normal(size=(128, 16)).astype(np.float32)
+        w = rng.normal(size=16).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(jnp.asarray(X), jnp.asarray(w))), X @ w,
+            rtol=1e-5, atol=1e-5)
+
+
+class TestMixedPrecisionTraining:
+    def _problem(self, rng, n=4000, d=24):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = rng.normal(size=d).astype(np.float32) / np.sqrt(d)
+        p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        return X, y
+
+    def test_bf16_training_matches_f32(self, rng):
+        X, y = self._problem(rng)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        m32, r32 = train_glm(make_batch(X, y),
+                             TaskType.LOGISTIC_REGRESSION, cfg)
+        m16, r16 = train_glm(cast_features(make_batch(X, y)),
+                             TaskType.LOGISTIC_REGRESSION, cfg)
+        assert bool(r16.converged) and not bool(r16.failed)
+        w32 = np.asarray(m32.coefficients.means)
+        w16 = np.asarray(m16.coefficients.means)
+        assert w16.dtype == np.float32
+        # bf16 data rounding perturbs the optimum slightly; agreement well
+        # inside statistical noise.
+        np.testing.assert_allclose(w16, w32, atol=0.02)
+
+    def test_bf16_on_mesh(self, rng, mesh8):
+        X, y = self._problem(rng, n=1024, d=8)
+        # tolerance sits above the bf16 operand-rounding noise floor; the
+        # default 1e-7-ish tolerance is unreachable with rounded features.
+        cfg = OptimizerConfig(max_iters=40, tolerance=1e-4, reg=reg.l2(),
+                              reg_weight=1.0, regularize_intercept=True)
+        batch = cast_features(make_batch(X, y))
+        m_mesh, res = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                                mesh=mesh8)
+        m_one, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(m_mesh.coefficients.means),
+                                   np.asarray(m_one.coefficients.means),
+                                   atol=2e-3)
+
+    def test_pad_batch_preserves_bf16(self, rng):
+        X, y = self._problem(rng, n=100, d=4)
+        b = cast_features(make_batch(X, y))
+        padded = pad_batch(b, 128)
+        assert padded.X.dtype == jnp.bfloat16
+        M = sp.random(100, 16, density=0.2, random_state=0, format="csr",
+                      dtype=np.float32)
+        bs = cast_features(make_batch(from_scipy_csr(M), y))
+        ps = pad_batch(bs, 128)
+        assert ps.X.values.dtype == jnp.bfloat16
