@@ -47,6 +47,7 @@ def make_objective(
     intercept_index: Optional[int] = -1,
     normalization=None,
     prior_full_precision=None,
+    fused: bool = False,
 ) -> Objective:
     """Build the smooth objective for one coordinate's solve.
 
@@ -73,6 +74,7 @@ def make_objective(
         task=task,
         l2=config.reg.l2_weight(config.reg_weight),
         axis_name=axis_name,
+        fused=fused,
         reg_mask=reg_mask,
         prior_mean=prior_mean,
         prior_precision=prior_precision,
@@ -177,16 +179,30 @@ def train_glm(
         f = np.asarray(norm.factors) if norm.factors is not None else 1.0
         prior_precision = jnp.asarray(
             np.asarray(prior_precision, np.float32) * f * f)
+    # Single-device dense solves use the pallas fused value+grad kernel (one
+    # X pass per evaluation; ops/fused.py). Mesh solves keep the jnp path —
+    # XLA's SPMD partitioner cannot shard a pallas custom call, so the fused
+    # kernel under a mesh is only reachable through the explicit
+    # shard_map/axis_name route (Objective(axis_name=..., fused=True)).
     obj = make_objective(task, config, d,
                          prior_mean=prior_mean, prior_precision=prior_precision,
                          normalization=norm,
-                         prior_full_precision=prior_full_precision)
+                         prior_full_precision=prior_full_precision,
+                         fused=(mesh is None))
 
     if mesh is not None:
         n_dev = mesh.devices.size
         batch = pad_batch(batch, pad_to_multiple(batch.n, n_dev))
         batch = jax.device_put(batch, data_sharding(mesh))
         w0 = jax.device_put(w0, replicated(mesh))
+    elif (obj.fused and not isinstance(batch.X, SparseRows)
+          and batch.n >= 128
+          and not (jax.default_backend() == "tpu" and d % 128 != 0)):
+        # Zero-weight padding up to a 4096 multiple so the fused kernel's
+        # power-of-two row chunks always divide n (padding rows contribute
+        # nothing to loss or gradient). Skipped when can_fuse would reject
+        # the batch anyway (lane-unaligned d on TPU).
+        batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
     @jax.jit
     def _run(batch, w0):

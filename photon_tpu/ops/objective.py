@@ -26,6 +26,7 @@ from jax import lax
 
 from photon_tpu.data.dataset import GLMBatch
 from photon_tpu.data.matrix import matvec, rmatvec, sq_rmatvec, weighted_gram
+from photon_tpu.ops.fused import can_fuse, fused_value_and_grad
 from photon_tpu.ops.losses import TaskType, loss_fns
 
 
@@ -56,6 +57,10 @@ class Objective:
     task: TaskType
     l2: float = 0.0
     axis_name: Optional[str] = None
+    # Use the pallas fused single-pass kernel (ops/fused.py) for
+    # value_and_grad when the batch qualifies (dense X, no normalization).
+    # Set by train_glm; leave False for vmapped per-entity solves.
+    fused: bool = False
     reg_mask: Optional[jax.Array] = None
     prior_mean: Optional[jax.Array] = None
     prior_precision: Optional[jax.Array] = None
@@ -142,6 +147,14 @@ class Objective:
         return self.value_and_grad(w, batch)[1]
 
     def value_and_grad(self, w, batch: GLMBatch):
+        if (self.fused and self.norm_factors is None
+                and self.norm_shifts is None and can_fuse(batch.X)):
+            local_value, gX = fused_value_and_grad(
+                self.task, batch.X, w, batch.y, batch.weights, batch.offsets)
+            value = self._psum(local_value)
+            grad = self._psum(gX)
+            rv, rg = self._reg_terms(w)
+            return value + rv, grad + rg
         loss, d1, _ = loss_fns(self.task)
         z = self._margin(w, batch)
         g = batch.weights * d1(z, batch.y)
