@@ -272,7 +272,9 @@ class AvroContainerReader:
             self.sync = f.read(SYNC_SIZE)
             self._data_offset = f.tell()
 
-    def __iter__(self) -> Iterator[dict]:
+    def blocks(self) -> Iterator[tuple[int, bytes]]:
+        """(record count, decompressed payload) per container block — the
+        unit the native C++ decoder consumes."""
         with open(self.path, "rb") as f:
             f.seek(self._data_offset)
             while True:
@@ -290,9 +292,13 @@ class AvroContainerReader:
                     raise ValueError(f"{self.path}: bad sync marker")
                 if self.codec == "deflate":
                     payload = zlib.decompress(payload, -15)
-                buf = io.BytesIO(payload)
-                for _ in range(count):
-                    yield read_datum(buf, self.schema)
+                yield count, payload
+
+    def __iter__(self) -> Iterator[dict]:
+        for count, payload in self.blocks():
+            buf = io.BytesIO(payload)
+            for _ in range(count):
+                yield read_datum(buf, self.schema)
 
 
 def read_avro(path) -> list:

@@ -86,18 +86,25 @@ def build_design_matrix(
             rows.append(i)
             cols.append(imap.intercept_id)
             vals.append(1.0)
-    rows_a = np.asarray(rows, np.int64)
-    cols_a = np.asarray(cols, np.int64)
-    vals_a = np.asarray(vals, np.float32)
+    return coo_to_matrix(np.asarray(rows, np.int64),
+                         np.asarray(cols, np.int64),
+                         np.asarray(vals, np.float32),
+                         n, d, config.dense_threshold, k=k)
 
-    if d <= config.dense_threshold:
+
+def coo_to_matrix(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                  n: int, d: int, dense_threshold: int,
+                  k: Optional[int] = None) -> Matrix:
+    """COO triples → dense (n, d) or padded-COO SparseRows (duplicates
+    summed). Shared by the Python and native ingestion paths."""
+    if d <= dense_threshold:
         X = np.zeros((n, d), np.float32)
-        np.add.at(X, (rows_a, cols_a), vals_a)
+        np.add.at(X, (rows, cols), vals)
         return jnp.asarray(X)
 
     import scipy.sparse as sp
 
-    csr = sp.csr_matrix((vals_a, (rows_a, cols_a)), shape=(n, d))
+    csr = sp.csr_matrix((vals, (rows, cols)), shape=(n, d))
     csr.sum_duplicates()
     from photon_tpu.data.matrix import from_scipy_csr
 

@@ -138,6 +138,22 @@ def read_game_data(
     config: GameDataConfig,
     index_maps: Optional[dict] = None,
     sparse_k: Optional[int] = None,
+    use_native: Optional[bool] = None,
 ) -> tuple[GameData, dict]:
-    """Avro file/dir → GameData (reference: AvroDataReader.readMerged)."""
+    """Avro file/dir → GameData (reference: AvroDataReader.readMerged).
+
+    use_native: True forces the C++ block decoder (error if unavailable),
+    False forces pure Python, None (default) tries native and silently falls
+    back when the toolchain or the schema shape isn't supported.
+    """
+    if use_native is not False:
+        from photon_tpu.data.native_ingest import read_game_data_native
+
+        out = read_game_data_native(path, config, index_maps, sparse_k)
+        if out is not None:
+            return out
+        if use_native:
+            raise RuntimeError(
+                "native ingestion requested but unavailable (toolchain "
+                "missing or schema not plannable)")
     return records_to_game_data(read_avro(path), config, index_maps, sparse_k)

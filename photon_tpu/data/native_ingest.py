@@ -1,0 +1,221 @@
+"""Native (C++) Avro → GameData ingestion fast path.
+
+Reference parity: the JVM Avro decode inside
+com.linkedin.photon.ml.data.avro.AvroDataReader, re-done as a columnar C++
+block decoder (photon_tpu/native). The schema is compiled once into a flat
+field PLAN; the C++ VM then turns each decompressed container block into
+(y/offset/weight arrays, per-shard COO triples, entity-id string columns)
+with zero per-record Python. Feature-key → column-id lookups run inside the
+decoder against the native hash store (the PalDBIndexMap analog), in build
+mode (assign on first sight) for training or frozen mode for scoring.
+
+`read_game_data_native` mirrors `ingest.read_game_data` exactly — same
+GameData, same IndexMaps, same first-seen id order — and returns None when
+the schema has a shape the plan compiler doesn't cover (callers then fall
+back to the pure-Python path).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import native
+from photon_tpu.data.avro_io import AvroContainerReader, _schema_type
+from photon_tpu.data.feature_bags import coo_to_matrix
+from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap
+from photon_tpu.data.ingest import GameDataConfig
+from photon_tpu.game.dataset import GameData
+
+# ops understood by the C++ decoder (see photon_native.cc)
+_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_OPT_STR_SKIP, _OP_ENTITY, _OP_BAG, \
+    _OP_STR_SKIP, _OP_LONG_SKIP = range(7)
+
+
+def _is_opt(schema, inner: str) -> bool:
+    """union [null, inner] with null as branch 0 (what the decoder assumes)."""
+    return (isinstance(schema, list) and len(schema) == 2
+            and _schema_type(schema[0]) == "null"
+            and _schema_type(schema[1]) == inner)
+
+
+def _ntv_value_kind(items) -> Optional[int]:
+    """0=double, 1=float when items is a NameTermValue-shaped record."""
+    if _schema_type(items) != "record":
+        return None
+    fields = items.get("fields", [])
+    if len(fields) != 3:
+        return None
+    names = [f["name"] for f in fields]
+    types = [_schema_type(f["type"]) for f in fields]
+    if names != ["name", "term", "value"] or types[:2] != ["string", "string"]:
+        return None
+    return {"double": 0, "float": 1}.get(types[2])
+
+
+def compile_plan(schema, config: GameDataConfig):
+    """Schema → (ops, aux, vkinds, bag names) or None if not plannable."""
+    if _schema_type(schema) != "record":
+        return None
+    scalar_slots = {config.response_field: 0, config.offset_field: 1,
+                    config.weight_field: 2}
+    entity_idx = {e: i for i, e in enumerate(config.entity_fields)}
+    ops, aux, vkinds, bag_names = [], [], [], []
+    for f in schema["fields"]:
+        name, t = f["name"], f["type"]
+        ts = _schema_type(t)
+        if name in scalar_slots:
+            if ts == "double":
+                ops.append(_OP_DOUBLE)
+            elif _is_opt(t, "double"):
+                ops.append(_OP_OPT_DOUBLE)
+            else:
+                return None
+            aux.append(scalar_slots[name])
+        elif name in entity_idx:
+            if not _is_opt(t, "string"):
+                return None
+            ops.append(_OP_ENTITY)
+            aux.append(entity_idx[name])
+        elif ts == "array":
+            vk = _ntv_value_kind(t["items"] if isinstance(t, dict) else None)
+            if vk is None:
+                return None
+            ops.append(_OP_BAG)
+            aux.append(len(bag_names))
+            vkinds.append(vk)
+            bag_names.append(name)
+        elif ts == "string":
+            ops.append(_OP_STR_SKIP)
+            aux.append(0)
+        elif _is_opt(t, "string"):
+            ops.append(_OP_OPT_STR_SKIP)
+            aux.append(0)
+        elif ts in ("long", "int"):
+            ops.append(_OP_LONG_SKIP)
+            aux.append(0)
+        else:
+            return None
+    required = {b for cfg in config.shards.values() for b in cfg.bags}
+    if not required.issubset(bag_names):
+        return None  # a configured bag is missing from the schema
+    return ops, aux, vkinds, bag_names
+
+
+def read_game_data_native(
+    path,
+    config: GameDataConfig,
+    index_maps: Optional[dict] = None,
+    sparse_k: Optional[int] = None,
+):
+    """Native-decoder twin of ingest.read_game_data; None when inapplicable."""
+    if not native.available():
+        return None
+    import os
+
+    paths = ([os.path.join(path, n) for n in sorted(os.listdir(path))
+              if n.endswith(".avro")] if os.path.isdir(path) else [path])
+    if not paths:
+        return None
+    readers = [AvroContainerReader(p) for p in paths]
+    plan0 = compile_plan(readers[0].schema, config)
+    if plan0 is None:
+        return None
+    ops, aux, vkinds, bag_names = plan0
+
+    shard_names = list(config.shards)
+    index_maps = dict(index_maps or {})
+    stores, build_flags = [], []
+    for s in shard_names:
+        imap = index_maps.get(s)
+        if imap is None:
+            stores.append(native.NativeIndexStore(capacity_hint=1024))
+            build_flags.append(True)
+        else:
+            keys = imap.keys_in_order()
+            if imap.has_intercept:
+                keys = keys[:-1]
+            stores.append(native.NativeIndexStore.from_keys(keys))
+            build_flags.append(False)
+    if len(set(build_flags)) > 1:
+        return None  # mixed build/frozen per call is not supported natively
+    build_mode = build_flags[0] if build_flags else True
+
+    # Store s consumes its shard's bags IN CONFIG ORDER (id-assignment
+    # parity with build_index_map's `for bag in config.bags` loop).
+    sb_off, sb_idx = [0], []
+    for s in shard_names:
+        sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
+        sb_off.append(len(sb_idx))
+    plan = (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
+            np.asarray(vkinds or [0], np.int32),
+            np.asarray(sb_off, np.int32),
+            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
+
+    ys, offs, wts = [], [], []
+    coos = [[] for _ in shard_names]
+    ents = [[] for _ in config.entity_fields]
+    row0 = 0
+    for rd in readers:
+        if compile_plan(rd.schema, config) != plan0:
+            return None  # schema drift across files: fall back
+        for count, payload in rd.blocks():
+            dec = native.decode_block(payload, count, row0, plan, stores,
+                                      build_mode)
+            if not dec.ok:
+                raise ValueError(f"{rd.path}: malformed Avro block")
+            y, y_set = dec.scalars(0)
+            if not y_set.all():
+                raise ValueError(f"{rd.path}: record missing response")
+            off, off_set = dec.scalars(1)
+            wt, wt_set = dec.scalars(2)
+            ys.append(y)
+            offs.append(np.where(off_set, off, 0.0))
+            wts.append(np.where(wt_set, wt, 1.0))
+            for si in range(len(shard_names)):
+                coos[si].append(dec.coo(si))
+            for e in range(len(config.entity_fields)):
+                ents[e].append(dec.entities(e))
+            dec.free()
+            row0 += count
+
+    n = row0
+    y = np.concatenate(ys).astype(np.float32) if ys else np.zeros(0, np.float32)
+    offsets = (np.concatenate(offs).astype(np.float32)
+               if offs else np.zeros(0, np.float32))
+    weights = (np.concatenate(wts).astype(np.float32)
+               if wts else np.ones(0, np.float32))
+
+    shards = {}
+    for si, s in enumerate(shard_names):
+        cfg = config.shards[s]
+        imap = index_maps.get(s)
+        if imap is None:
+            key_to_id = {k: i for i, k in enumerate(stores[si].keys_in_order())}
+            imap = IndexMap(key_to_id, frozen=True,
+                            has_intercept=cfg.has_intercept)
+            if cfg.has_intercept:
+                imap.index_of(INTERCEPT_KEY)  # no-op id; records metadata
+            index_maps[s] = imap
+        rows = np.concatenate([c[0] for c in coos[si]]) if coos[si] else \
+            np.zeros(0, np.int64)
+        cols = np.concatenate([c[1] for c in coos[si]]).astype(np.int64) \
+            if coos[si] else np.zeros(0, np.int64)
+        vals = np.concatenate([c[2] for c in coos[si]]) if coos[si] else \
+            np.zeros(0, np.float32)
+        if cfg.has_intercept:
+            rows = np.concatenate([rows, np.arange(n, dtype=np.int64)])
+            cols = np.concatenate(
+                [cols, np.full(n, imap.intercept_id, np.int64)])
+            vals = np.concatenate([vals, np.ones(n, np.float32)])
+        shards[s] = coo_to_matrix(rows, cols, vals, n, imap.n_features,
+                                  cfg.dense_threshold, k=sparse_k)
+
+    ids = {}
+    for e_i, e in enumerate(config.entity_fields):
+        col = (np.concatenate(ents[e_i]) if ents[e_i]
+               else np.zeros(0, object))
+        if any(v is None for v in col):  # null union branch, like Python path
+            raise ValueError(f"records missing entity id {e!r}")
+        ids[e] = np.asarray([str(v) for v in col])
+    return GameData(y, weights, offsets, shards, ids), index_maps

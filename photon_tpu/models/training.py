@@ -16,6 +16,7 @@ pattern explicitly.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -119,6 +120,17 @@ def solve(
     )
 
 
+@partial(jax.jit, static_argnames=("config", "variance"))
+def _train_run(batch, w0, obj, config, variance):
+    """Module-level jitted solve+variance runner. Objective is a pytree
+    argument (ops/objective.py registration), so repeated train_glm calls on
+    same-shaped data hit the jit cache instead of retracing — per-call
+    retrace of the solver loop (with its pallas kernel) costs ~2s on TPU."""
+    res = solve(obj, batch, w0, config)
+    var = compute_variances(obj, res.w, batch, variance)
+    return res, var
+
+
 def train_glm(
     batch: GLMBatch,
     task: TaskType,
@@ -204,13 +216,7 @@ def train_glm(
         # the batch anyway (lane-unaligned d on TPU).
         batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
-    @jax.jit
-    def _run(batch, w0):
-        res = solve(obj, batch, w0, config)
-        var = compute_variances(obj, res.w, batch, variance)
-        return res, var
-
-    res, var = _run(batch, w0)
+    res, var = _train_run(batch, w0, obj, config, variance)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))

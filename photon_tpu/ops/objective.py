@@ -227,3 +227,16 @@ class Objective:
         if self.prior_full_precision is not None:
             H = H + self.prior_full_precision
         return H
+
+
+# Pytree registration: array-valued fields are leaves; task/l2/axis_name/
+# fused are static metadata. This lets an Objective cross jit boundaries as
+# an ARGUMENT, so module-level jitted runners (models/training._train_run)
+# cache by treedef+shape instead of retracing per closure — the difference
+# between one trace per program shape and one trace per train_glm() call.
+jax.tree_util.register_dataclass(
+    Objective,
+    data_fields=["reg_mask", "prior_mean", "prior_precision",
+                 "prior_full_precision", "norm_factors", "norm_shifts"],
+    meta_fields=["task", "l2", "axis_name", "fused"],
+)

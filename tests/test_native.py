@@ -1,0 +1,177 @@
+"""Native C++ layer: hash store, PalDB index map, Avro block decoder.
+
+Every test asserts exact agreement with the pure-Python implementations —
+the native layer is a fast path, never a semantic fork. Skipped wholesale
+when the toolchain is unavailable (callers fall back the same way).
+"""
+import numpy as np
+import pytest
+
+from photon_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+from photon_tpu.data.avro_io import read_avro, write_avro  # noqa: E402
+from photon_tpu.data.feature_bags import FeatureShardConfig  # noqa: E402
+from photon_tpu.data.index_map import (  # noqa: E402
+    INTERCEPT_KEY,
+    IndexMap,
+    PalDBIndexMap,
+    feature_key,
+)
+from photon_tpu.data.ingest import (  # noqa: E402
+    GameDataConfig,
+    read_game_data,
+    records_to_game_data,
+    training_example_schema,
+)
+from photon_tpu.data.matrix import SparseRows  # noqa: E402
+
+
+class TestNativeStore:
+    def test_insert_get_roundtrip(self, rng):
+        s = native.NativeIndexStore(capacity_hint=8)
+        keys = [f"f{i}\x01t{i % 7}" for i in range(500)]
+        ids = s.insert_batch(keys)
+        np.testing.assert_array_equal(ids, np.arange(500))
+        assert len(s) == 500
+        np.testing.assert_array_equal(s.lookup_batch(keys), np.arange(500))
+        assert s.get("missing") == -1
+        # re-insert returns existing ids
+        np.testing.assert_array_equal(s.insert_batch(keys[:10]),
+                                      np.arange(10))
+
+    def test_save_open_mmap(self, tmp_path):
+        s = native.NativeIndexStore.from_keys(["a", "b\x01t", "c"])
+        p = tmp_path / "store.phidx"
+        s.save(p)
+        s2 = native.NativeIndexStore.open(p)
+        assert len(s2) == 3
+        assert s2.get("b\x01t") == 1
+        assert s2.keys_in_order() == ["a", "b\x01t", "c"]
+        # mapped stores are frozen: insert degrades to lookup
+        assert s2.insert("nope") == -1
+
+    def test_paldb_matches_index_map(self, tmp_path):
+        imap = IndexMap()
+        for i in range(100):
+            imap.index_of(feature_key(f"f{i}", f"t{i % 3}"))
+        imap.index_of(INTERCEPT_KEY)
+        imap.freeze()
+        pal = PalDBIndexMap.build(imap)
+        assert pal.n_features == imap.n_features
+        assert pal.intercept_id == imap.intercept_id
+        for k in imap.keys_in_order():
+            assert pal.get(k) == imap.get(k)
+        assert pal.get("absent") == IndexMap.NULL_ID
+        p = tmp_path / "pal.bin"
+        pal.save(p)
+        pal2 = PalDBIndexMap.open(p)
+        assert pal2.keys_in_order() == imap.keys_in_order()
+        assert pal2.to_index_map().key_to_id == imap.key_to_id
+
+
+def _fixture_records(rng, n=200):
+    recs = []
+    for i in range(n):
+        feats = [{"name": f"f{j}", "term": ("" if j % 3 == 0 else f"t{j % 5}"),
+                  "value": float(rng.normal())}
+                 for j in rng.choice(30, size=rng.integers(1, 8),
+                                     replace=False)]
+        recs.append({
+            "response": float(i % 2),
+            "offset": None if i % 4 else 0.25,
+            "weight": None if i % 3 else 2.0,
+            "uid": f"u{i}",
+            "userId": f"user{i % 11}",
+            "features": feats,
+            "ctx": [{"name": "c", "term": "", "value": 1.0 + i}],
+        })
+    return recs
+
+
+@pytest.fixture
+def avro_file(tmp_path, rng):
+    schema = training_example_schema(feature_bags=("features", "ctx"),
+                                     entity_fields=("userId",))
+    recs = _fixture_records(rng)
+    path = tmp_path / "train.avro"
+    write_avro(path, recs, schema, codec="deflate", block_records=64)
+    return path
+
+
+@pytest.fixture
+def gd_config():
+    return GameDataConfig(
+        shards={"global": FeatureShardConfig(bags=("features", "ctx")),
+                # bag order REVERSED vs the schema's field order: id
+                # assignment must still follow config order, like the
+                # Python build_index_map loop.
+                "rev": FeatureShardConfig(bags=("ctx", "features")),
+                "per_user": FeatureShardConfig(bags=("ctx",),
+                                               has_intercept=False)},
+        entity_fields=("userId",),
+    )
+
+
+def _assert_same(gd_n, maps_n, gd_p, maps_p):
+    np.testing.assert_array_equal(gd_n.y, gd_p.y)
+    np.testing.assert_array_equal(gd_n.weights, gd_p.weights)
+    np.testing.assert_array_equal(gd_n.offsets, gd_p.offsets)
+    assert set(gd_n.shards) == set(gd_p.shards)
+    for s in gd_p.shards:
+        assert maps_n[s].keys_in_order() == maps_p[s].keys_in_order()
+        Xn, Xp = gd_n.shards[s], gd_p.shards[s]
+        if isinstance(Xp, SparseRows):
+            np.testing.assert_array_equal(np.asarray(Xn.indices),
+                                          np.asarray(Xp.indices))
+            np.testing.assert_allclose(np.asarray(Xn.values),
+                                       np.asarray(Xp.values), rtol=1e-6)
+        else:
+            np.testing.assert_allclose(np.asarray(Xn), np.asarray(Xp),
+                                       rtol=1e-6)
+    for e in gd_p.entity_ids:
+        np.testing.assert_array_equal(gd_n.entity_ids[e], gd_p.entity_ids[e])
+
+
+class TestNativeIngest:
+    def test_matches_python_build_mode(self, avro_file, gd_config):
+        gd_p, maps_p = read_game_data(avro_file, gd_config, use_native=False)
+        gd_n, maps_n = read_game_data(avro_file, gd_config, use_native=True)
+        _assert_same(gd_n, maps_n, gd_p, maps_p)
+
+    def test_matches_python_frozen_mode(self, avro_file, gd_config):
+        _, maps = read_game_data(avro_file, gd_config, use_native=False)
+        gd_p, _ = read_game_data(avro_file, gd_config, index_maps=maps,
+                                 use_native=False)
+        gd_n, _ = read_game_data(avro_file, gd_config, index_maps=maps,
+                                 use_native=True)
+        _assert_same(gd_n, maps, gd_p, maps)
+
+    def test_unplannable_schema_falls_back(self, tmp_path, gd_config, rng):
+        # A record field type the plan compiler refuses (map) → native path
+        # returns None and read_game_data(use_native=True) raises.
+        schema = training_example_schema(feature_bags=("features", "ctx"),
+                                         entity_fields=("userId",))
+        schema["fields"].append(
+            {"name": "extra", "type": {"type": "map", "values": "double"}})
+        recs = [dict(r, extra={"k": 1.0}) for r in _fixture_records(rng, 10)]
+        path = tmp_path / "odd.avro"
+        write_avro(path, recs, schema)
+        with pytest.raises(RuntimeError):
+            read_game_data(path, gd_config, use_native=True)
+        gd, _ = read_game_data(path, gd_config)  # auto-fallback works
+        assert gd.y.shape == (10,)
+
+    def test_null_codec_and_dir_input(self, tmp_path, gd_config, rng):
+        schema = training_example_schema(feature_bags=("features", "ctx"),
+                                         entity_fields=("userId",))
+        recs = _fixture_records(rng, 120)
+        d = tmp_path / "data"
+        d.mkdir()
+        write_avro(d / "part-0.avro", recs[:50], schema, codec="null")
+        write_avro(d / "part-1.avro", recs[50:], schema, codec="null")
+        gd_p, maps_p = read_game_data(d, gd_config, use_native=False)
+        gd_n, maps_n = read_game_data(d, gd_config, use_native=True)
+        _assert_same(gd_n, maps_n, gd_p, maps_p)
