@@ -56,7 +56,9 @@ class Objective:
 
     task: TaskType
     l2: float = 0.0
-    axis_name: Optional[str] = None
+    # Mesh axis (or tuple of axes — hybrid ICI×DCN meshes psum over both,
+    # lowered hierarchically by XLA) for the gradient all-reduce.
+    axis_name: Optional[str | tuple] = None
     # Use the pallas fused single-pass kernel (ops/fused.py) for
     # value_and_grad when the batch qualifies (dense X, no normalization).
     # Set by train_glm; leave False for vmapped per-entity solves.

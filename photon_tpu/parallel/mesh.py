@@ -1,15 +1,27 @@
-"""Device-mesh helpers.
+"""Device-mesh helpers: single-slice ICI meshes and multi-host ICI×DCN.
 
-The reference distributes with Spark RDD partitions; photon-tpu uses a
-`jax.sharding.Mesh`. Conventions:
+The reference distributes with Spark RDD partitions over an Ethernet
+cluster; photon-tpu uses a `jax.sharding.Mesh` and lets XLA place the
+collectives. Conventions:
 
 - axis ``"data"``: examples are sharded across it; gradient aggregation is
   a `psum` over this axis (the `treeAggregate` analog,
   reference: DistributedGLMLossFunction.calculate gradient treeAggregate).
+  On a single slice this all-reduce rides the ICI.
+- axis ``"replica"`` (multi-host): the slower DCN axis between slices/hosts.
+  Examples shard over BOTH axes (`P(("replica", "data"))`) — each slice
+  holds a contiguous row range, split again across its chips. A gradient
+  psum over ``("replica", "data")`` lowers to a hierarchical all-reduce:
+  reduce inside the slice over ICI first, then once across DCN per slice —
+  the (d,)-vector crossing DCN once per iteration instead of the whole
+  batch, exactly the reference's executor-tree→driver aggregation shape but
+  compiler-scheduled.
 - axis ``"entity"`` (optional, for very large random-effect spaces):
   per-entity model blocks are sharded across it.
 """
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
@@ -26,9 +38,90 @@ def make_mesh(data_axis: str = "data", n_devices: int | None = None,
     return Mesh(np.asarray(devices), (data_axis,))
 
 
-def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Shard the leading (example) dimension across the data axis."""
-    return NamedSharding(mesh, P(axis))
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> bool:
+    """Bring up the multi-host runtime (jax.distributed) — the analog of the
+    reference's Spark driver/executor bootstrap, except the transport is
+    XLA's DCN-aware runtime rather than RPC to a driver.
+
+    With no arguments, defers entirely to `jax.distributed.initialize()`'s
+    own cluster auto-detection (Cloud TPU pod metadata, SLURM, the JAX_*
+    env vars) — a plain single-process environment fails that detection and
+    returns False. With explicit arguments they are passed through. Returns
+    True when a multi-process runtime was initialized.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if not kwargs and os.environ.get("JAX_COORDINATOR_ADDRESS") is None \
+            and not _cluster_detectable():
+        return False
+    try:
+        jax.distributed.initialize(**kwargs)
+        return True
+    except (RuntimeError, ValueError):
+        # no detectable cluster / already initialized single-process run
+        return False
+
+
+def _cluster_detectable() -> bool:
+    """Whether JAX's ClusterEnv auto-detection would find a cluster, without
+    paying its (possibly blocking) metadata queries in plain local runs."""
+    try:
+        from jax._src.clusters import ClusterEnv
+
+        return any(c.is_env_present() for c in ClusterEnv._cluster_types)
+    except Exception:
+        return False
+
+
+def make_hybrid_mesh(n_replicas: int | None = None,
+                     dcn_axis: str = "replica", ici_axis: str = "data",
+                     devices=None) -> Mesh:
+    """A 2-D (replica × data) mesh with the replica axis on DCN.
+
+    Multi-host: uses `mesh_utils.create_hybrid_device_mesh`, which orders
+    devices so that the ``dcn_axis`` strides across slices (DCN) and the
+    ``ici_axis`` stays inside each slice (ICI) — a psum over ``ici_axis``
+    then never leaves the slice, and a psum over both axes lowers
+    hierarchically. Single-host (tests, virtual CPU meshes): plain reshape,
+    which preserves the same program semantics without the topology.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_replicas is None:
+        # One replica per slice on multi-slice topologies; otherwise one per
+        # host process (single-slice pods / CPU test meshes).
+        n_replicas = n_slices if n_slices > 1 else max(jax.process_count(), 1)
+    n = len(devices)
+    if n % n_replicas != 0:
+        raise ValueError(f"{n} devices do not divide into "
+                         f"{n_replicas} replicas")
+    per = n // n_replicas
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (per,), (n_replicas,), devices=devices)
+        grid = grid.reshape(n_replicas, per)
+    else:
+        grid = np.asarray(devices).reshape(n_replicas, per)
+    return Mesh(grid, (dcn_axis, ici_axis))
+
+
+def data_sharding(mesh: Mesh, axis=None) -> NamedSharding:
+    """Shard the leading (example) dimension across ALL mesh axes (for a
+    hybrid mesh: slice-major over DCN, chip-minor over ICI), or across the
+    given axis/axes only."""
+    spec = tuple(mesh.axis_names) if axis is None else axis
+    return NamedSharding(mesh, P(spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
