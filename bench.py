@@ -33,9 +33,13 @@ MAX_ITERS = 40
 
 
 def make_problem(seed: int = 0):
+    # Full-strength planted signal + weak regularization: the solve stays
+    # below the f32 precision floor for the whole MAX_ITERS budget, so the
+    # metric measures steady-state iteration throughput rather than how
+    # quickly the solver runs out of representable progress.
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
-    w_true = rng.normal(size=N_FEATURES).astype(np.float32) / np.sqrt(N_FEATURES)
+    w_true = rng.normal(size=N_FEATURES).astype(np.float32)
     p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
     y = (rng.uniform(size=N_ROWS) < p).astype(np.float32)
     return make_batch(X, y)
@@ -52,7 +56,7 @@ def run_once(batch, config):
 
 def main() -> None:
     config = OptimizerConfig(max_iters=MAX_ITERS, tolerance=0.0,
-                             reg=l2(), reg_weight=1.0)
+                             reg=l2(), reg_weight=1e-4)
     # Device-resident batch: the metric is training throughput (the Spark
     # baseline likewise excludes HDFS ingest), so host->device transfer is
     # outside the timed region.

@@ -31,7 +31,7 @@ from photon_tpu.models.variance import VarianceComputationType, compute_variance
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.ops.objective import Objective
 from photon_tpu.optim.config import OptimizerConfig, OptimizerType
-from photon_tpu.optim.lbfgs import minimize_lbfgs
+from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.tron import minimize_tron
 from photon_tpu.optim.tracker import OptResult
@@ -113,8 +113,11 @@ def solve(
             max_iters=config.max_iters, tolerance=config.tolerance,
             cg_max_iters=config.cg_max_iters,
         )
-    return minimize_lbfgs(
-        vg, w0,
+    # Smooth solves use the margin-cached L-BFGS: the GLM margin is linear
+    # in w, so line-search evaluations run elementwise on cached (z, dz) —
+    # two X passes per iteration total instead of two per evaluation.
+    return minimize_lbfgs_margin(
+        obj, batch, w0,
         max_iters=config.max_iters, tolerance=config.tolerance,
         history=config.history,
     )
@@ -125,7 +128,7 @@ def _train_run(batch, w0, obj, config, variance):
     """Module-level jitted solve+variance runner. Objective is a pytree
     argument (ops/objective.py registration), so repeated train_glm calls on
     same-shaped data hit the jit cache instead of retracing — per-call
-    retrace of the solver loop (with its pallas kernel) costs ~2s on TPU."""
+    retrace of the solver loop costs ~2s on TPU."""
     res = solve(obj, batch, w0, config)
     var = compute_variances(obj, res.w, batch, variance)
     return res, var
@@ -191,16 +194,21 @@ def train_glm(
         f = np.asarray(norm.factors) if norm.factors is not None else 1.0
         prior_precision = jnp.asarray(
             np.asarray(prior_precision, np.float32) * f * f)
-    # Single-device dense solves use the pallas fused value+grad kernel (one
-    # X pass per evaluation; ops/fused.py). Mesh solves keep the jnp path —
-    # XLA's SPMD partitioner cannot shard a pallas custom call, so the fused
-    # kernel under a mesh is only reachable through the explicit
-    # shard_map/axis_name route (Objective(axis_name=..., fused=True)).
+    # Single-device dense OWLQN/TRON solves use the pallas fused value+grad
+    # kernel (one X pass per evaluation; ops/fused.py). L-BFGS instead goes
+    # through the margin-cached solver, which never calls value_and_grad —
+    # its per-pass matvec/rmatvec are already single X passes. Mesh solves
+    # keep the jnp path — XLA's SPMD partitioner cannot shard a pallas
+    # custom call; under a mesh the fused kernel is only reachable through
+    # the explicit shard_map/axis_name route (Objective(axis_name=...,
+    # fused=True)).
+    use_fused = (mesh is None
+                 and config.effective_optimizer() is not OptimizerType.LBFGS)
     obj = make_objective(task, config, d,
                          prior_mean=prior_mean, prior_precision=prior_precision,
                          normalization=norm,
                          prior_full_precision=prior_full_precision,
-                         fused=(mesh is None))
+                         fused=use_fused)
 
     if mesh is not None:
         n_dev = mesh.devices.size

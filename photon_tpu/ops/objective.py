@@ -157,12 +157,56 @@ class Objective:
             grad = self._psum(gX)
             rv, rg = self._reg_terms(w)
             return value + rv, grad + rg
+        return self.value_and_grad_at_margin(w, self._margin(w, batch), batch)
+
+    # ------------------------------------------------ margin-space API
+    # The margin is LINEAR in w: z(w + a·p) = z(w) + a·dz with dz the
+    # direction's margin. The margin-cached L-BFGS (optim/lbfgs.py,
+    # minimize_lbfgs_margin) exploits this: line-search evaluations become
+    # elementwise work on cached (z, dz) — no pass over X — so a full
+    # iteration costs exactly two X passes (dz and the accepted gradient)
+    # regardless of how many step lengths the Wolfe search tries. The
+    # reference pays a full treeAggregate per Breeze line-search evaluation.
+
+    def margin(self, w, batch: GLMBatch):
+        """z(w): the per-row margin, LOCAL to this shard."""
+        return self._margin(w, batch)
+
+    def direction_margin(self, p, batch: GLMBatch):
+        """dz = ∂z/∂w · p (offset-free margin of the direction), LOCAL."""
+        return self._margin_of_eff(
+            self._eff_w(p),
+            batch._replace(offsets=jnp.zeros_like(batch.offsets)))
+
+    def phi_at(self, z, dz, a, w, p, batch: GLMBatch):
+        """(φ(a), φ'(a)) along w + a·p from cached margins — one elementwise
+        pass plus two scalar psums; zero passes over X."""
         loss, d1, _ = loss_fns(self.task)
-        z = self._margin(w, batch)
-        g = batch.weights * d1(z, batch.y)
-        local_value = jnp.sum(batch.weights * loss(z, batch.y))
-        gX, gsum = self._backprop(batch, g)
-        value = self._psum(local_value)
+        za = z + a * dz
+        wl = batch.weights * loss(za, batch.y)
+        wd = batch.weights * d1(za, batch.y) * dz
+        f = self._psum(jnp.sum(wl))
+        dphi = self._psum(jnp.sum(wd))
+        wa = w + a * p
+        rv, rg = self._reg_terms(wa)
+        return f + rv, dphi + jnp.dot(rg, p)
+
+    def grad_at_margin(self, w, z, batch: GLMBatch):
+        """Full gradient from a cached margin — ONE pass over X (Xᵀr)."""
+        _, d1, _ = loss_fns(self.task)
+        r = batch.weights * d1(z, batch.y)
+        gX, gsum = self._backprop(batch, r)
+        grad = self._finish_backprop(
+            self._psum(gX), None if gsum is None else self._psum(gsum))
+        _, rg = self._reg_terms(w)
+        return grad + rg
+
+    def value_and_grad_at_margin(self, w, z, batch: GLMBatch):
+        """(f, g) from a cached margin — one elementwise pass + one Xᵀr."""
+        loss, d1, _ = loss_fns(self.task)
+        r = batch.weights * d1(z, batch.y)
+        value = self._psum(jnp.sum(batch.weights * loss(z, batch.y)))
+        gX, gsum = self._backprop(batch, r)
         grad = self._finish_backprop(
             self._psum(gX), None if gsum is None else self._psum(gsum))
         rv, rg = self._reg_terms(w)
