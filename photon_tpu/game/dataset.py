@@ -210,6 +210,7 @@ class RandomEffectDataset:
         min_block_rows: int = 4,
         seed: int = 0,
         projection=None,
+        max_blocks: int = 3,
     ) -> "RandomEffectDataset":
         X = data.shards[shard_name]
         raw = np.asarray(data.entity_ids[entity_name])
@@ -239,6 +240,21 @@ class RandomEffectDataset:
         for e in range(E):
             m = _next_pow2(max(int(active_counts[e]), 1), min_block_rows)
             buckets.setdefault(m, []).append(e)
+
+        # Each distinct block shape costs one solver compile (~tens of
+        # seconds on TPU via the remote compiler) while padded-row compute in
+        # the vmapped solves is nearly free — so greedily merge adjacent
+        # power-of-two buckets (padding the smaller one up) until at most
+        # ``max_blocks`` shapes remain. Merge the pair that adds the fewest
+        # padded row-slots.
+        if max_blocks < 1:
+            raise ValueError(f"max_blocks must be >= 1, got {max_blocks}")
+        while len(buckets) > max_blocks:
+            sizes = sorted(buckets)
+            costs = [len(buckets[sizes[i]]) * (sizes[i + 1] - sizes[i])
+                     for i in range(len(sizes) - 1)]
+            i = int(np.argmin(costs))
+            buckets[sizes[i + 1]] = buckets.pop(sizes[i]) + buckets[sizes[i + 1]]
 
         # Optional feature-space projection (reference:
         # projector.* / RandomEffectDatasetInProjectedSpace).

@@ -43,6 +43,23 @@ def _pad_axis0(tree, target: int):
     return jax.tree_util.tree_map(pad, tree)
 
 
+def _slice_axis0(tree, start: int, size: int):
+    return jax.tree_util.tree_map(lambda x: x[start:start + size], tree)
+
+
+# XLA-TPU compile time grows superlinearly in the vmapped lane count (~3s at
+# 512 lanes, ~100s at 39k), so big entity blocks are solved in fixed-size
+# lane chunks: one compile per block SHAPE, many cheap dispatches.
+_MAX_SOLVE_LANES = 4096
+
+
+def _next_pow2_int(x: int) -> int:
+    m = 1
+    while m < x:
+        m <<= 1
+    return m
+
+
 @dataclasses.dataclass
 class RETrainStats:
     """Per-train diagnostics (reference: per-entity OptimizationTracker)."""
@@ -120,6 +137,32 @@ class RandomEffectCoordinate:
         fn = jax.jit(jax.vmap(one_with_prior if with_prior else one))
         self._solvers[key] = fn
         return fn
+
+    def _run_block(self, solver, batch, w0, pm, pp, e_real):
+        """Dispatch one bucket's vmapped solve in lane chunks.
+
+        Chunk size: next power of two of the entity count, capped at
+        _MAX_SOLVE_LANES (and rounded to a mesh multiple) — so every block
+        compiles at a small fixed lane count and large blocks become
+        multiple dispatches of the SAME compiled program.
+        """
+        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        chunk = min(_MAX_SOLVE_LANES, _next_pow2_int(max(e_real, 1)))
+        chunk = pad_to_multiple(chunk, n_dev)
+        e_pad = pad_to_multiple(e_real, chunk)
+        args = (batch, w0) + ((pm, pp) if pm is not None else ())
+        args = _pad_axis0(args, e_pad)
+        outs = []
+        for c0 in range(0, e_pad, chunk):
+            part = _slice_axis0(args, c0, chunk)
+            if self.mesh is not None:
+                part = jax.device_put(part, data_sharding(self.mesh))
+            outs.append(solver(*part))
+        if len(outs) == 1:
+            return outs[0]
+        # None leaves (variance off) are structural and skipped by tree_map.
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *outs)
 
     def train(
         self,
@@ -203,24 +246,9 @@ class RandomEffectCoordinate:
                     pm = jnp.asarray(prior_means[block.entity_index])
                     pp = jnp.asarray(prior_precs[block.entity_index])
             e_real = block.n_entities
-            if self.mesh is not None:
-                n_dev = self.mesh.devices.size
-                e_pad = pad_to_multiple(e_real, n_dev)
-                batch = _pad_axis0(batch, e_pad)
-                w0 = _pad_axis0(w0, e_pad)
-                batch = jax.device_put(batch, data_sharding(self.mesh))
-                w0 = jax.device_put(w0, data_sharding(self.mesh))
-                if pm is not None:
-                    pm = jax.device_put(_pad_axis0(pm, e_pad),
-                                        data_sharding(self.mesh))
-                    pp = jax.device_put(_pad_axis0(pp, e_pad),
-                                        data_sharding(self.mesh))
             d_solve = block.dim if block.dim is not None else d
             solver = self._solver_for(d_solve, pm is not None)
-            if pm is not None:
-                res, var = solver(batch, w0, pm, pp)
-            else:
-                res, var = solver(batch, w0)
+            res, var = self._run_block(solver, batch, w0, pm, pp, e_real)
             w_out = np.asarray(res.w)[:e_real]
             if block.proj is not None:
                 from photon_tpu.game.projector import scatter_rows_into

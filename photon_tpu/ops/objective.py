@@ -221,8 +221,7 @@ class Objective:
         """
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
-        dz = self._margin_of_eff(self._eff_w(v), batch._replace(
-            offsets=jnp.zeros_like(batch.offsets)))
+        dz = self.direction_margin(v, batch)
         g = batch.weights * d2(z, batch.y) * dz
         gX, gsum = self._backprop(batch, g)
         hv = self._finish_backprop(

@@ -181,6 +181,11 @@ def minimize_lbfgs(
     )
 
 
+# Refresh the chained margin from w every this many iterations (f32 drift
+# bound); most solves finish sooner and never pay the extra pass.
+_Z_REFRESH = 64
+
+
 class _MarginState(NamedTuple):
     w: jax.Array
     z: jax.Array  # cached margin z = Xw (+norm/offset terms), shard-local
@@ -257,6 +262,17 @@ def minimize_lbfgs_margin(
 
         w_new = jnp.where(ok, s.w + alpha * direction, s.w)
         z_new = jnp.where(ok, s.z + alpha * dz, s.z)
+        # The chained z accumulates f32 drift vs margin(w); refresh it from
+        # w periodically (one extra X pass every _Z_REFRESH iters) so long
+        # tight-tolerance solves converge on the true objective. lax.cond
+        # keeps the pass free on non-refresh iterations (under vmap it
+        # degrades to one always-on pass, but vmapped per-entity solves are
+        # short and tiny, so the cost is noise there).
+        z_new = lax.cond(
+            (s.it + 1) % _Z_REFRESH == 0,
+            lambda: obj.margin(w_new, batch),
+            lambda: z_new,
+        )
         f_new = jnp.where(ok, f_star, s.f)
         g_new = jnp.where(ok, obj.grad_at_margin(w_new, z_new, batch),  # X pass 2
                           s.g)
