@@ -33,7 +33,7 @@ from photon_tpu.ops.objective import Objective
 from photon_tpu.optim.config import OptimizerConfig, OptimizerType
 from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.owlqn import minimize_owlqn
-from photon_tpu.optim.tron import minimize_tron
+from photon_tpu.optim.tron import minimize_tron_margin
 from photon_tpu.optim.tracker import OptResult
 from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple, replicated
 
@@ -108,8 +108,8 @@ def solve(
             history=config.history, reg_mask=obj.reg_mask,
         )
     if opt is OptimizerType.TRON:
-        return minimize_tron(
-            vg, lambda w, v: obj.hvp(w, batch, v), w0,
+        return minimize_tron_margin(
+            obj, batch, w0,
             max_iters=config.max_iters, tolerance=config.tolerance,
             cg_max_iters=config.cg_max_iters,
         )
@@ -194,16 +194,16 @@ def train_glm(
         f = np.asarray(norm.factors) if norm.factors is not None else 1.0
         prior_precision = jnp.asarray(
             np.asarray(prior_precision, np.float32) * f * f)
-    # Single-device dense OWLQN/TRON solves use the pallas fused value+grad
-    # kernel (one X pass per evaluation; ops/fused.py). L-BFGS instead goes
-    # through the margin-cached solver, which never calls value_and_grad —
-    # its per-pass matvec/rmatvec are already single X passes. Mesh solves
+    # Single-device dense OWL-QN solves use the pallas fused value+grad
+    # kernel (one X pass per evaluation; ops/fused.py). L-BFGS and TRON go
+    # through the margin-cached solvers, which never call value_and_grad —
+    # their per-pass matvec/rmatvec are already single X passes. Mesh solves
     # keep the jnp path — XLA's SPMD partitioner cannot shard a pallas
     # custom call; under a mesh the fused kernel is only reachable through
     # the explicit shard_map/axis_name route (Objective(axis_name=...,
     # fused=True)).
     use_fused = (mesh is None
-                 and config.effective_optimizer() is not OptimizerType.LBFGS)
+                 and config.effective_optimizer() is OptimizerType.OWLQN)
     obj = make_objective(task, config, d,
                          prior_mean=prior_mean, prior_precision=prior_precision,
                          normalization=norm,

@@ -191,6 +191,27 @@ class Objective:
         rv, rg = self._reg_terms(wa)
         return f + rv, dphi + jnp.dot(rg, p)
 
+    def value_at_margin(self, w, z, batch: GLMBatch):
+        """f(w) from a cached margin — elementwise only, no pass over X."""
+        loss, _, _ = loss_fns(self.task)
+        value = self._psum(jnp.sum(batch.weights * loss(z, batch.y)))
+        rv, _ = self._reg_terms(w)
+        return value + rv
+
+    def hvp_at_margin(self, w, z, batch: GLMBatch, v, dz_v=None):
+        """H(w)·v with the margin z cached (Gauss-Newton form): the d2 curve
+        is evaluated on z instead of recomputing X·w, so an HVP costs two X
+        passes (dz_v and the backprop) instead of three. Pass dz_v when the
+        caller already has the direction's margin (TRON's CG does)."""
+        _, _, d2 = loss_fns(self.task)
+        if dz_v is None:
+            dz_v = self.direction_margin(v, batch)
+        g = batch.weights * d2(z, batch.y) * dz_v
+        gX, gsum = self._backprop(batch, g)
+        hv = self._finish_backprop(
+            self._psum(gX), None if gsum is None else self._psum(gsum))
+        return hv + self._reg_hvp(w, v)
+
     def grad_at_margin(self, w, z, batch: GLMBatch):
         """Full gradient from a cached margin — ONE pass over X (Xᵀr)."""
         _, d1, _ = loss_fns(self.task)

@@ -171,6 +171,67 @@ def test_vmapped_per_entity(rng):
                                atol=5e-4)
 
 
+class TestTronMargin:
+    def test_matches_classic_tron(self, rng):
+        from photon_tpu.optim.tron import minimize_tron, minimize_tron_margin
+
+        batch = _problem(rng, n=800, d=10)
+        obj = make_objective(TaskType.LOGISTIC_REGRESSION,
+                             OptimizerConfig(reg=reg.l2(), reg_weight=0.5),
+                             10, intercept_index=None)
+        w0 = jnp.zeros((10,), jnp.float32)
+        classic = minimize_tron(
+            lambda w: obj.value_and_grad(w, batch),
+            lambda w, v: obj.hvp(w, batch, v), w0, tolerance=1e-9,
+            max_iters=100)
+        margin = minimize_tron_margin(obj, batch, w0, tolerance=1e-9,
+                                      max_iters=100)
+        assert bool(margin.converged) and not bool(margin.failed)
+        np.testing.assert_allclose(float(margin.value), float(classic.value),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(margin.w),
+                                   np.asarray(classic.w), atol=1e-3)
+
+    def test_tron_with_normalization(self, rng):
+        from photon_tpu.optim.tron import minimize_tron, minimize_tron_margin
+
+        n, d = 500, 6
+        X = (rng.normal(size=(n, d)) * rng.uniform(0.5, 4, d)).astype(
+            np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        norm = NormalizationContext.build(
+            X, NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            intercept_index=None)
+        obj = make_objective(TaskType.LOGISTIC_REGRESSION,
+                             OptimizerConfig(reg=reg.l2(), reg_weight=0.5),
+                             d, normalization=norm, intercept_index=None)
+        batch = make_batch(X, y)
+        w0 = jnp.zeros((d,), jnp.float32)
+        classic = minimize_tron(
+            lambda w: obj.value_and_grad(w, batch),
+            lambda w, v: obj.hvp(w, batch, v), w0)
+        margin = minimize_tron_margin(obj, batch, w0)
+        np.testing.assert_allclose(float(margin.value), float(classic.value),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(margin.w),
+                                   np.asarray(classic.w), atol=2e-3)
+
+    def test_tron_vmapped(self, rng):
+        from photon_tpu.optim.tron import minimize_tron_margin
+
+        B, n, d = 8, 64, 4
+        X = rng.normal(size=(B, n, d)).astype(np.float32)
+        y = (rng.uniform(size=(B, n)) < 0.5).astype(np.float32)
+        obj = make_objective(TaskType.LOGISTIC_REGRESSION,
+                             OptimizerConfig(reg=reg.l2(), reg_weight=1.0),
+                             d, intercept_index=None)
+        res = jax.jit(jax.vmap(lambda Xb, yb: minimize_tron_margin(
+            obj, make_batch(Xb, yb), jnp.zeros((d,), jnp.float32))))(
+                jnp.asarray(X), jnp.asarray(y))
+        assert res.w.shape == (B, d)
+        assert bool(res.converged.all())
+
+
 def test_train_glm_end_to_end_unchanged(rng):
     """train_glm (now margin-solver-backed) still matches sklearn-grade
     results: planted coefficients recovered."""
