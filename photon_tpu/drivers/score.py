@@ -90,7 +90,8 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     log.info("scoring %d rows with %d coordinates", data.n,
              len(model.coordinates))
 
-    margin = score_game(model, data)  # one pass over every coordinate
+    # Shards on device once; the scoring pass is then a pure device program.
+    margin = score_game(model, data.to_device())
     scores = np.asarray(model.mean(margin) if params.output_mean else margin)
 
     metric = None

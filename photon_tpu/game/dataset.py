@@ -63,6 +63,31 @@ class GameData:
         )
         return GameData(y, weights, offsets, dict(shards), dict(entity_ids or {}))
 
+    def to_device(self, sharding=None) -> "GameData":
+        """GameData with device-resident feature shards.
+
+        Scoring walks the shards once per call; host numpy shards would be
+        re-transferred through PCIe/the tunnel EVERY call (hundreds of MB at
+        scale). Put them on device once and every subsequent score_game /
+        predict_mean is a pure device program. Entity-id columns stay host
+        numpy (they are factorized to int ids before any device work).
+        """
+        import jax
+
+        put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
+            else jax.device_put
+
+        def put_shard(X):
+            if isinstance(X, SparseRows):
+                return SparseRows(put(X.indices), put(X.values), X.n_features)
+            # np (not jnp) conversion: device_put then transfers ONCE,
+            # directly into the target sharding.
+            return put(np.asarray(X, np.float32))
+
+        return GameData(self.y, self.weights, self.offsets,
+                        {k: put_shard(X) for k, X in self.shards.items()},
+                        self.entity_ids)
+
 
 def _shard_dim(X: Matrix) -> int:
     return X.n_features if isinstance(X, SparseRows) else X.shape[1]

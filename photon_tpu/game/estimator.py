@@ -205,6 +205,10 @@ class GameEstimator:
         grid = config_grid or [self.coordinate_configs]
         evaluator = self.evaluator or default_evaluator(self.task)
         dataset_cache, coord_cache = self._caches_for(data)
+        if validation is not None:
+            # One transfer for the whole grid: every grid point scores the
+            # same validation shards.
+            validation = validation.to_device()
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})

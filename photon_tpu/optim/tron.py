@@ -33,6 +33,24 @@ class _CGState(NamedTuple):
     boundary: jax.Array
 
 
+def _cg_step_geometry(p, dvec, Hd, rsq, delta):
+    """One Steihaug step's shared geometry: the CG step length, or the
+    projection to the trust-region boundary on overshoot/negative curvature.
+    Returns (step, take_boundary) — p_new = p + step·dvec either way, which
+    is what lets the margin variant accumulate zp with the same step."""
+    dHd = jnp.dot(dvec, Hd)
+    alpha = rsq / jnp.maximum(dHd, 1e-20)
+    over = jnp.linalg.norm(p + alpha * dvec) >= delta
+    # project to the trust-region boundary along dvec
+    pd = jnp.dot(p, dvec)
+    dd = jnp.dot(dvec, dvec)
+    pp = jnp.dot(p, p)
+    rad = jnp.sqrt(jnp.maximum(pd * pd + dd * (delta * delta - pp), 0.0))
+    theta = (rad - pd) / jnp.maximum(dd, 1e-20)
+    take_boundary = over | (dHd <= 0.0)
+    return jnp.where(take_boundary, theta, alpha), take_boundary
+
+
 def _cg_trust(hvp, g, delta, max_cg: int, tol_factor=0.1):
     """Steihaug-CG: approximately solve H p = -g s.t. ||p|| <= delta."""
     gnorm = jnp.linalg.norm(g)
@@ -43,21 +61,8 @@ def _cg_trust(hvp, g, delta, max_cg: int, tol_factor=0.1):
 
     def body(s: _CGState):
         Hd = hvp(s.dvec)
-        dHd = jnp.dot(s.dvec, Hd)
-        alpha = s.rsq / jnp.maximum(dHd, 1e-20)
-        p_next = s.p + alpha * s.dvec
-        over = jnp.linalg.norm(p_next) >= delta
-        # project to the trust-region boundary along dvec
-        pd = jnp.dot(s.p, s.dvec)
-        dd = jnp.dot(s.dvec, s.dvec)
-        pp = jnp.dot(s.p, s.p)
-        rad = jnp.sqrt(jnp.maximum(pd * pd + dd * (delta * delta - pp), 0.0))
-        theta = (rad - pd) / jnp.maximum(dd, 1e-20)
-        p_bound = s.p + theta * s.dvec
-        neg_curv = dHd <= 0.0
-        take_boundary = over | neg_curv
-        p_new = jnp.where(take_boundary, p_bound, p_next)
-        step = jnp.where(take_boundary, theta, alpha)
+        step, take_boundary = _cg_step_geometry(s.p, s.dvec, Hd, s.rsq, delta)
+        p_new = s.p + step * s.dvec
         r_new = s.r - step * Hd
         rsq_new = jnp.dot(r_new, r_new)
         small = jnp.sqrt(rsq_new) <= cg_tol
@@ -214,18 +219,7 @@ def _cg_trust_margin(obj, w, z, batch, g, delta, max_cg: int,
 
     def body(s: _CGZState):
         Hd = obj.hvp_at_margin(w, z, batch, s.dvec, dz_v=s.dz)
-        dHd = jnp.dot(s.dvec, Hd)
-        alpha = s.rsq / jnp.maximum(dHd, 1e-20)
-        p_next = s.p + alpha * s.dvec
-        over = jnp.linalg.norm(p_next) >= delta
-        pd = jnp.dot(s.p, s.dvec)
-        dd = jnp.dot(s.dvec, s.dvec)
-        pp = jnp.dot(s.p, s.p)
-        rad = jnp.sqrt(jnp.maximum(pd * pd + dd * (delta * delta - pp), 0.0))
-        theta = (rad - pd) / jnp.maximum(dd, 1e-20)
-        neg_curv = dHd <= 0.0
-        take_boundary = over | neg_curv
-        step = jnp.where(take_boundary, theta, alpha)
+        step, take_boundary = _cg_step_geometry(s.p, s.dvec, Hd, s.rsq, delta)
         p_new = s.p + step * s.dvec
         zp_new = s.zp + step * s.dz
         r_new = s.r - step * Hd

@@ -258,6 +258,9 @@ def test_scoring_unseen_entity_contributes_zero(rng):
     np.testing.assert_allclose(scores, fe_scores, atol=1e-6)
     mean = np.asarray(predict_mean(model, new))
     assert ((mean > 0) & (mean < 1)).all()
+    # Device-resident shards score identically (drivers use to_device()).
+    np.testing.assert_allclose(np.asarray(score_game(model, new.to_device())),
+                               scores, atol=1e-6)
 
 
 def test_sparse_re_matches_dense(rng):
