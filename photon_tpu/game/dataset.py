@@ -80,6 +80,11 @@ class GameData:
         def put_shard(X):
             if isinstance(X, SparseRows):
                 return SparseRows(put(X.indices), put(X.values), X.n_features)
+            if isinstance(X, jax.Array):
+                # Idempotent: already-device shards are not round-tripped
+                # through the host (np.asarray of a multi-host sharded array
+                # would even raise).
+                return X if sharding is None else put(X)
             # np (not jnp) conversion: device_put then transfers ONCE,
             # directly into the target sharding.
             return put(np.asarray(X, np.float32))

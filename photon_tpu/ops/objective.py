@@ -79,6 +79,20 @@ class Objective:
             return x
         return lax.psum(x, self.axis_name)
 
+    def _psum_many(self, *xs):
+        """One all-reduce for several partial sums (skipping Nones).
+
+        The reference aggregates (value, gradient) in a single
+        treeAggregate; a variadic psum keeps that one-collective-per-
+        evaluation shape here too (tests/test_multihost.py pins the
+        compiled all-reduce count)."""
+        if self.axis_name is None:
+            return xs
+        present = lax.psum(tuple(x for x in xs if x is not None),
+                           self.axis_name)
+        it = iter(present)
+        return tuple(None if x is None else next(it) for x in xs)
+
     def _eff_w(self, w):
         """Normalized-space coefficients as seen by the data: f∘w."""
         return w if self.norm_factors is None else w * self.norm_factors
@@ -153,8 +167,7 @@ class Objective:
                 and self.norm_shifts is None and can_fuse(batch.X)):
             local_value, gX = fused_value_and_grad(
                 self.task, batch.X, w, batch.y, batch.weights, batch.offsets)
-            value = self._psum(local_value)
-            grad = self._psum(gX)
+            value, grad = self._psum_many(local_value, gX)
             rv, rg = self._reg_terms(w)
             return value + rv, grad + rg
         return self.value_and_grad_at_margin(w, self._margin(w, batch), batch)
@@ -185,8 +198,7 @@ class Objective:
         za = z + a * dz
         wl = batch.weights * loss(za, batch.y)
         wd = batch.weights * d1(za, batch.y) * dz
-        f = self._psum(jnp.sum(wl))
-        dphi = self._psum(jnp.sum(wd))
+        f, dphi = self._psum_many(jnp.sum(wl), jnp.sum(wd))
         wa = w + a * p
         rv, rg = self._reg_terms(wa)
         return f + rv, dphi + jnp.dot(rg, p)
@@ -208,8 +220,7 @@ class Objective:
             dz_v = self.direction_margin(v, batch)
         g = batch.weights * d2(z, batch.y) * dz_v
         gX, gsum = self._backprop(batch, g)
-        hv = self._finish_backprop(
-            self._psum(gX), None if gsum is None else self._psum(gsum))
+        hv = self._finish_backprop(*self._psum_many(gX, gsum))
         return hv + self._reg_hvp(w, v)
 
     def grad_at_margin(self, w, z, batch: GLMBatch):
@@ -217,8 +228,7 @@ class Objective:
         _, d1, _ = loss_fns(self.task)
         r = batch.weights * d1(z, batch.y)
         gX, gsum = self._backprop(batch, r)
-        grad = self._finish_backprop(
-            self._psum(gX), None if gsum is None else self._psum(gsum))
+        grad = self._finish_backprop(*self._psum_many(gX, gsum))
         _, rg = self._reg_terms(w)
         return grad + rg
 
@@ -226,10 +236,10 @@ class Objective:
         """(f, g) from a cached margin — one elementwise pass + one Xᵀr."""
         loss, d1, _ = loss_fns(self.task)
         r = batch.weights * d1(z, batch.y)
-        value = self._psum(jnp.sum(batch.weights * loss(z, batch.y)))
         gX, gsum = self._backprop(batch, r)
-        grad = self._finish_backprop(
-            self._psum(gX), None if gsum is None else self._psum(gsum))
+        value, gX, gsum = self._psum_many(
+            jnp.sum(batch.weights * loss(z, batch.y)), gX, gsum)
+        grad = self._finish_backprop(gX, gsum)
         rv, rg = self._reg_terms(w)
         return value + rv, grad + rg
 
@@ -245,8 +255,7 @@ class Objective:
         dz = self.direction_margin(v, batch)
         g = batch.weights * d2(z, batch.y) * dz
         gX, gsum = self._backprop(batch, g)
-        hv = self._finish_backprop(
-            self._psum(gX), None if gsum is None else self._psum(gsum))
+        hv = self._finish_backprop(*self._psum_many(gX, gsum))
         return hv + self._reg_hvp(w, v)
 
     def hess_diag(self, w, batch: GLMBatch):
@@ -259,12 +268,13 @@ class Objective:
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
         w2 = batch.weights * d2(z, batch.y)
-        diag = self._psum(sq_rmatvec(batch.X, w2))
         if self.norm_shifts is not None:
-            xw2 = self._psum(rmatvec(batch.X, w2))
-            w2sum = self._psum(jnp.sum(w2))
+            diag, xw2, w2sum = self._psum_many(
+                sq_rmatvec(batch.X, w2), rmatvec(batch.X, w2), jnp.sum(w2))
             s = self.norm_shifts
             diag = diag - 2.0 * s * xw2 + s * s * w2sum
+        else:
+            diag = self._psum(sq_rmatvec(batch.X, w2))
         if self.norm_factors is not None:
             diag = diag * self.norm_factors * self.norm_factors
         return diag + self._reg_hess_diag(w)
@@ -279,12 +289,13 @@ class Objective:
         _, _, d2 = loss_fns(self.task)
         z = self._margin(w, batch)
         w2 = batch.weights * d2(z, batch.y)
-        H = self._psum(weighted_gram(batch.X, w2))
         if self.norm_shifts is not None:
-            q = self._psum(rmatvec(batch.X, w2))
-            w2sum = self._psum(jnp.sum(w2))
+            H, q, w2sum = self._psum_many(
+                weighted_gram(batch.X, w2), rmatvec(batch.X, w2), jnp.sum(w2))
             s = self.norm_shifts
             H = H - jnp.outer(s, q) - jnp.outer(q, s) + w2sum * jnp.outer(s, s)
+        else:
+            H = self._psum(weighted_gram(batch.X, w2))
         if self.norm_factors is not None:
             H = H * jnp.outer(self.norm_factors, self.norm_factors)
         mask = self.reg_mask if self.reg_mask is not None else 1.0

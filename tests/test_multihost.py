@@ -89,6 +89,29 @@ def test_hierarchical_psum_gradient(rng, hybrid_mesh):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_single_all_reduce_per_evaluation(rng, mesh8):
+    """Pins the communication pattern: one value_and_grad under shard_map
+    compiles to exactly ONE all-reduce (value and gradient partial sums ride
+    the same fused collective — the reference's single treeAggregate)."""
+    X, y = _logistic(rng, n=512, d=6)
+    batch = make_batch(X, y)
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=0.5,
+                    axis_name="data")
+
+    @jax.jit
+    def vg(batch, w):
+        return shard_map(
+            lambda b, w: obj.value_and_grad(w, b), mesh=mesh8,
+            in_specs=(P("data"), P()), out_specs=(P(), P()))(batch, w)
+
+    compiled = vg.lower(
+        jax.device_put(batch, NamedSharding(mesh8, P("data"))),
+        jax.device_put(jnp.zeros(6), NamedSharding(mesh8, P()))).compile()
+    n_ar = sum(1 for line in compiled.as_text().splitlines()
+               if "= " in line and "all-reduce(" in line)
+    assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
+
+
 def test_padding_divides_hybrid_mesh(hybrid_mesh):
     n_dev = hybrid_mesh.devices.size
     assert pad_to_multiple(1000, n_dev) % n_dev == 0
