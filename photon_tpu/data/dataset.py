@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.matrix import Matrix, SparseRows
+from photon_tpu.data.matrix import HybridRows, Matrix, SparseRows
 
 
 class GLMBatch(NamedTuple):
@@ -34,7 +34,7 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
         weights = jnp.ones((n,), jnp.float32)
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
-    if not isinstance(X, SparseRows):
+    if not isinstance(X, (SparseRows, HybridRows)):
         X = jnp.asarray(X, jnp.float32)
     return GLMBatch(X, y, jnp.asarray(weights, jnp.float32),
                     jnp.asarray(offsets, jnp.float32))
@@ -47,7 +47,16 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
         return batch
     extra = target_n - n
     X = batch.X
-    if isinstance(X, SparseRows):
+    if isinstance(X, HybridRows):
+        import dataclasses
+
+        # Tail COO row ids already point at real rows; only the dense block
+        # grows.
+        X = dataclasses.replace(
+            X, dense=jnp.concatenate(
+                [X.dense, jnp.zeros((extra, X.dense.shape[1]),
+                                    X.dense.dtype)]))
+    elif isinstance(X, SparseRows):
         X = SparseRows(
             jnp.concatenate([X.indices, jnp.zeros((extra, X.indices.shape[1]), jnp.int32)]),
             jnp.concatenate([X.values, jnp.zeros((extra, X.values.shape[1]), X.values.dtype)]),
@@ -75,7 +84,12 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
     (data.matrix matvec/rmatvec use preferred_element_type=float32).
     Labels/weights/offsets and all solver state stay f32."""
     X = batch.X
-    if isinstance(X, SparseRows):
+    if isinstance(X, HybridRows):
+        import dataclasses
+
+        X = dataclasses.replace(X, dense=X.dense.astype(dtype),
+                                tail_vals=X.tail_vals.astype(dtype))
+    elif isinstance(X, SparseRows):
         X = SparseRows(X.indices, X.values.astype(dtype), X.n_features)
     else:
         X = X.astype(dtype)

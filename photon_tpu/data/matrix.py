@@ -38,7 +38,88 @@ class SparseRows:
         return (self.indices.shape[0], self.n_features)
 
 
-Matrix = jax.Array | SparseRows
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "dense_cols", "tail_rows", "tail_cols",
+                 "tail_vals"),
+    meta_fields=("n_features",),
+)
+@dataclasses.dataclass(frozen=True)
+class HybridRows:
+    """Power-law hybrid: hot columns dense (MXU path), cold tail flat COO.
+
+    On TPU, per-element gathers/scatters run at ~66M nnz/s while dense
+    matmul streams at hundreds of GB/s — a dense column costs roughly as
+    much as ONE sparse nnz per row. Real sparse feature spaces are
+    power-law distributed, so routing the top-`d_sel` most frequent columns
+    through a dense (n, d_sel) block covers most nnz at matmul speed and
+    leaves only the long tail to the gather path. The tail is FLAT
+    row-sorted COO (no per-row padding — padded slots cost as much as real
+    nnz on the gather path). See `to_hybrid`.
+
+    The reference has no analog (JVM sparse vectors are cheap to walk);
+    this is the TPU-first representation of its 10M-feature regime.
+    """
+
+    dense: jax.Array       # (n, d_sel) values of the selected hot columns
+    dense_cols: jax.Array  # (d_sel,) original column ids of the dense block
+    tail_rows: jax.Array   # (m,) int32 row ids, ascending (padding: row 0)
+    tail_cols: jax.Array   # (m,) int32 original column ids (padding: 0)
+    tail_vals: jax.Array   # (m,) tail values (padding: 0.0)
+    n_features: int
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+
+Matrix = jax.Array | SparseRows | HybridRows
+
+
+def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
+    """Split a SparseRows into (hot dense block, cold sparse tail).
+
+    Selects the `d_dense` columns with the most nonzeros (host-side pass
+    over the padded COO). Rows keep their full width k in the tail — the
+    padding slots freed by moved entries are zeroed, not compacted, so
+    construction is one vectorized pass.
+    """
+    ind = np.asarray(X.indices)
+    val = np.asarray(X.values)
+    n, k = ind.shape
+    d = X.n_features
+    nnz_mask = val != 0.0
+    counts = np.bincount(ind[nnz_mask].ravel(), minlength=d)
+    d_sel = min(d_dense, d)
+    sel = np.sort(np.argpartition(-counts, d_sel - 1)[:d_sel])
+    col_to_pos = np.full(d, -1, np.int64)
+    col_to_pos[sel] = np.arange(d_sel)
+
+    pos = col_to_pos[ind]  # (n, k); -1 = stays sparse
+    hot = (pos >= 0) & nnz_mask
+    dense = np.zeros((n, d_sel), np.float32)
+    rows = np.repeat(np.arange(n), k).reshape(n, k)
+    np.add.at(dense, (rows[hot], pos[hot]), val[hot])
+    # Flat row-sorted COO tail: exactly the cold nnz, no per-row padding
+    # (row-major traversal keeps rows ascending for the sorted segment_sum
+    # in matvec). One zero sentinel entry keeps the arrays non-empty.
+    cold = (~hot) & nnz_mask
+    flat = cold.reshape(-1)
+    tail_rows = rows.reshape(-1)[flat]
+    tail_cols = ind.reshape(-1)[flat]
+    tail_vals = val.reshape(-1)[flat]
+    if tail_rows.size == 0:
+        tail_rows = np.zeros(1, np.int64)
+        tail_cols = np.zeros(1, np.int64)
+        tail_vals = np.zeros(1, np.float32)
+    return HybridRows(
+        dense=jnp.asarray(dense),
+        dense_cols=jnp.asarray(sel.astype(np.int32)),
+        tail_rows=jnp.asarray(tail_rows.astype(np.int32)),
+        tail_cols=jnp.asarray(tail_cols.astype(np.int32)),
+        tail_vals=jnp.asarray(tail_vals.astype(np.float32)),
+        n_features=d,
+    )
 
 
 def from_scipy_csr(csr, k: int | None = None) -> SparseRows:
@@ -89,6 +170,14 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     keeps the ACCUMULATION in f32 — the TPU matmul recipe. Output is always
     f32; everything downstream (losses, solver state) never sees bf16.
     """
+    if isinstance(X, HybridRows):
+        tail = jax.ops.segment_sum(
+            X.tail_vals.astype(jnp.float32) * w[X.tail_cols],
+            X.tail_rows, num_segments=X.dense.shape[0],
+            indices_are_sorted=True)
+        return tail + jnp.matmul(
+            X.dense, w[X.dense_cols].astype(X.dense.dtype),
+            preferred_element_type=jnp.float32)
     if isinstance(X, SparseRows):
         # Sparse runs on the VPU (gather + multiply + reduce), never the MXU:
         # bf16 is a STORAGE format only — upcast in registers, full-precision
@@ -101,6 +190,13 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
 def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """X^T @ r -> (d,). The gradient aggregation hot path (f32 accumulation,
     bf16-storage aware like matvec)."""
+    if isinstance(X, HybridRows):
+        out = jax.ops.segment_sum(
+            X.tail_vals.astype(jnp.float32) * r[X.tail_rows],
+            X.tail_cols, num_segments=X.n_features)
+        hot = jnp.matmul(X.dense.T, r.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
     if isinstance(X, SparseRows):
         contrib = (X.values.astype(jnp.float32) * r[:, None]).reshape(-1)
         return jax.ops.segment_sum(
@@ -111,6 +207,14 @@ def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
 
 def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """(X∘X)^T @ r -> (d,): Hessian diagonal building block."""
+    if isinstance(X, HybridRows):
+        tv = X.tail_vals.astype(jnp.float32)
+        out = jax.ops.segment_sum(
+            tv * tv * r[X.tail_rows], X.tail_cols,
+            num_segments=X.n_features)
+        hot = jnp.matmul((X.dense * X.dense).T, r.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
     if isinstance(X, SparseRows):
         v = X.values.astype(jnp.float32)
         contrib = (v * v * r[:, None]).reshape(-1)
@@ -132,6 +236,19 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     at the 10M-feature regime a (d, d) Gram is impossible anyway; use
     hess_diag (VarianceComputationType.SIMPLE) there.
     """
+    if isinstance(X, HybridRows):
+        if X.n_features > MAX_GRAM_FEATURES:
+            raise ValueError(
+                f"weighted_gram densifies HybridRows: d={X.n_features} "
+                f"exceeds MAX_GRAM_FEATURES={MAX_GRAM_FEATURES}; use "
+                "hess_diag/SIMPLE variances for large feature spaces"
+            )
+        n = X.dense.shape[0]
+        rows = jnp.zeros((n, X.n_features), jnp.float32)
+        rows = rows.at[:, X.dense_cols].add(X.dense.astype(jnp.float32))
+        rows = rows.at[X.tail_rows, X.tail_cols].add(
+            X.tail_vals.astype(jnp.float32))
+        return (rows * r[:, None]).T @ rows
     if isinstance(X, SparseRows):
         n, k = X.indices.shape
         d = X.n_features
@@ -161,6 +278,17 @@ def next_pow2(x: int, floor: int = 2) -> int:
 def last_column_is_intercept(X: Matrix) -> bool:
     """True when the design matrix's last column is constant 1 — the
     data.feature_bags intercept-last convention."""
+    if isinstance(X, HybridRows):
+        d = X.n_features
+        cols = np.asarray(X.dense_cols)
+        if d - 1 in cols:  # intercept is maximally hot: dense block
+            col = np.asarray(X.dense)[:, int(np.where(cols == d - 1)[0][0])]
+            return bool((col == 1.0).all())
+        tc, tv = np.asarray(X.tail_cols), np.asarray(X.tail_vals)
+        hit = (tc == d - 1) & (tv != 0.0)
+        per_row = np.zeros(X.shape[0], bool)
+        per_row[np.asarray(X.tail_rows)[hit]] = True
+        return bool(per_row.all() and (tv[hit] == 1.0).all())
     if isinstance(X, SparseRows):
         d = X.n_features
         ind, val = np.asarray(X.indices), np.asarray(X.values)

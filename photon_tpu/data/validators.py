@@ -33,6 +33,11 @@ SAMPLE_SIZE = 100_000
 def _feature_values(X) -> np.ndarray:
     if isinstance(X, SparseRows):
         return np.asarray(X.values)
+    from photon_tpu.data.matrix import HybridRows
+
+    if isinstance(X, HybridRows):
+        return np.concatenate([np.asarray(X.dense).reshape(-1),
+                               np.asarray(X.tail_vals)])
     return np.asarray(X)
 
 

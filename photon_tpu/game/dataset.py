@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.dataset import GLMBatch
-from photon_tpu.data.matrix import Matrix, SparseRows
+from photon_tpu.data.matrix import HybridRows, Matrix, SparseRows
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +78,12 @@ class GameData:
             else jax.device_put
 
         def put_shard(X):
+            if isinstance(X, HybridRows):
+                if sharding is not None:
+                    raise ValueError(
+                        "HybridRows shards cannot be row-sharded "
+                        "(single-device representation)")
+                return jax.device_put(X)  # registered pytree: one put
             if isinstance(X, SparseRows):
                 return SparseRows(put(X.indices), put(X.values), X.n_features)
             if isinstance(X, jax.Array):
@@ -100,6 +106,11 @@ def _shard_dim(X: Matrix) -> int:
 
 def _gather_rows(X: Matrix, idx: np.ndarray):
     """Host-side row gather; returns numpy (dense) or numpy-backed SparseRows."""
+    if isinstance(X, HybridRows):
+        raise TypeError(
+            "HybridRows shards are not supported for GAME entity bucketing "
+            "(single-device fixed-effect representation); use SparseRows or "
+            "dense shards for random-effect coordinates")
     if isinstance(X, SparseRows):
         ind = np.asarray(X.indices)[idx]
         val = np.asarray(X.values)[idx]
@@ -127,7 +138,7 @@ class FixedEffectDataset:
     @staticmethod
     def build(data: GameData, shard_name: str) -> "FixedEffectDataset":
         X = data.shards[shard_name]
-        if not isinstance(X, SparseRows):
+        if not isinstance(X, (SparseRows, HybridRows)):
             X = jnp.asarray(X, jnp.float32)
         return FixedEffectDataset(
             shard_name, X, jnp.asarray(data.y), jnp.asarray(data.weights)

@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from photon_tpu.data.dataset import GLMBatch, pad_batch
-from photon_tpu.data.matrix import SparseRows
+from photon_tpu.data.matrix import HybridRows, SparseRows
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
@@ -162,7 +162,8 @@ def train_glm(
     prior_mean/prior_precision pair, and the only way to pass a
     full-covariance precision.
     """
-    d = (batch.X.n_features if isinstance(batch.X, SparseRows)
+    d = (batch.X.n_features
+         if isinstance(batch.X, (SparseRows, HybridRows))
          else batch.X.shape[1])
     norm = normalization if (normalization is not None
                              and not normalization.is_identity) else None
@@ -211,11 +212,18 @@ def train_glm(
                          fused=use_fused)
 
     if mesh is not None:
+        if isinstance(batch.X, HybridRows):
+            raise ValueError(
+                "HybridRows is a single-device representation: its flat COO "
+                "tail cannot be row-sharded over a mesh (global row ids, "
+                "arbitrary nnz length). Shard the rows first and build one "
+                "HybridRows per shard, or use SparseRows under a mesh.")
         n_dev = mesh.devices.size
         batch = pad_batch(batch, pad_to_multiple(batch.n, n_dev))
         batch = jax.device_put(batch, data_sharding(mesh))
         w0 = jax.device_put(w0, replicated(mesh))
-    elif (obj.fused and not isinstance(batch.X, SparseRows)
+    elif (obj.fused
+          and not isinstance(batch.X, (SparseRows, HybridRows))
           and batch.n >= 128
           and not (jax.default_backend() == "tpu" and d % 128 != 0)):
         # Zero-weight padding up to a 4096 multiple so the fused kernel's

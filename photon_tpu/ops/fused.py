@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from photon_tpu.data.matrix import SparseRows
+from photon_tpu.data.matrix import HybridRows, SparseRows
 from photon_tpu.ops.losses import TaskType, loss_fns
 
 # Per-chunk VMEM budget for one X slot (bytes). v5e VMEM is ~16 MB/core and
@@ -193,7 +193,8 @@ def can_fuse(X) -> bool:
     Mosaic memref row-slices require the minor dim to be a multiple of the
     128-lane tile, so on TPU d % 128 != 0 falls back to the jnp objective.
     """
-    if isinstance(X, SparseRows) or not hasattr(X, "shape") or X.ndim != 2:
+    if (isinstance(X, (SparseRows, HybridRows)) or not hasattr(X, "ndim")
+            or X.ndim != 2):
         return False
     if jax.default_backend() == "tpu" and X.shape[1] % 128 != 0:
         return False

@@ -1,0 +1,102 @@
+"""HybridRows (hot-dense / cold-sparse split) vs plain SparseRows parity."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.sparse as sp
+
+from photon_tpu.data.dataset import cast_features, make_batch, pad_batch
+from photon_tpu.data.matrix import (
+    HybridRows,
+    from_scipy_csr,
+    matvec,
+    rmatvec,
+    sq_rmatvec,
+    to_hybrid,
+    weighted_gram,
+)
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig
+
+
+@pytest.fixture
+def power_law(rng):
+    """Power-law sparse matrix: a few hot columns, long cold tail."""
+    n, d, k = 400, 500, 12
+    cols = np.minimum((rng.pareto(1.0, size=(n, k)) * 20).astype(np.int64),
+                      d - 1)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    rows = np.repeat(np.arange(n), k)
+    M = sp.csr_matrix((vals.ravel(), (rows, cols.ravel())), shape=(n, d))
+    M.sum_duplicates()
+    return from_scipy_csr(M)
+
+
+class TestHybridParity:
+    def test_ops_match_sparse(self, power_law, rng):
+        X = power_law
+        H = to_hybrid(X, d_dense=32)
+        assert H.shape == X.shape
+        w = jnp.asarray(rng.normal(size=X.n_features), jnp.float32)
+        r = jnp.asarray(rng.normal(size=X.shape[0]), jnp.float32)
+        np.testing.assert_allclose(np.asarray(matvec(H, w)),
+                                   np.asarray(matvec(X, w)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(rmatvec(H, r)),
+                                   np.asarray(rmatvec(X, r)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(sq_rmatvec(H, r)),
+                                   np.asarray(sq_rmatvec(X, r)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(weighted_gram(H, r)),
+                                   np.asarray(weighted_gram(X, r)),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_hot_columns_really_dense(self, power_law):
+        H = to_hybrid(power_law, d_dense=32)
+        # The selected columns carry no tail nnz.
+        tail_cols = set(np.asarray(H.tail_cols)[
+            np.asarray(H.tail_vals) != 0].ravel())
+        assert tail_cols.isdisjoint(set(np.asarray(H.dense_cols)))
+        # Power-law data: 32 of 500 columns should cover most nnz.
+        nnz_dense = int((np.asarray(H.dense) != 0).sum())
+        nnz_tail = int((np.asarray(H.tail_vals) != 0).sum())
+        assert nnz_dense > nnz_tail
+        # Flat tail is exact-size (no per-row padding) and row-sorted.
+        rows = np.asarray(H.tail_rows)
+        assert (np.diff(rows) >= 0).all()
+
+    def test_train_glm_hybrid(self, power_law, rng):
+        X = power_law
+        n = X.shape[0]
+        w_true = rng.normal(size=X.n_features).astype(np.float32)
+        z = np.asarray(matvec(X, jnp.asarray(w_true)))
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        m_s, r_s = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                             cfg)
+        m_h, r_h = train_glm(make_batch(to_hybrid(X, 32), y),
+                             TaskType.LOGISTIC_REGRESSION, cfg)
+        assert bool(r_h.converged)
+        np.testing.assert_allclose(np.asarray(m_h.coefficients.means),
+                                   np.asarray(m_s.coefficients.means),
+                                   atol=2e-3)
+
+    def test_pad_and_cast(self, power_law, rng):
+        n = power_law.shape[0]
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        b = make_batch(to_hybrid(power_law, 16), y)
+        padded = pad_batch(b, n + 24)
+        assert padded.X.dense.shape[0] == n + 24
+        w = jnp.asarray(rng.normal(size=power_law.n_features), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(padded.X, w))[:n],
+            np.asarray(matvec(b.X, w)), rtol=1e-5, atol=1e-5)
+        b16 = cast_features(b)
+        assert b16.X.dense.dtype == jnp.bfloat16
+        assert b16.X.tail_vals.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(matvec(b16.X, w)),
+                                   np.asarray(matvec(b.X, w)),
+                                   rtol=0.05, atol=0.1)
