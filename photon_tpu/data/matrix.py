@@ -80,9 +80,9 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
     """Split a SparseRows into (hot dense block, cold sparse tail).
 
     Selects the `d_dense` columns with the most nonzeros (host-side pass
-    over the padded COO). Rows keep their full width k in the tail — the
-    padding slots freed by moved entries are zeroed, not compacted, so
-    construction is one vectorized pass.
+    over the padded COO); the remaining nnz are COMPACTED into exact-size
+    flat row-sorted COO (tail_rows/tail_cols/tail_vals) — per-row padding
+    would cost as much as real nnz on the gather path.
     """
     ind = np.asarray(X.indices)
     val = np.asarray(X.values)

@@ -39,6 +39,14 @@ class NormalizationType(enum.Enum):
 def _column_stats(X: Matrix) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(mean, std, max|x|) per column; sparse stats count implicit zeros,
     matching the reference's BasicStatisticalSummary over full vectors."""
+    from photon_tpu.data.matrix import HybridRows
+
+    if isinstance(X, HybridRows):
+        raise TypeError(
+            "NormalizationContext.build does not take HybridRows: build the "
+            "context from the original SparseRows/dense matrix BEFORE "
+            "to_hybrid (the fitted factors/shifts then apply unchanged, "
+            "since to_hybrid only reorders storage)")
     if isinstance(X, SparseRows):
         n, d = X.shape
         idx = np.asarray(X.indices).reshape(-1)

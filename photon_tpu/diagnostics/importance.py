@@ -35,6 +35,13 @@ class FeatureImportanceReport(NamedTuple):
 def _column_moments(X, weights, which: str) -> jax.Array:
     """One weighted column moment: E[|x|] (which='abs') or Var[x] ('var').
     Static dispatch so each caller compiles only the passes it uses."""
+    from photon_tpu.data.matrix import HybridRows
+
+    if isinstance(X, HybridRows):
+        raise TypeError(
+            "feature importance does not take HybridRows: compute it on the "
+            "original SparseRows/dense matrix (to_hybrid only reorders "
+            "storage)")
     w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
     if isinstance(X, SparseRows):
         d = X.n_features

@@ -23,10 +23,10 @@ from jax.sharding import Mesh
 
 from photon_tpu.game.dataset import RandomEffectDataset, REBlock
 from photon_tpu.game.model import RandomEffectModel
-from photon_tpu.models.training import make_objective, solve
+from photon_tpu.models.training import _static_config, make_objective, solve
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
-from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.config import OptimizerConfig, OptimizerType
 from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple
 
 
@@ -51,6 +51,46 @@ def _slice_axis0(tree, start: int, size: int):
 # 512 lanes, ~100s at 39k), so big entity blocks are solved in fixed-size
 # lane chunks: one compile per block SHAPE, many cheap dispatches.
 _MAX_SOLVE_LANES = 4096
+
+# Module-level solver cache keyed on (with_prior, weight-normalized config,
+# variance type); the Objective and the L1 weight are runtime ARGUMENTS, so
+# reg-weight grids and repeated estimator fits all share compilations.
+_RE_SOLVERS: dict = {}
+
+
+def _re_solver(with_prior: bool, cfg, variance):
+    import dataclasses as _dc
+
+    key = (with_prior, cfg, variance)
+    fn = _RE_SOLVERS.get(key)
+    if fn is not None:
+        return fn
+
+    def one(obj, lam, batch, w0):
+        res = solve(obj, batch, w0, cfg, l1_weight=lam)
+        var = compute_variances(obj, res.w, batch, variance)
+        return res, var
+
+    def one_with_prior(obj, lam, batch, w0, pm, pp):
+        # Per-entity informative prior: the vmapped lanes each carry their
+        # own (mean, precision) — incremental training's per-entity
+        # PriorDistribution (pp == 0 ⇒ no prior for that lane, e.g. an
+        # entity unseen in the previous run).
+        obj_p = _dc.replace(obj, prior_mean=pm, prior_precision=pp)
+        res = solve(obj_p, batch, w0, cfg, l1_weight=lam)
+        var = compute_variances(obj_p, res.w, batch, variance)
+        return res, var
+
+    # One compile per bucket shape (jax.jit caches on shapes); the vmap
+    # batches the entire while_loop solver across entities. obj/lam are
+    # broadcast (in_axes None): shared by every lane.
+    if with_prior:
+        fn = jax.jit(jax.vmap(one_with_prior,
+                              in_axes=(None, None, 0, 0, 0, 0)))
+    else:
+        fn = jax.jit(jax.vmap(one, in_axes=(None, None, 0, 0)))
+    _RE_SOLVERS[key] = fn
+    return fn
 
 
 def _next_pow2_int(x: int) -> int:
@@ -102,43 +142,24 @@ class RandomEffectCoordinate:
                     "coefficient variances are not defined through a RANDOM "
                     "projection; use INDEX_MAP projection or no projection"
                 )
-        self._solvers: dict = {}
 
-    def _solver_for(self, dim: int, with_prior: bool):
-        """jit(vmap(solve)) for one projected (or full) feature dim. Cached
-        per dim — INDEX_MAP buckets each carry their own dim."""
-        import dataclasses as _dc
+    def _solver_for(self, with_prior: bool):
+        """jit(vmap(solve)) taking the Objective (and the dynamic L1 weight)
+        as ARGUMENTS — cached at module level on the weight-normalized
+        config, so different reg weights in a grid/tuner sweep, and even
+        different RandomEffectCoordinate instances, share one compiled
+        program per bucket shape. Per-dim specialization falls out of jit's
+        shape-keyed cache (the Objective's leaves carry the dim)."""
+        return _re_solver(with_prior, _static_config(self.config),
+                          self.variance)
 
-        key = (dim, with_prior)
-        fn = self._solvers.get(key)
-        if fn is not None:
-            return fn
+    def _block_objective(self, dim: int):
         norm = (self.normalization
                 if self.dataset.projection is None else None)
-        obj = make_objective(self.task, self.config, dim, normalization=norm)
+        return make_objective(self.task, self.config, dim,
+                              normalization=norm)
 
-        def one(batch, w0):
-            res = solve(obj, batch, w0, self.config)
-            var = compute_variances(obj, res.w, batch, self.variance)
-            return res, var
-
-        def one_with_prior(batch, w0, pm, pp):
-            # Per-entity informative prior: the vmapped lanes each carry
-            # their own (mean, precision) — incremental training's
-            # per-entity PriorDistribution (pp == 0 ⇒ no prior for that lane,
-            # e.g. an entity unseen in the previous run).
-            obj_p = _dc.replace(obj, prior_mean=pm, prior_precision=pp)
-            res = solve(obj_p, batch, w0, self.config)
-            var = compute_variances(obj_p, res.w, batch, self.variance)
-            return res, var
-
-        # One compile per bucket shape (jax.jit caches on shapes); the vmap
-        # batches the entire while_loop solver across entities.
-        fn = jax.jit(jax.vmap(one_with_prior if with_prior else one))
-        self._solvers[key] = fn
-        return fn
-
-    def _run_block(self, solver, batch, w0, pm, pp, e_real):
+    def _run_block(self, solver, obj, lam, batch, w0, pm, pp, e_real):
         """Dispatch one bucket's vmapped solve in lane chunks.
 
         Chunk size: next power of two of the entity count, capped at
@@ -157,7 +178,7 @@ class RandomEffectCoordinate:
             part = _slice_axis0(args, c0, chunk)
             if self.mesh is not None:
                 part = jax.device_put(part, data_sharding(self.mesh))
-            outs.append(solver(*part))
+            outs.append(solver(obj, lam, *part))
         if len(outs) == 1:
             return outs[0]
         # None leaves (variance off) are structural and skipped by tree_map.
@@ -247,8 +268,13 @@ class RandomEffectCoordinate:
                     pp = jnp.asarray(prior_precs[block.entity_index])
             e_real = block.n_entities
             d_solve = block.dim if block.dim is not None else d
-            solver = self._solver_for(d_solve, pm is not None)
-            res, var = self._run_block(solver, batch, w0, pm, pp, e_real)
+            solver = self._solver_for(pm is not None)
+            obj = self._block_objective(d_solve)
+            lam = (self.config.reg.l1_weight(self.config.reg_weight)
+                   if self.config.effective_optimizer() is OptimizerType.OWLQN
+                   else None)
+            res, var = self._run_block(solver, obj, lam, batch, w0, pm, pp,
+                                       e_real)
             w_out = np.asarray(res.w)[:e_real]
             if block.proj is not None:
                 from photon_tpu.game.projector import scatter_rows_into

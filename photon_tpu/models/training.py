@@ -124,14 +124,27 @@ def solve(
 
 
 @partial(jax.jit, static_argnames=("config", "variance"))
-def _train_run(batch, w0, obj, config, variance):
+def _train_run(batch, w0, obj, l1_lam, config, variance):
     """Module-level jitted solve+variance runner. Objective is a pytree
-    argument (ops/objective.py registration), so repeated train_glm calls on
-    same-shaped data hit the jit cache instead of retracing — per-call
-    retrace of the solver loop costs ~2s on TPU."""
-    res = solve(obj, batch, w0, config)
+    argument (ops/objective.py registration) and BOTH regularization
+    weights are dynamic (obj.l2 leaf, l1_lam argument), so repeated
+    train_glm calls on same-shaped data — including every point of a
+    reg-weight grid or GP-tuner sweep — hit the jit cache instead of
+    retracing (a retrace of the solver loop costs ~2s on TPU). ``config``
+    is normalized by the caller so its cache key is weight-independent."""
+    res = solve(obj, batch, w0, config, l1_weight=l1_lam)
     var = compute_variances(obj, res.w, batch, variance)
     return res, var
+
+
+def _static_config(config: OptimizerConfig) -> OptimizerConfig:
+    """The jit-cache key for a solve: the config with its (dynamic) weight
+    zeroed and the L1-vs-smooth routing pinned, so every reg weight maps to
+    the same compiled program."""
+    import dataclasses as _dc
+
+    return _dc.replace(config, reg_weight=0.0,
+                       optimizer=config.effective_optimizer())
 
 
 def train_glm(
@@ -232,7 +245,10 @@ def train_glm(
         # the batch anyway (lane-unaligned d on TPU).
         batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
-    res, var = _train_run(batch, w0, obj, config, variance)
+    l1_lam = (config.reg.l1_weight(config.reg_weight)
+              if config.effective_optimizer() is OptimizerType.OWLQN else None)
+    res, var = _train_run(batch, w0, obj, l1_lam, _static_config(config),
+                          variance)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))

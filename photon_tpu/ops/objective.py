@@ -311,9 +311,12 @@ class Objective:
 # an ARGUMENT, so module-level jitted runners (models/training._train_run)
 # cache by treedef+shape instead of retracing per closure — the difference
 # between one trace per program shape and one trace per train_glm() call.
+# l2 is a DATA field (traced leaf): a regularization-weight grid or the GP
+# tuner then reuses one compiled solver across every weight instead of
+# recompiling per grid point.
 jax.tree_util.register_dataclass(
     Objective,
-    data_fields=["reg_mask", "prior_mean", "prior_precision",
+    data_fields=["l2", "reg_mask", "prior_mean", "prior_precision",
                  "prior_full_precision", "norm_factors", "norm_shifts"],
-    meta_fields=["task", "l2", "axis_name", "fused"],
+    meta_fields=["task", "axis_name", "fused"],
 )
