@@ -23,10 +23,15 @@ from jax.sharding import Mesh
 
 from photon_tpu.game.dataset import RandomEffectDataset, REBlock
 from photon_tpu.game.model import RandomEffectModel
-from photon_tpu.models.training import _static_config, make_objective, solve
+from photon_tpu.models.training import (
+    _l1_lam,
+    _static_config,
+    make_objective,
+    solve,
+)
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
-from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+from photon_tpu.optim.config import OptimizerConfig
 from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple
 
 
@@ -270,11 +275,8 @@ class RandomEffectCoordinate:
             d_solve = block.dim if block.dim is not None else d
             solver = self._solver_for(pm is not None)
             obj = self._block_objective(d_solve)
-            lam = (self.config.reg.l1_weight(self.config.reg_weight)
-                   if self.config.effective_optimizer() is OptimizerType.OWLQN
-                   else None)
-            res, var = self._run_block(solver, obj, lam, batch, w0, pm, pp,
-                                       e_real)
+            res, var = self._run_block(solver, obj, _l1_lam(self.config),
+                                       batch, w0, pm, pp, e_real)
             w_out = np.asarray(res.w)[:e_real]
             if block.proj is not None:
                 from photon_tpu.game.projector import scatter_rows_into

@@ -137,6 +137,15 @@ def _train_run(batch, w0, obj, l1_lam, config, variance):
     return res, var
 
 
+def _l1_lam(config: OptimizerConfig):
+    """The dynamic L1 weight for a solve (None on smooth routes) — the one
+    place the OWLQN lam is derived, shared by fixed- and random-effect
+    paths."""
+    if config.effective_optimizer() is OptimizerType.OWLQN:
+        return config.reg.l1_weight(config.reg_weight)
+    return None
+
+
 def _static_config(config: OptimizerConfig) -> OptimizerConfig:
     """The jit-cache key for a solve: the config with its (dynamic) weight
     zeroed and the L1-vs-smooth routing pinned, so every reg weight maps to
@@ -245,10 +254,8 @@ def train_glm(
         # the batch anyway (lane-unaligned d on TPU).
         batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
-    l1_lam = (config.reg.l1_weight(config.reg_weight)
-              if config.effective_optimizer() is OptimizerType.OWLQN else None)
-    res, var = _train_run(batch, w0, obj, l1_lam, _static_config(config),
-                          variance)
+    res, var = _train_run(batch, w0, obj, _l1_lam(config),
+                          _static_config(config), variance)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))

@@ -232,6 +232,21 @@ class TestTronMargin:
         assert bool(res.converged.all())
 
 
+def test_reg_weight_grid_shares_compilation(rng):
+    """Different reg weights must hit the SAME jit cache entry — the
+    reference's grid search / GP tuner sweeps weights, and a retrace per
+    point costs ~2s on TPU (l2 is a traced Objective leaf, the static
+    config is weight-normalized)."""
+    from photon_tpu.models.training import _train_run
+
+    batch = _problem(rng, n=512, d=6)
+    before = _train_run._cache_size()
+    for rw in (1e-3, 1e-1, 1.0, 30.0):
+        train_glm(batch, TaskType.LOGISTIC_REGRESSION,
+                  OptimizerConfig(max_iters=10, reg=reg.l2(), reg_weight=rw))
+    assert _train_run._cache_size() == before + 1
+
+
 def test_train_glm_end_to_end_unchanged(rng):
     """train_glm (now margin-solver-backed) still matches sklearn-grade
     results: planted coefficients recovered."""

@@ -164,6 +164,44 @@ class TestNativeIngest:
         gd, _ = read_game_data(path, gd_config)  # auto-fallback works
         assert gd.y.shape == (10,)
 
+    def test_corrupt_block_raises_not_crashes(self, tmp_path, gd_config, rng):
+        """Bit-flipped/truncated payloads must surface as ValueError from the
+        C++ decoder's bounds checks — never an out-of-bounds read (the
+        varint length guard in photon_native.cc read_str/read_long)."""
+        from photon_tpu.data.avro_io import AvroContainerReader
+        from photon_tpu.data.native_ingest import read_game_data_native
+
+        schema = training_example_schema(feature_bags=("features", "ctx"),
+                                         entity_fields=("userId",))
+        recs = _fixture_records(rng, 50)
+        path = tmp_path / "ok.avro"
+        write_avro(path, recs, schema, codec="null", block_records=50)
+        raw = bytearray(path.read_bytes())
+        rd = AvroContainerReader(path)
+        # Corrupt bytes inside the data block (after header+sync): flip a
+        # spread of payload bytes so varint string lengths go haywire.
+        start = rd._data_offset + 8
+        for off in range(start, min(start + 2000, len(raw) - 20), 37):
+            raw[off] ^= 0xFF
+        bad = tmp_path / "bad.avro"
+        bad.write_bytes(bytes(raw))
+        with pytest.raises((ValueError, EOFError)):
+            read_game_data_native(bad, gd_config)
+
+    def test_truncated_block_raises(self, tmp_path, gd_config, rng):
+        schema = training_example_schema(feature_bags=("features", "ctx"),
+                                         entity_fields=("userId",))
+        recs = _fixture_records(rng, 50)
+        path = tmp_path / "ok.avro"
+        write_avro(path, recs, schema, codec="null", block_records=50)
+        raw = path.read_bytes()
+        bad = tmp_path / "trunc.avro"
+        bad.write_bytes(raw[:len(raw) - len(raw) // 3])
+        from photon_tpu.data.native_ingest import read_game_data_native
+
+        with pytest.raises((ValueError, EOFError)):
+            read_game_data_native(bad, gd_config)
+
     def test_null_codec_and_dir_input(self, tmp_path, gd_config, rng):
         schema = training_example_schema(feature_bags=("features", "ctx"),
                                          entity_fields=("userId",))
