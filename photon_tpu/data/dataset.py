@@ -13,7 +13,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.matrix import HybridRows, Matrix, SparseRows
+from photon_tpu.data.matrix import (
+    HybridRows,
+    Matrix,
+    ShardedHybridRows,
+    SparseRows,
+    shard_hybrid,
+)
 
 
 class GLMBatch(NamedTuple):
@@ -47,6 +53,10 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
         return batch
     extra = target_n - n
     X = batch.X
+    if isinstance(X, ShardedHybridRows):
+        raise ValueError(
+            "cannot pad a ShardedHybridRows batch (per-shard tails are "
+            "already laid out); pad before shard_hybrid_batch")
     if isinstance(X, HybridRows):
         import dataclasses
 
@@ -73,6 +83,21 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
     )
 
 
+def shard_hybrid_batch(batch: GLMBatch, n_shards: int,
+                       d_dense: int = 1024) -> GLMBatch:
+    """Pad a sparse batch to the mesh and re-lay its X as ShardedHybridRows
+    (data.matrix.shard_hybrid): the mesh-ready form of the hot-dense /
+    cold-tail representation. models.training.train_glm routes such batches
+    through shard_map so each device keeps its own tail — the TPU answer to
+    the reference's per-partition sparse vectors under treeAggregate."""
+    from photon_tpu.parallel.mesh import pad_to_multiple
+
+    if not isinstance(batch.X, (SparseRows, HybridRows)):
+        raise TypeError("shard_hybrid_batch expects SparseRows or HybridRows")
+    batch = pad_batch(batch, pad_to_multiple(batch.n, n_shards))
+    return batch._replace(X=shard_hybrid(batch.X, n_shards, d_dense))
+
+
 def with_offsets(batch: GLMBatch, offsets) -> GLMBatch:
     return batch._replace(offsets=jnp.asarray(offsets, jnp.float32))
 
@@ -84,7 +109,7 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
     (data.matrix matvec/rmatvec use preferred_element_type=float32).
     Labels/weights/offsets and all solver state stay f32."""
     X = batch.X
-    if isinstance(X, HybridRows):
+    if isinstance(X, (HybridRows, ShardedHybridRows)):
         import dataclasses
 
         X = dataclasses.replace(X, dense=X.dense.astype(dtype),

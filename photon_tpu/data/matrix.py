@@ -73,7 +73,77 @@ class HybridRows:
         return (self.dense.shape[0], self.n_features)
 
 
-Matrix = jax.Array | SparseRows | HybridRows
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "dense_cols", "tail_rows", "tail_cols",
+                 "tail_vals"),
+    meta_fields=("n_features",),
+)
+@dataclasses.dataclass(frozen=True)
+class ShardedHybridRows:
+    """HybridRows laid out for a device mesh: per-shard flat-COO tails.
+
+    A single HybridRows cannot row-shard over a mesh — its flat tail has
+    arbitrary length per row range and global row ids. This layout fixes
+    both: rows are split into `S` equal contiguous shards, each shard's
+    tail is padded to one common length `m`, and tail row ids are LOCAL to
+    the shard. The tail arrays are (S, m) with the shard axis leading, so
+    sharding every data leaf's axis 0 over the mesh gives each device its
+    own complete (dense block rows + local tail) piece — the tail gather/
+    scatter never crosses devices; only the (d,) gradient psum does.
+
+    Works in two views:
+    - global (single device / plain jit): ops offset local row ids by
+      `shard * n_local` — exactly equivalent to the unsharded HybridRows.
+    - local (inside shard_map, leaves sliced to dense (n_local, d_sel) and
+      tails (1, m)): `local()` squeezes the shard axis into a plain
+      HybridRows; models.training routes mesh solves through this.
+
+    Tail padding entries use (row = n_local-1, col = 0, val = 0): zero
+    values contribute nothing, and padding with the LAST local row keeps
+    each shard's row ids ascending for the sorted segment_sum in matvec.
+    """
+
+    dense: jax.Array       # (n, d_sel) hot-column values, rows shardable
+    dense_cols: jax.Array  # (d_sel,) original column ids (replicated)
+    tail_rows: jax.Array   # (S, m) int32 LOCAL row ids, ascending per shard
+    tail_cols: jax.Array   # (S, m) int32 original column ids
+    tail_vals: jax.Array   # (S, m) tail values (padding: 0.0)
+    n_features: int
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+    @property
+    def n_shards(self) -> int:
+        return self.tail_rows.shape[0]
+
+    @property
+    def n_local(self) -> int:
+        return self.dense.shape[0] // self.tail_rows.shape[0]
+
+    def local(self) -> HybridRows:
+        """The one-shard view (inside shard_map, where the shard axis has
+        been sliced to length 1)."""
+        return HybridRows(
+            dense=self.dense,
+            dense_cols=self.dense_cols,
+            tail_rows=self.tail_rows[0],
+            tail_cols=self.tail_cols[0],
+            tail_vals=self.tail_vals[0],
+            n_features=self.n_features,
+        )
+
+    def _global_tail(self):
+        """(rows, cols, vals) flat with GLOBAL row ids, sorted ascending."""
+        S, m = self.tail_rows.shape
+        off = jnp.arange(S, dtype=jnp.int32) * self.n_local
+        rows = (self.tail_rows + off[:, None]).reshape(-1)
+        return rows, self.tail_cols.reshape(-1), self.tail_vals.reshape(-1)
+
+
+Matrix = jax.Array | SparseRows | HybridRows | ShardedHybridRows
 
 
 def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
@@ -119,6 +189,50 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
         tail_cols=jnp.asarray(tail_cols.astype(np.int32)),
         tail_vals=jnp.asarray(tail_vals.astype(np.float32)),
         n_features=d,
+    )
+
+
+def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
+                 d_dense: int = 1024) -> ShardedHybridRows:
+    """Re-lay a hybrid matrix for an `n_shards`-device mesh (see
+    ShardedHybridRows). Rows must already divide `n_shards` — pad the batch
+    first (`data.dataset.shard_hybrid_batch` does both).
+
+    Host-side, one pass: the flat tail is row-sorted, so each shard's slice
+    is contiguous (searchsorted on the shard row boundaries); slices are
+    padded to the max per-shard tail length.
+    """
+    if isinstance(X, SparseRows):
+        X = to_hybrid(X, d_dense)
+    n = X.dense.shape[0]
+    if n % n_shards != 0:
+        raise ValueError(
+            f"{n} rows do not divide {n_shards} shards; pad the batch first "
+            "(data.dataset.shard_hybrid_batch)")
+    n_local = n // n_shards
+    tr = np.asarray(X.tail_rows)
+    tc = np.asarray(X.tail_cols)
+    tv = np.asarray(X.tail_vals)
+    keep = tv != 0.0  # drop the sentinel / any padding before re-padding
+    tr, tc, tv = tr[keep], tc[keep], tv[keep]
+    bounds = np.searchsorted(tr, np.arange(n_shards + 1) * n_local)
+    m = max(1, int(np.max(np.diff(bounds))))
+    rows = np.full((n_shards, m), n_local - 1, np.int32)
+    cols = np.zeros((n_shards, m), np.int32)
+    vals = np.zeros((n_shards, m), np.asarray(X.tail_vals).dtype)
+    for s in range(n_shards):
+        lo, hi = int(bounds[s]), int(bounds[s + 1])
+        c = hi - lo
+        rows[s, :c] = tr[lo:hi] - s * n_local
+        cols[s, :c] = tc[lo:hi]
+        vals[s, :c] = tv[lo:hi]
+    return ShardedHybridRows(
+        dense=X.dense,
+        dense_cols=X.dense_cols,
+        tail_rows=jnp.asarray(rows),
+        tail_cols=jnp.asarray(cols),
+        tail_vals=jnp.asarray(vals),
+        n_features=X.n_features,
     )
 
 
@@ -170,6 +284,14 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     keeps the ACCUMULATION in f32 — the TPU matmul recipe. Output is always
     f32; everything downstream (losses, solver state) never sees bf16.
     """
+    if isinstance(X, ShardedHybridRows):
+        rows, cols, vals = X._global_tail()
+        tail = jax.ops.segment_sum(
+            vals.astype(jnp.float32) * w[cols], rows,
+            num_segments=X.dense.shape[0], indices_are_sorted=True)
+        return tail + jnp.matmul(
+            X.dense, w[X.dense_cols].astype(X.dense.dtype),
+            preferred_element_type=jnp.float32)
     if isinstance(X, HybridRows):
         tail = jax.ops.segment_sum(
             X.tail_vals.astype(jnp.float32) * w[X.tail_cols],
@@ -190,6 +312,14 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
 def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """X^T @ r -> (d,). The gradient aggregation hot path (f32 accumulation,
     bf16-storage aware like matvec)."""
+    if isinstance(X, ShardedHybridRows):
+        rows, cols, vals = X._global_tail()
+        out = jax.ops.segment_sum(
+            vals.astype(jnp.float32) * r[rows], cols,
+            num_segments=X.n_features)
+        hot = jnp.matmul(X.dense.T, r.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
     if isinstance(X, HybridRows):
         out = jax.ops.segment_sum(
             X.tail_vals.astype(jnp.float32) * r[X.tail_rows],
@@ -207,6 +337,14 @@ def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
 
 def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """(X∘X)^T @ r -> (d,): Hessian diagonal building block."""
+    if isinstance(X, ShardedHybridRows):
+        rows, cols, vals = X._global_tail()
+        tv = vals.astype(jnp.float32)
+        out = jax.ops.segment_sum(
+            tv * tv * r[rows], cols, num_segments=X.n_features)
+        hot = jnp.matmul((X.dense * X.dense).T, r.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
     if isinstance(X, HybridRows):
         tv = X.tail_vals.astype(jnp.float32)
         out = jax.ops.segment_sum(
@@ -236,7 +374,7 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     at the 10M-feature regime a (d, d) Gram is impossible anyway; use
     hess_diag (VarianceComputationType.SIMPLE) there.
     """
-    if isinstance(X, HybridRows):
+    if isinstance(X, (HybridRows, ShardedHybridRows)):
         if X.n_features > MAX_GRAM_FEATURES:
             raise ValueError(
                 f"weighted_gram densifies HybridRows: d={X.n_features} "
@@ -244,10 +382,13 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
                 "hess_diag/SIMPLE variances for large feature spaces"
             )
         n = X.dense.shape[0]
+        if isinstance(X, ShardedHybridRows):
+            t_rows, t_cols, t_vals = X._global_tail()
+        else:
+            t_rows, t_cols, t_vals = X.tail_rows, X.tail_cols, X.tail_vals
         rows = jnp.zeros((n, X.n_features), jnp.float32)
         rows = rows.at[:, X.dense_cols].add(X.dense.astype(jnp.float32))
-        rows = rows.at[X.tail_rows, X.tail_cols].add(
-            X.tail_vals.astype(jnp.float32))
+        rows = rows.at[t_rows, t_cols].add(t_vals.astype(jnp.float32))
         return (rows * r[:, None]).T @ rows
     if isinstance(X, SparseRows):
         n, k = X.indices.shape
@@ -278,16 +419,21 @@ def next_pow2(x: int, floor: int = 2) -> int:
 def last_column_is_intercept(X: Matrix) -> bool:
     """True when the design matrix's last column is constant 1 — the
     data.feature_bags intercept-last convention."""
-    if isinstance(X, HybridRows):
+    if isinstance(X, (HybridRows, ShardedHybridRows)):
         d = X.n_features
         cols = np.asarray(X.dense_cols)
         if d - 1 in cols:  # intercept is maximally hot: dense block
             col = np.asarray(X.dense)[:, int(np.where(cols == d - 1)[0][0])]
             return bool((col == 1.0).all())
-        tc, tv = np.asarray(X.tail_cols), np.asarray(X.tail_vals)
+        if isinstance(X, ShardedHybridRows):
+            t_rows = np.asarray(X._global_tail()[0])
+        else:
+            t_rows = np.asarray(X.tail_rows)
+        tc, tv = np.asarray(X.tail_cols).reshape(-1), \
+            np.asarray(X.tail_vals).reshape(-1)
         hit = (tc == d - 1) & (tv != 0.0)
         per_row = np.zeros(X.shape[0], bool)
-        per_row[np.asarray(X.tail_rows)[hit]] = True
+        per_row[t_rows[hit]] = True
         return bool(per_row.all() and (tv[hit] == 1.0).all())
     if isinstance(X, SparseRows):
         d = X.n_features

@@ -22,10 +22,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_tpu.data.dataset import GLMBatch, pad_batch
-from photon_tpu.data.matrix import HybridRows, SparseRows
+from photon_tpu.data.matrix import HybridRows, ShardedHybridRows, SparseRows
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
@@ -137,6 +138,42 @@ def _train_run(batch, w0, obj, l1_lam, config, variance):
     return res, var
 
 
+def _hybrid_specs(X: ShardedHybridRows, axes: tuple, wrap=lambda s: s):
+    """(batch_spec_tree) for a ShardedHybridRows batch: every data leaf's
+    axis 0 over all mesh axes, dense_cols replicated. ``wrap`` lifts each
+    PartitionSpec (e.g. into a NamedSharding for device_put)."""
+    dat, rep = wrap(P(axes)), wrap(P())
+    x = ShardedHybridRows(dense=dat, dense_cols=rep, tail_rows=dat,
+                          tail_cols=dat, tail_vals=dat,
+                          n_features=X.n_features)
+    return GLMBatch(X=x, y=dat, weights=dat, offsets=dat)
+
+
+@partial(jax.jit, static_argnames=("config", "variance", "mesh"))
+def _train_run_sharded(batch, w0, obj, l1_lam, config, variance, mesh):
+    """The ShardedHybridRows solve: whole solver under shard_map, so the
+    flat-COO tail gather/scatter is provably LOCAL to each device — the only
+    cross-device traffic is the Objective's fused (value, grad) psum. XLA's
+    SPMD partitioner cannot make that locality guarantee for a global
+    segment_sum whose indices it can't reason about; shard_map states it.
+    """
+    axes = tuple(mesh.axis_names)
+    batch_spec = _hybrid_specs(batch.X, axes)
+    obj_spec = jax.tree_util.tree_map(lambda _: P(), obj)
+
+    def body(b, w0, obj, l1):
+        bl = b._replace(X=b.X.local())
+        res = solve(obj, bl, w0, config, l1_weight=l1)
+        var = compute_variances(obj, res.w, bl, variance)
+        return res, var
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(batch_spec, P(), obj_spec, P()),
+        out_specs=P(),
+    )(batch, w0, obj, l1_lam)
+
+
 def _l1_lam(config: OptimizerConfig):
     """The dynamic L1 weight for a solve (None on smooth routes) — the one
     place the OWLQN lam is derived, shared by fixed- and random-effect
@@ -227,25 +264,46 @@ def train_glm(
     # fused=True)).
     use_fused = (mesh is None
                  and config.effective_optimizer() is OptimizerType.OWLQN)
-    obj = make_objective(task, config, d,
+    sharded_hybrid = mesh is not None and isinstance(batch.X,
+                                                     ShardedHybridRows)
+    axis_name = None
+    if sharded_hybrid:
+        axes = tuple(mesh.axis_names)
+        axis_name = axes[0] if len(axes) == 1 else axes
+    obj = make_objective(task, config, d, axis_name=axis_name,
                          prior_mean=prior_mean, prior_precision=prior_precision,
                          normalization=norm,
                          prior_full_precision=prior_full_precision,
                          fused=use_fused)
 
-    if mesh is not None:
+    if sharded_hybrid:
+        if batch.X.n_shards != mesh.devices.size:
+            raise ValueError(
+                f"ShardedHybridRows has {batch.X.n_shards} shards but the "
+                f"mesh has {mesh.devices.size} devices; rebuild with "
+                "data.dataset.shard_hybrid_batch(batch, mesh.devices.size)")
+        batch = jax.device_put(
+            batch, _hybrid_specs(batch.X, tuple(mesh.axis_names),
+                                 wrap=lambda s: NamedSharding(mesh, s)))
+        w0 = jax.device_put(w0, replicated(mesh))
+        res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
+                                      _static_config(config), variance, mesh)
+    elif mesh is not None:
         if isinstance(batch.X, HybridRows):
             raise ValueError(
                 "HybridRows is a single-device representation: its flat COO "
                 "tail cannot be row-sharded over a mesh (global row ids, "
-                "arbitrary nnz length). Shard the rows first and build one "
-                "HybridRows per shard, or use SparseRows under a mesh.")
+                "arbitrary nnz length). Re-lay it with "
+                "data.dataset.shard_hybrid_batch(batch, mesh.devices.size) "
+                "— the per-shard-tail form train_glm runs under shard_map — "
+                "or use SparseRows under a mesh.")
         n_dev = mesh.devices.size
         batch = pad_batch(batch, pad_to_multiple(batch.n, n_dev))
         batch = jax.device_put(batch, data_sharding(mesh))
         w0 = jax.device_put(w0, replicated(mesh))
     elif (obj.fused
-          and not isinstance(batch.X, (SparseRows, HybridRows))
+          and not isinstance(batch.X,
+                             (SparseRows, HybridRows, ShardedHybridRows))
           and batch.n >= 128
           and not (jax.default_backend() == "tpu" and d % 128 != 0)):
         # Zero-weight padding up to a 4096 multiple so the fused kernel's
@@ -254,8 +312,9 @@ def train_glm(
         # the batch anyway (lane-unaligned d on TPU).
         batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
-    res, var = _train_run(batch, w0, obj, _l1_lam(config),
-                          _static_config(config), variance)
+    if not sharded_hybrid:
+        res, var = _train_run(batch, w0, obj, _l1_lam(config),
+                              _static_config(config), variance)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))
