@@ -64,7 +64,9 @@ def main() -> None:
     jax.block_until_ready(batch.X)
     run_once(batch, config)  # warm-up: compile + autotune
     best = float("inf")
-    for _ in range(3):
+    # Five reps, keep the best: the axon tunnel's throughput drifts ±30%
+    # between runs minutes apart, so more reps = less pessimistic noise.
+    for _ in range(5):
         t0 = time.perf_counter()
         res = run_once(batch, config)
         best = min(best, time.perf_counter() - t0)

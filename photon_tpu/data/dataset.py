@@ -40,7 +40,7 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
         weights = jnp.ones((n,), jnp.float32)
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
-    if not isinstance(X, (SparseRows, HybridRows)):
+    if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows)):
         X = jnp.asarray(X, jnp.float32)
     return GLMBatch(X, y, jnp.asarray(weights, jnp.float32),
                     jnp.asarray(offsets, jnp.float32))

@@ -96,6 +96,30 @@ class NormalizationContext:
         if norm_type is NormalizationType.NONE:
             return NormalizationContext.no_op()
         mean, std, mx = _column_stats(X)
+        return NormalizationContext._from_stats(mean, std, mx, norm_type,
+                                                intercept_index)
+
+    @staticmethod
+    def from_summary(
+        summary,
+        norm_type: NormalizationType,
+        intercept_index: Optional[int] = -1,
+    ) -> "NormalizationContext":
+        """Build from a precomputed data.statistics.FeatureSummary — the
+        reference's constructor shape (NormalizationContext(normalizationType,
+        statisticalSummary, interceptId)); lets one summary pass feed
+        normalization, the driver's summarization output, and validators."""
+        if norm_type is NormalizationType.NONE:
+            return NormalizationContext.no_op()
+        return NormalizationContext._from_stats(
+            summary.mean, summary.std, summary.abs_max, norm_type,
+            intercept_index)
+
+    @staticmethod
+    def _from_stats(mean, std, mx, norm_type, intercept_index):
+        mean = np.asarray(mean, np.float64)
+        std = np.asarray(std, np.float64)
+        mx = np.asarray(mx, np.float64)
         d = mean.shape[0]
         if intercept_index is not None and intercept_index < 0:
             intercept_index += d

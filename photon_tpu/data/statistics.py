@@ -1,0 +1,194 @@
+"""Per-feature summary statistics.
+
+Reference parity: com.linkedin.photon.ml.stat.{BasicStatistics,
+BasicStatisticalSummary} / FeatureDataStatistics — per-feature mean,
+variance, min, max, |x|max, L1/L2 norms and nonzero counts over the whole
+dataset, computed distributed (the reference aggregates
+MultivariateStatisticalSummary over RDD partitions; the GAME training
+driver can persist the summary, and NormalizationContext is built from it).
+
+TPU-first: ONE jitted pass over the (possibly mesh-sharded) design matrix.
+Dense matrices reduce straight on device; SparseRows reduce with
+`segment_*` ops over the padded COO (padding slots are routed to a spill
+bucket), with implicit zeros folded in afterwards — a column whose nonzero
+count is below the row count includes 0 in its min/max, matching the
+reference's full-vector semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.matrix import Matrix, SparseRows
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSummary:
+    """Reference: BasicStatisticalSummary's per-feature vectors."""
+
+    count: int  # rows (full vectors, incl. implicit sparse zeros)
+    mean: np.ndarray  # (d,) float64
+    variance: np.ndarray  # (d,) float64 population variance
+    minimum: np.ndarray  # (d,)
+    maximum: np.ndarray  # (d,)
+    abs_max: np.ndarray  # (d,) max |x| (SCALE_WITH_MAX_MAGNITUDE input)
+    norm_l1: np.ndarray  # (d,) sum |x|
+    norm_l2: np.ndarray  # (d,) sqrt(sum x^2)
+    num_nonzeros: np.ndarray  # (d,) int64
+
+    @property
+    def std(self) -> np.ndarray:
+        return np.sqrt(self.variance)
+
+    # ------------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        """One JSON document (small: 8 vectors of d floats) — the analog of
+        the reference driver's summarization output Avro."""
+        doc = {"count": self.count}
+        for f in dataclasses.fields(self):
+            if f.name != "count":
+                doc[f.name] = np.asarray(getattr(self, f.name),
+                                         np.float64).tolist()
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+
+    @staticmethod
+    def load(path: str) -> "FeatureSummary":
+        with open(path) as fh:
+            doc = json.load(fh)
+        kwargs = {"count": int(doc["count"])}
+        for f in dataclasses.fields(FeatureSummary):
+            if f.name == "count":
+                continue
+            dt = np.int64 if f.name == "num_nonzeros" else np.float64
+            kwargs[f.name] = np.asarray(doc[f.name], dt)
+        return FeatureSummary(**kwargs)
+
+    # ------------------------------------------------------------ construction
+    @staticmethod
+    def compute(X: Matrix, mesh=None) -> "FeatureSummary":
+        """Summarize a design matrix in one device pass.
+
+        With a mesh, rows are sharded over it and the per-column partial
+        reductions combine with psums inside the same compiled program (the
+        reference's treeAggregate of summarizers); without one the pass runs
+        single-device.
+        """
+        from photon_tpu.data.matrix import HybridRows, ShardedHybridRows
+
+        if isinstance(X, (HybridRows, ShardedHybridRows)):
+            raise TypeError(
+                "FeatureSummary.compute takes the original SparseRows/dense "
+                "matrix, not a hybrid re-layout; compute the summary before "
+                "to_hybrid/shard_hybrid (the statistics are unaffected by "
+                "storage re-layout)")
+        n = X.shape[0]
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import data_sharding
+
+            axes = tuple(mesh.axis_names)
+            n_dev = mesh.devices.size
+            if n % n_dev != 0:
+                # summary semantics need exact n; pad rows are all-zero and
+                # would corrupt min/nnz, so require aligned input instead.
+                raise ValueError(
+                    f"{n} rows do not divide the {n_dev}-device mesh; "
+                    "summarize before padding or pass mesh=None")
+            X = jax.device_put(X, data_sharding(mesh))
+        sparse = isinstance(X, SparseRows)
+        out = _summarize_sparse(X) if sparse else _summarize_dense(
+            jnp.asarray(X))
+        s1, s2, mn, mx, l1, nnz = (np.asarray(v, np.float64) for v in out)
+        mean = s1 / n
+        # Variance via a SECOND, mean-shifted pass: Σ(x−μ)² accumulates small
+        # numbers, where the one-pass E[x²]−E[x]² form cancels catastrophically
+        # in f32 for large-mean features (a N(5000, 0.1) column would report
+        # variance 0 and silently break standardization built from_summary).
+        shift = jnp.asarray(mean, jnp.float32)
+        if sparse:
+            ssq = np.asarray(_shifted_ssq_sparse(X, shift), np.float64)
+            # stored entries contribute (v−μ)²; the n−nnz implicit zeros
+            # contribute μ² each — no cancellation in either term.
+            var = (ssq + (n - nnz) * mean * mean) / n
+        else:
+            var = np.asarray(
+                _shifted_ssq_dense(jnp.asarray(X), shift), np.float64) / n
+        var = np.maximum(var, 0.0)
+        # Fold implicit zeros into extrema (reference: full-vector summary).
+        has_zero = nnz < n
+        mn = np.where(has_zero, np.minimum(mn, 0.0), mn)
+        mx = np.where(has_zero, np.maximum(mx, 0.0), mx)
+        f64 = partial(np.asarray, dtype=np.float64)
+        return FeatureSummary(
+            count=n, mean=f64(mean), variance=f64(var), minimum=f64(mn),
+            maximum=f64(mx), abs_max=f64(np.maximum(np.abs(mn), np.abs(mx))),
+            norm_l1=f64(l1), norm_l2=f64(np.sqrt(s2)),
+            num_nonzeros=np.asarray(nnz, np.int64))
+
+
+@jax.jit
+def _shifted_ssq_dense(X, shift):
+    c = X.astype(jnp.float32) - shift[None, :]
+    return jnp.sum(c * c, 0)
+
+
+@jax.jit
+def _shifted_ssq_sparse(X: SparseRows, shift):
+    d = X.n_features
+    val = X.values.astype(jnp.float32).reshape(-1)
+    live = val != 0.0
+    seg = jnp.where(live, X.indices.reshape(-1), d)
+    c = jnp.where(live, val - shift[jnp.minimum(seg, d - 1)], 0.0)
+    return jax.ops.segment_sum(c * c, seg, num_segments=d + 1)[:d]
+
+
+@jax.jit
+def _summarize_dense(X):
+    Xf = X.astype(jnp.float32)
+    return (jnp.sum(Xf, 0), jnp.sum(Xf * Xf, 0), jnp.min(Xf, 0),
+            jnp.max(Xf, 0), jnp.sum(jnp.abs(Xf), 0),
+            jnp.sum((Xf != 0.0).astype(jnp.float32), 0))
+
+
+@jax.jit
+def _summarize_sparse(X: SparseRows):
+    d = X.n_features
+    val = X.values.astype(jnp.float32).reshape(-1)
+    live = val != 0.0
+    # Padding slots (value 0 at index 0) spill into segment d, dropped below.
+    seg = jnp.where(live, X.indices.reshape(-1), d)
+    args = dict(num_segments=d + 1)
+    s1 = jax.ops.segment_sum(val, seg, **args)
+    s2 = jax.ops.segment_sum(val * val, seg, **args)
+    l1 = jax.ops.segment_sum(jnp.abs(val), seg, **args)
+    nnz = jax.ops.segment_sum(live.astype(jnp.float32), seg, **args)
+    mn = jax.ops.segment_min(jnp.where(live, val, jnp.inf), seg, **args)
+    mx = jax.ops.segment_max(jnp.where(live, val, -jnp.inf), seg, **args)
+    # All-implicit-zero columns: empty segments give ±inf; their extrema are 0.
+    empty = nnz[:d] == 0
+    mn = jnp.where(empty, 0.0, mn[:d])
+    mx = jnp.where(empty, 0.0, mx[:d])
+    return s1[:d], s2[:d], mn, mx, l1[:d], nnz[:d]
+
+
+def summarize_features(X: Matrix, mesh=None,
+                       names: Optional[list[str]] = None) -> dict:
+    """Human-readable per-feature table (driver summarization output);
+    ``names`` come from the IndexMap when available."""
+    s = FeatureSummary.compute(X, mesh=mesh)
+    d = s.mean.shape[0]
+    names = names if names is not None else [str(j) for j in range(d)]
+    return {
+        names[j]: {
+            "mean": float(s.mean[j]), "variance": float(s.variance[j]),
+            "min": float(s.minimum[j]), "max": float(s.maximum[j]),
+            "num_nonzeros": int(s.num_nonzeros[j]),
+        }
+        for j in range(d)
+    }

@@ -128,6 +128,10 @@ class TrainingParams:
     # Partial retraining (reference: partialRetrainLockedCoordinates): listed
     # coordinates keep the initial model and only contribute scores.
     locked_coordinates: Sequence[str] = ()
+    # Per-shard feature summary output (reference: GameTrainingDriver
+    # summarizationOutputDir → BasicStatisticalSummary per shard). Relative
+    # paths land under output_dir.
+    summarization_output_dir: Optional[str] = None
 
     def __post_init__(self):
         self.coordinates = {
@@ -221,6 +225,22 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                 data, task, params.down_sampling_rate, params.seed)
             log.info("down-sampled %d -> %d rows", n0, data.n)
 
+    summaries = {}
+    if params.summarization_output_dir is not None:
+        from photon_tpu.data.statistics import FeatureSummary
+
+        summary_dir = params.summarization_output_dir
+        if not os.path.isabs(summary_dir):
+            summary_dir = os.path.join(params.output_dir, summary_dir)
+        os.makedirs(summary_dir, exist_ok=True)
+        with timers("summarize"):
+            for shard_name in params.feature_shards:
+                s = FeatureSummary.compute(data.shards[shard_name])
+                s.save(os.path.join(summary_dir, f"{shard_name}.json"))
+                summaries[shard_name] = s
+        log.info("wrote feature summaries for %d shards to %s",
+                 len(summaries), summary_dir)
+
     norm_type = NormalizationType(params.normalization)
     normalization = {}
     if norm_type is not NormalizationType.NONE:
@@ -232,8 +252,16 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                     f"standardization requires an intercept in shard "
                     f"{spec.feature_shard!r}"
                 )
-            normalization[name] = NormalizationContext.build(
-                data.shards[spec.feature_shard], norm_type, intercept_index=icpt)
+            if spec.feature_shard in summaries:
+                # One stats pass feeds both outputs (reference builds the
+                # NormalizationContext from the same summary object).
+                normalization[name] = NormalizationContext.from_summary(
+                    summaries[spec.feature_shard], norm_type,
+                    intercept_index=icpt)
+            else:
+                normalization[name] = NormalizationContext.build(
+                    data.shards[spec.feature_shard], norm_type,
+                    intercept_index=icpt)
 
     initial_models = None
     if params.initial_model_dir:

@@ -192,3 +192,32 @@ class TestTimers:
             pass
         s = timers.summary()
         assert set(s) == {"a", "b"} and s["a"] >= 0
+
+
+class TestSummarization:
+    def test_driver_writes_feature_summaries(self, job_dirs):
+        from photon_tpu.data.statistics import FeatureSummary
+
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            output_dir=str(root / "out_summ"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+            normalization="scale_with_standard_deviation",
+            summarization_output_dir="summaries",
+        )
+        out = run_training(params)
+        assert out.best is not None
+        for shard in FEATURE_SHARDS:
+            s = FeatureSummary.load(
+                str(root / "out_summ" / "summaries" / f"{shard}.json"))
+            assert s.count == 600
+        s_fixed = FeatureSummary.load(
+            str(root / "out_summ" / "summaries" / "fixedShard.json"))
+        # age/ctr are standard normal draws; intercept column is constant 1
+        assert abs(float(s_fixed.mean[-1]) - 1.0) < 1e-6
+        assert float(s_fixed.variance[-1]) < 1e-8
+        assert 0.7 < float(s_fixed.std[0]) < 1.3

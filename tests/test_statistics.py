@@ -1,0 +1,167 @@
+"""FeatureSummary (reference: stat.BasicStatisticalSummary) vs numpy."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_tpu.data.matrix import from_scipy_csr, to_hybrid
+from photon_tpu.data.normalization import NormalizationContext, NormalizationType
+from photon_tpu.data.statistics import FeatureSummary, summarize_features
+
+
+@pytest.fixture
+def sparse_with_zeros(rng):
+    """Sparse matrix with implicit zeros, an all-zero column, and negatives."""
+    n, d = 240, 40
+    M = sp.random(n, d, density=0.15, random_state=7,
+                  data_rvs=lambda k: rng.normal(size=k)).tocsr()
+    M[:, 11] = 0.0  # all-zero column
+    M.eliminate_zeros()
+    return M
+
+
+def _dense_ref(Xd):
+    n = Xd.shape[0]
+    return dict(
+        mean=Xd.mean(0), variance=Xd.var(0), minimum=Xd.min(0),
+        maximum=Xd.max(0), abs_max=np.abs(Xd).max(0),
+        norm_l1=np.abs(Xd).sum(0), norm_l2=np.sqrt((Xd * Xd).sum(0)),
+        num_nonzeros=(Xd != 0).sum(0).astype(float), count=n)
+
+
+def _check(s: FeatureSummary, Xd):
+    ref = _dense_ref(np.asarray(Xd, np.float64))
+    assert s.count == ref["count"]
+    for k, v in ref.items():
+        if k == "count":
+            continue
+        np.testing.assert_allclose(getattr(s, k), v, rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_dense_matches_numpy(rng):
+    Xd = rng.normal(size=(300, 17)).astype(np.float32)
+    Xd[:, 4] = 0.0
+    _check(FeatureSummary.compute(Xd), Xd)
+
+
+def test_sparse_matches_dense(sparse_with_zeros):
+    X = from_scipy_csr(sparse_with_zeros)
+    _check(FeatureSummary.compute(X), sparse_with_zeros.toarray())
+
+
+def test_sparse_implicit_zero_extrema(rng):
+    # A column whose stored values are all positive still has min 0 when
+    # some rows miss it (full-vector semantics).
+    M = sp.csr_matrix(np.array([[2.0, -3.0], [5.0, -1.0], [0.0, -2.0]]))
+    s = FeatureSummary.compute(from_scipy_csr(M))
+    assert s.minimum[0] == 0.0 and s.maximum[0] == 5.0
+    # Column 1 is fully stored: min stays negative, max is max(stored, 0)?
+    # no — no implicit zero, so extrema are the stored ones.
+    assert s.minimum[1] == -3.0 and s.maximum[1] == -1.0
+
+
+def test_mesh_matches_single(sparse_with_zeros, mesh8):
+    X = from_scipy_csr(sparse_with_zeros)
+    s1 = FeatureSummary.compute(X)
+    s2 = FeatureSummary.compute(X, mesh=mesh8)
+    for f in ("mean", "variance", "minimum", "maximum", "num_nonzeros"):
+        np.testing.assert_allclose(getattr(s2, f), getattr(s1, f),
+                                   rtol=1e-5, atol=1e-6, err_msg=f)
+
+
+def test_mesh_requires_aligned_rows(rng, mesh8):
+    with pytest.raises(ValueError, match="divide"):
+        FeatureSummary.compute(rng.normal(size=(101, 4)).astype(np.float32),
+                               mesh=mesh8)
+
+
+def test_hybrid_rejected(sparse_with_zeros):
+    X = to_hybrid(from_scipy_csr(sparse_with_zeros), d_dense=8)
+    with pytest.raises(TypeError, match="before to_hybrid"):
+        FeatureSummary.compute(X)
+
+
+def test_save_load_roundtrip(tmp_path, rng):
+    s = FeatureSummary.compute(rng.normal(size=(64, 5)).astype(np.float32))
+    p = str(tmp_path / "summary.json")
+    s.save(p)
+    s2 = FeatureSummary.load(p)
+    assert s2.count == s.count
+    np.testing.assert_allclose(s2.variance, s.variance, rtol=1e-6)
+    np.testing.assert_allclose(s2.num_nonzeros, s.num_nonzeros)
+
+
+def test_normalization_from_summary_matches_build(rng):
+    Xd = np.concatenate(
+        [rng.normal(size=(128, 6)).astype(np.float32) * 3.0 + 1.0,
+         np.ones((128, 1), np.float32)], axis=1)
+    s = FeatureSummary.compute(Xd)
+    for nt in (NormalizationType.STANDARDIZATION,
+               NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+               NormalizationType.SCALE_WITH_MAX_MAGNITUDE):
+        a = NormalizationContext.build(Xd, nt)
+        b = NormalizationContext.from_summary(s, nt)
+        np.testing.assert_allclose(b.factors, a.factors, rtol=1e-4,
+                                   err_msg=str(nt))
+        if a.shifts is not None:
+            np.testing.assert_allclose(b.shifts, a.shifts, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_summarize_features_table(rng):
+    Xd = rng.normal(size=(32, 3)).astype(np.float32)
+    tab = summarize_features(Xd, names=["a", "b", "c"])
+    assert set(tab) == {"a", "b", "c"}
+    np.testing.assert_allclose(tab["b"]["mean"], Xd[:, 1].mean(), atol=1e-5)
+
+
+def test_large_mean_variance_no_cancellation(rng):
+    # E[x^2]-E[x]^2 in f32 would report ~0 variance here; the mean-shifted
+    # second pass must recover it (regression: from_summary silently
+    # disabling standardization on large-offset features).
+    col = rng.normal(5000.0, 0.1, size=4096).astype(np.float32)
+    Xd = col[:, None]
+    s = FeatureSummary.compute(Xd)
+    true_var = np.asarray(col, np.float64).var()
+    np.testing.assert_allclose(s.variance[0], true_var, rtol=0.05)
+    a = NormalizationContext.build(Xd, NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+                                   intercept_index=None)
+    b = NormalizationContext.from_summary(
+        s, NormalizationType.SCALE_WITH_STANDARD_DEVIATION, intercept_index=None)
+    np.testing.assert_allclose(b.factors, a.factors, rtol=0.05)
+
+
+def test_large_mean_variance_sparse(rng):
+    # Same cancellation check through the sparse path (stored entries +
+    # implicit-zero term).
+    col = rng.normal(3000.0, 0.5, size=512)
+    M = sp.csr_matrix(np.stack([col, np.zeros(512)], 1))
+    M[::2, 1] = 1.0
+    M.eliminate_zeros()
+    s = FeatureSummary.compute(from_scipy_csr(M.tocsr()))
+    np.testing.assert_allclose(s.variance[0], col.var(), rtol=0.05)
+    np.testing.assert_allclose(s.variance[1], 0.25, rtol=1e-3)
+
+
+def test_roundtrip_precision_large_counts():
+    # num_nonzeros must survive save/load exactly above 2^24.
+    s = FeatureSummary(
+        count=30_000_000, mean=np.array([1.0]), variance=np.array([2.0]),
+        minimum=np.array([0.0]), maximum=np.array([9.0]),
+        abs_max=np.array([9.0]), norm_l1=np.array([3.0]),
+        norm_l2=np.array([4.0]), num_nonzeros=np.array([20_000_001]))
+    import tempfile, os
+    p = os.path.join(tempfile.mkdtemp(), "s.json")
+    s.save(p)
+    s2 = FeatureSummary.load(p)
+    assert int(s2.num_nonzeros[0]) == 20_000_001
+    assert s2.num_nonzeros.dtype == np.int64
+
+
+def test_make_batch_accepts_sharded_hybrid(sparse_with_zeros, rng):
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.data.matrix import from_scipy_csr as f, shard_hybrid
+
+    X = shard_hybrid(f(sparse_with_zeros), 4, d_dense=8)
+    b = make_batch(X, rng.uniform(size=X.shape[0]).astype(np.float32))
+    assert b.X is X and b.n == X.shape[0]
