@@ -174,3 +174,33 @@ def test_sharded_hybrid_solve_collectives(rng, mesh8):
     assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
     for bad in ("all-to-all(", "collective-permute(", "all-gather("):
         assert bad not in hlo, f"unexpected collective {bad} in hybrid solve"
+
+
+def test_sharded_hybrid_on_hybrid_mesh(rng, hybrid_mesh):
+    """ShardedHybridRows solves on a 2-D (replica × data) mesh: tails shard
+    over BOTH axes, psums lower hierarchically, results match single-device."""
+    import scipy.sparse as sp
+
+    from photon_tpu.data.dataset import shard_hybrid_batch
+    from photon_tpu.data.matrix import from_scipy_csr
+    from photon_tpu.optim.config import OptimizerConfig as OC
+
+    n, d, k = 640, 48, 6
+    cols = rng.integers(0, d, size=(n, k))
+    M = sp.csr_matrix((rng.normal(size=n * k).astype(np.float32),
+                       (np.repeat(np.arange(n), k), cols.ravel())),
+                      shape=(n, d))
+    M.sum_duplicates()
+    X = from_scipy_csr(M)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    cfg = OC(max_iters=30, reg=reg.l2(), reg_weight=1.0,
+             regularize_intercept=True)
+    m_ref, _ = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    b = shard_hybrid_batch(make_batch(X, y), hybrid_mesh.devices.size,
+                           d_dense=16)
+    m_sh, res = train_glm(b, TaskType.LOGISTIC_REGRESSION, cfg,
+                          mesh=hybrid_mesh)
+    assert not bool(res.failed)
+    np.testing.assert_allclose(np.asarray(m_sh.coefficients.means),
+                               np.asarray(m_ref.coefficients.means),
+                               atol=5e-3)

@@ -141,3 +141,133 @@ def test_prior_incremental_training(rng):
     strong, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg,
                           prior_mean=mu, prior_precision=jnp.full((5,), 1e6))
     np.testing.assert_allclose(strong.weights, mu, atol=1e-2)
+
+
+class TestTrainGlmGrid:
+    """train_glm_grid: one compiled program per reg-weight sweep."""
+
+    def _problem(self, rng, n=512, d=12):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d).astype(np.float32) / np.sqrt(d)
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w)))).astype(
+            np.float32)
+        return make_batch(X, y)
+
+    def test_matches_sequential_l2(self, rng):
+        from photon_tpu.models.training import train_glm_grid
+
+        batch = self._problem(rng)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=0.0,
+                              regularize_intercept=True)
+        weights = [0.1, 1.0, 10.0]
+        grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                              weights)
+        assert len(grid) == 3
+        for wt, (m_g, r_g) in zip(weights, grid):
+            import dataclasses
+
+            m_s, r_s = train_glm(
+                batch, TaskType.LOGISTIC_REGRESSION,
+                dataclasses.replace(cfg, reg_weight=wt))
+            assert bool(r_g.converged)
+            np.testing.assert_allclose(
+                np.asarray(m_g.coefficients.means),
+                np.asarray(m_s.coefficients.means), atol=2e-4)
+
+    def test_matches_sequential_owlqn(self, rng):
+        """Grid lanes must equal the same-route single solve bit-for-bit-ish
+        (train_glm's single-device OWLQN takes the pallas fused route, whose
+        f32 rounding diverges the iterate path — so compare against the jnp
+        objective the grid itself uses)."""
+        from photon_tpu.models.training import (
+            make_objective, solve, train_glm_grid)
+        from photon_tpu.optim.config import OptimizerType
+
+        batch = self._problem(rng)
+        cfg = OptimizerConfig(optimizer=OptimizerType.OWLQN, max_iters=60,
+                              reg=reg.l1(), reg_weight=0.0,
+                              regularize_intercept=True)
+        weights = [0.5, 5.0]
+        grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                              weights)
+        d = batch.X.shape[1]
+        w0 = np.zeros(d, np.float32)
+        for wt, (m_g, r_g) in zip(weights, grid):
+            import dataclasses
+
+            c = dataclasses.replace(cfg, reg_weight=wt)
+            obj = make_objective(TaskType.LOGISTIC_REGRESSION, c, d)
+            r_s = solve(obj, batch, w0, c)
+            np.testing.assert_allclose(np.asarray(m_g.coefficients.means),
+                                       np.asarray(r_s.w), atol=1e-5)
+        # stronger L1 → sparser lane
+        nnz = [int((np.abs(np.asarray(m.coefficients.means)) > 1e-6).sum())
+               for m, _ in grid]
+        assert nnz[1] <= nnz[0]
+
+    def test_l1_grid_routes_owlqn_without_config_weight(self, rng):
+        """An L1 grid whose config carries reg_weight=0.0 (the natural grid
+        idiom) must still run OWL-QN lanes with the grid's weights —
+        regression: effective_optimizer() saw l1_weight(0.0)==0 and silently
+        dropped ALL regularization, every lane returning the same
+        unpenalized solution."""
+        from photon_tpu.models.training import train_glm_grid
+
+        batch = self._problem(rng)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l1(), reg_weight=0.0,
+                              regularize_intercept=True)
+        grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                              [0.5, 20.0])
+        w_weak = np.asarray(grid[0][0].coefficients.means)
+        w_strong = np.asarray(grid[1][0].coefficients.means)
+        assert not np.allclose(w_weak, w_strong)  # weights actually applied
+        nnz_weak = int((np.abs(w_weak) > 1e-6).sum())
+        nnz_strong = int((np.abs(w_strong) > 1e-6).sum())
+        assert nnz_strong < nnz_weak  # strong L1 produces genuine sparsity
+
+    def test_grid_on_mesh(self, rng, mesh8):
+        from photon_tpu.models.training import train_glm_grid
+
+        batch = self._problem(rng, n=1024)
+        cfg = OptimizerConfig(max_iters=40, reg=reg.l2(), reg_weight=0.0,
+                              regularize_intercept=True)
+        grid_m = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                                [0.5, 5.0], mesh=mesh8)
+        grid_s = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                                [0.5, 5.0])
+        for (m_m, _), (m_s, _) in zip(grid_m, grid_s):
+            np.testing.assert_allclose(
+                np.asarray(m_m.coefficients.means),
+                np.asarray(m_s.coefficients.means), atol=2e-3)
+
+    def test_grid_with_variances_and_normalization(self, rng):
+        from photon_tpu.data.normalization import (
+            NormalizationContext, NormalizationType)
+        from photon_tpu.models.training import train_glm_grid
+        from photon_tpu.models.variance import VarianceComputationType
+
+        rng2 = np.random.default_rng(3)
+        n, d = 400, 6
+        X = np.concatenate([rng2.normal(2.0, 5.0, size=(n, d - 1)),
+                            np.ones((n, 1))], 1).astype(np.float32)
+        y = (rng2.uniform(size=n) < 0.4).astype(np.float32)
+        batch = make_batch(X, y)
+        norm = NormalizationContext.build(X, NormalizationType.STANDARDIZATION)
+        cfg = OptimizerConfig(max_iters=50, reg=reg.l2(), reg_weight=0.0,
+                              regularize_intercept=True)
+        grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                              [1.0, 3.0], normalization=norm,
+                              variance=VarianceComputationType.SIMPLE)
+        for wt, (m_g, _) in zip([1.0, 3.0], grid):
+            import dataclasses
+
+            m_s, _ = train_glm(batch, TaskType.LOGISTIC_REGRESSION,
+                               dataclasses.replace(cfg, reg_weight=wt),
+                               normalization=norm,
+                               variance=VarianceComputationType.SIMPLE)
+            np.testing.assert_allclose(
+                np.asarray(m_g.coefficients.means),
+                np.asarray(m_s.coefficients.means), atol=2e-3)
+            np.testing.assert_allclose(
+                np.asarray(m_g.coefficients.variances),
+                np.asarray(m_s.coefficients.variances), rtol=2e-2)
