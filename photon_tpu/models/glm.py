@@ -70,6 +70,20 @@ class GeneralizedLinearModel:
         raise ValueError(f"{self.task} is not a classification task")
 
 
+@jax.jit
+def _score_many(W, X, offsets):
+    return jax.vmap(lambda w: matvec(X, w))(W) + offsets
+
+
+def score_models(models, X: Matrix, offsets=0.0) -> jax.Array:
+    """(G, n) raw margins of G same-shape models over one design matrix, as
+    ONE device program — the scoring side of a `train_glm_grid` sweep (the
+    dense case compiles to a single (n, d)×(d, G) matmul; per-model scoring
+    would pay a dispatch round-trip per model)."""
+    W = jnp.stack([jnp.asarray(m.coefficients.means) for m in models])
+    return _score_many(W, X, jnp.asarray(offsets, jnp.float32))
+
+
 def logistic_regression(coeffs, variances=None):
     return GeneralizedLinearModel(
         Coefficients(jnp.asarray(coeffs), variances), TaskType.LOGISTIC_REGRESSION

@@ -305,6 +305,30 @@ def train_glm_grid(
     return out
 
 
+def evaluate_glm_grid(grid, batch: GLMBatch, evaluator=None):
+    """Validation model selection over a `train_glm_grid` result
+    (reference: GameEstimator's best-model pick via Evaluator.betterThan,
+    one Spark evaluation job per grid point). The expensive part — scoring,
+    the only pass over X — runs for all lanes in one device program
+    (`models.glm.score_models`); the (n,)-sized metric reductions then run
+    per lane. Returns ``(best_index, [score per lane])``.
+    """
+    from photon_tpu.evaluation.evaluator import default_evaluator
+    from photon_tpu.models.glm import score_models
+
+    task = grid[0][0].task
+    evaluator = evaluator if evaluator is not None else default_evaluator(task)
+    margins = np.asarray(score_models([m for m, _ in grid], batch.X,
+                                      batch.offsets))
+    scores = [float(evaluator.evaluate(margins[i], batch.y, batch.weights))
+              for i in range(len(grid))]
+    best = 0
+    for i in range(1, len(scores)):
+        if evaluator.better_than(scores[i], scores[best]):
+            best = i
+    return best, scores
+
+
 def _l1_lam(config: OptimizerConfig):
     """The dynamic L1 weight for a solve (None on smooth routes) — the one
     place the OWLQN lam is derived, shared by fixed- and random-effect

@@ -271,3 +271,27 @@ class TestTrainGlmGrid:
             np.testing.assert_allclose(
                 np.asarray(m_g.coefficients.variances),
                 np.asarray(m_s.coefficients.variances), rtol=2e-2)
+
+    def test_score_models_and_grid_selection(self, rng):
+        from photon_tpu.models.glm import score_models
+        from photon_tpu.models.training import (
+            evaluate_glm_grid, train_glm_grid)
+
+        batch = self._problem(rng, n=800)
+        Xv = np.asarray(batch.X)[600:]
+        val = make_batch(Xv, np.asarray(batch.y)[600:])
+        tr = make_batch(np.asarray(batch.X)[:600], np.asarray(batch.y)[:600])
+        cfg = OptimizerConfig(max_iters=50, reg=reg.l2(), reg_weight=0.0,
+                              regularize_intercept=True)
+        weights = [0.1, 1.0, 1000.0]
+        grid = train_glm_grid(tr, TaskType.LOGISTIC_REGRESSION, cfg, weights)
+        # batched margins == per-model margins
+        M = np.asarray(score_models([m for m, _ in grid], val.X))
+        for i, (m, _) in enumerate(grid):
+            np.testing.assert_allclose(M[i], np.asarray(m.score(val.X)),
+                                       rtol=1e-5, atol=1e-5)
+        best, scores = evaluate_glm_grid(grid, val)
+        assert len(scores) == 3
+        # default logistic evaluator is AUC; the absurdly over-regularized
+        # lane must not win
+        assert best != 2
