@@ -107,6 +107,14 @@ class GameEstimator:
     # entity-id column for sharded (per-entity) validation evaluators;
     # defaults to the first random-effect coordinate's entity type.
     evaluator_entity: Optional[str] = None
+    # Fixed-effect-only models whose config_grid varies nothing but the
+    # regularization weight run the WHOLE grid as one compiled program
+    # (models.training.train_glm_grid: vmapped lanes share every X pass).
+    # Semantics difference vs the sequential path: lanes run concurrently,
+    # so `warm_start` cannot chain models across grid points — every lane
+    # starts from zeros (each still converges to its own optimum within
+    # tolerance). Set False to force the sequential warm-started sweep.
+    vectorized_grid: bool = True
 
     @staticmethod
     def _dataset_key(cfg: CoordinateConfig) -> tuple:
@@ -198,7 +206,9 @@ class GameEstimator:
         GameOptimizationConfiguration per model). None trains a single model
         with `coordinate_configs`. Successive models warm-start from the
         previous one when `warm_start` (reference: GameEstimator warm start
-        across regularization weights). Datasets are cached per
+        across regularization weights) — EXCEPT on the vectorized
+        fixed-effect-only grid path (see `vectorized_grid`), whose lanes
+        run concurrently from zeros. Datasets are cached per
         (shard, entity, active_cap) so overrides that change only the
         optimizer reuse the bucketed blocks.
         """
@@ -209,6 +219,21 @@ class GameEstimator:
             # One transfer for the whole grid: every grid point scores the
             # same validation shards.
             validation = validation.to_device()
+
+        # The vectorized path must be a semantic no-op apart from warm
+        # starts: engage only for true multi-point grids where a sweep is a
+        # single solve (n_sweeps == 1, no custom update sequence) — with
+        # n_sweeps > 1 the sequential path re-solves the coordinate each
+        # sweep (extra warm-started iterations), which one lane can't mimic.
+        if (self.vectorized_grid and len(grid) >= 2 and self.n_sweeps == 1
+                and not self.locked and not self.incremental
+                and not initial_models):
+            probe = self._fixed_only_reg_grid(grid)
+            if probe is not None and (
+                    self.update_sequence is None
+                    or list(self.update_sequence) == [probe[0]]):
+                return self._fit_fixed_grid(probe, data, validation,
+                                            evaluator, dataset_cache)
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})
@@ -251,6 +276,83 @@ class GameEstimator:
             results.append(result)
             if self.warm_start:
                 prev_models = dict(descent.model.coordinates)
+        return results
+
+    def _fixed_only_reg_grid(self, grid):
+        """(name, base_config, [reg_weight per grid point]) when the model
+        is a single fixed effect and the grid varies ONLY its regularization
+        weight; None otherwise (→ sequential path)."""
+        if len(self.coordinate_configs) != 1:
+            return None
+        ((name, base),) = self.coordinate_configs.items()
+        if not isinstance(base, FixedEffectConfig):
+            return None
+        weights = []
+        for overrides in grid:
+            if set(overrides) - {name}:
+                return None
+            cfg = {**self.coordinate_configs, **overrides}[name]
+            if (not isinstance(cfg, FixedEffectConfig)
+                    or cfg.feature_shard != base.feature_shard):
+                return None
+            if (dataclasses.replace(cfg.optimizer, reg_weight=0.0)
+                    != dataclasses.replace(base.optimizer, reg_weight=0.0)):
+                return None
+            weights.append(float(cfg.optimizer.reg_weight))
+        return name, base, weights
+
+    def _fit_fixed_grid(self, probe, data: GameData, validation,
+                        evaluator: Evaluator, dataset_cache) -> list:
+        """The vectorized fixed-effect grid: one train_glm_grid sweep, one
+        batched scoring pass per (train, validation) matrix."""
+        import jax.numpy as jnp
+
+        from photon_tpu.game.model import FixedEffectModel
+        from photon_tpu.models.glm import score_models
+        from photon_tpu.models.training import train_glm_grid
+        from photon_tpu.ops.losses import loss_fns
+
+        name, base, weights = probe
+        key = self._dataset_key(base)
+        if key not in dataset_cache:
+            dataset_cache[key] = self._build_dataset(data, base)
+        ds = dataset_cache[key]
+        norm = self._normalization_for(name, ds)
+        grid = train_glm_grid(
+            ds.batch(jnp.asarray(data.offsets)), self.task, base.optimizer,
+            weights, mesh=self.mesh, variance=self.variance,
+            normalization=norm)
+        models = [m for m, _ in grid]
+        # Per-lane total training objective (unregularized weighted loss —
+        # what coordinate_descent's objective_history records), from ONE
+        # batched scoring pass.
+        loss, _, _ = loss_fns(self.task)
+        margins = score_models(models, ds.X, jnp.asarray(data.offsets))
+        objectives = np.asarray(
+            jnp.sum(ds.weights * loss(margins, ds.y), axis=1))
+        val_margins = None
+        if validation is not None:
+            Xv = validation.shards[base.feature_shard]
+            val_margins = np.asarray(score_models(
+                models, Xv, jnp.asarray(validation.offsets)))
+        results = []
+        for i, (model, res) in enumerate(grid):
+            cfg_i = FixedEffectConfig(
+                base.feature_shard,
+                dataclasses.replace(base.optimizer, reg_weight=weights[i]))
+            game_model = GameModel(
+                {name: FixedEffectModel(model, base.feature_shard)},
+                self.task)
+            descent = CoordinateDescentResult(
+                model=game_model,
+                objective_history=[float(objectives[i])],
+                coordinate_stats={name: [res]},
+            )
+            r = GameFitResult(game_model, descent, {name: cfg_i})
+            if val_margins is not None:
+                r.validation_score = self._evaluate(
+                    evaluator, val_margins[i], validation)
+            results.append(r)
         return results
 
     def _evaluate(self, evaluator: Evaluator, scores, validation: GameData) -> float:

@@ -1,6 +1,7 @@
 """GAME end-to-end tests (SURVEY.md §4 integration strategy): synthetic
 mixed-effect data must recover planted coefficients, GAME must beat a
 fixed-effect-only model, and everything must run on the 8-device mesh."""
+import dataclasses
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -483,3 +484,111 @@ def test_estimator_normalization_detects_intercept():
     )
     r = est2.fit(data)[0]
     assert np.isfinite(np.asarray(r.model["fixed"].model.weights)).all()
+
+
+class TestVectorizedFixedGrid:
+    """Fixed-effect-only reg-weight grids run as one compiled program."""
+
+    def _data(self, rng, n=600, d=10):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=d).astype(np.float32) * 0.7
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ w)))).astype(
+            np.float32)
+        return GameData.build(y, shards={"fixed": X}, entity_ids={})
+
+    def test_matches_sequential_path(self, rng):
+        data = self._data(rng)
+        val = self._data(rng, n=300)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        grid = [{"fixed": FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg, reg_weight=wt))}
+            for wt in (0.1, 1.0, 10.0)]
+
+        def run(vectorized, warm):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+                n_sweeps=1, vectorized_grid=vectorized, warm_start=warm)
+            return est.fit(data, validation=val, config_grid=grid)
+
+        fast = run(True, False)
+        slow = run(False, False)
+        assert len(fast) == len(slow) == 3
+        for rf, rs in zip(fast, slow):
+            wf = np.asarray(
+                rf.model.coordinates["fixed"].model.coefficients.means)
+            ws = np.asarray(
+                rs.model.coordinates["fixed"].model.coefficients.means)
+            np.testing.assert_allclose(wf, ws, atol=2e-4)
+            assert abs(rf.validation_score - rs.validation_score) < 1e-3
+            np.testing.assert_allclose(rf.descent.objective_history[-1],
+                                       rs.descent.objective_history[-1],
+                                       rtol=1e-4)
+            assert rf.configs["fixed"].optimizer.reg_weight == \
+                rs.configs["fixed"].optimizer.reg_weight
+
+    def test_fast_path_not_taken_with_random_effects(self, rng):
+        """Mixed-effect grids must keep the sequential path (probe None)."""
+        data = self._data(rng)
+        ids = np.arange(data.n) % 5
+        data = GameData.build(np.asarray(data.y),
+                              shards={"fixed": np.asarray(data.shards["fixed"]),
+                                      "re": np.asarray(data.shards["fixed"])},
+                              entity_ids={"e": ids})
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "fixed": FixedEffectConfig("fixed"),
+                "per_e": RandomEffectConfig("e", "re"),
+            }, n_sweeps=1)
+        assert est._fixed_only_reg_grid([est.coordinate_configs]) is None
+
+    def test_best_model_selection_through_fast_path(self, rng):
+        data = self._data(rng)
+        val = self._data(rng, n=300)
+        cfg = OptimizerConfig(max_iters=60, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+            n_sweeps=1)
+        grid = [{"fixed": FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg, reg_weight=wt))}
+            for wt in (0.1, 1e5)]
+        results = est.fit(data, validation=val, config_grid=grid)
+        best = est.best_model(results)
+        assert best.configs["fixed"].optimizer.reg_weight == 0.1
+
+    def test_fast_path_disengages_for_sweeps_and_single_fit(self, rng):
+        """n_sweeps>1 (or no real grid) must keep the sequential path —
+        regression: the fast path silently replaced the second warm-started
+        sweep with one solve from zeros."""
+        data = self._data(rng)
+        cfg = OptimizerConfig(max_iters=15, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        grid = [{"fixed": FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg, reg_weight=wt))}
+            for wt in (0.5, 5.0)]
+
+        def run(vectorized):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+                n_sweeps=2, warm_start=True, vectorized_grid=vectorized)
+            return est.fit(data, config_grid=grid)
+
+        fast_flag, slow = run(True), run(False)
+        # identical code path ⇒ bitwise-identical coefficients
+        for rf, rs in zip(fast_flag, slow):
+            np.testing.assert_array_equal(
+                np.asarray(rf.model.coordinates["fixed"].model.coefficients.means),
+                np.asarray(rs.model.coordinates["fixed"].model.coefficients.means))
+        # plain fit() (no config_grid) likewise stays sequential: two sweeps
+        # progress further than the one-solve fast path would.
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+            n_sweeps=2)
+        (r,) = est.fit(data)
+        assert len(r.descent.objective_history) == 2
