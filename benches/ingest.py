@@ -1,0 +1,66 @@
+"""Avro ingest throughput: C++ columnar decoder vs pure Python
+(SURVEY.md §6's ingest numbers; reference: AvroDataReader on the JVM).
+
+Run: python benches/ingest.py [--rows 20000]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=20_000)
+    p.add_argument("--bag-nnz", type=int, default=12)
+    args = p.parse_args()
+
+    from photon_tpu.data.avro_io import write_avro
+    from photon_tpu.data.ingest import (
+        GameDataConfig,
+        read_game_data,
+        training_example_schema,
+    )
+    from photon_tpu.data.feature_bags import FeatureShardConfig
+
+    rng = np.random.default_rng(0)
+    n, k = args.rows, args.bag_nnz
+    schema = training_example_schema(feature_bags=("features",),
+                                     entity_fields=("memberId",))
+    records = [{
+        "response": float(rng.integers(0, 2)),
+        "offset": None, "weight": None, "uid": str(i),
+        "memberId": f"m{rng.integers(0, 1000)}",
+        "features": [
+            {"name": f"f{rng.integers(0, 5000)}", "term": "",
+             "value": float(rng.normal())}
+            for _ in range(k)
+        ],
+    } for i in range(n)]
+    path = os.path.join(tempfile.mkdtemp(), "bench.avro")
+    write_avro(path, records, schema)
+    print(f"wrote {n} records ({os.path.getsize(path) / 1e6:.1f} MB)")
+
+    cfg = GameDataConfig(
+        shards={"all": FeatureShardConfig(bags=("features",))},
+        entity_fields=("memberId",),
+    )
+    for name, use_native in (("python", False), ("native C++", True)):
+        t0 = time.perf_counter()
+        data, _ = read_game_data(path, cfg, use_native=use_native)
+        dt = time.perf_counter() - t0
+        assert data.n == n
+        print(f"{name:10s}: {dt:6.2f}s  ({n / dt:,.0f} rec/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -115,6 +115,9 @@ class TrainingParams:
     down_sampling_rate: Optional[float] = None  # binary tasks: negatives only
     sparse_k: Optional[int] = None
     warm_start: bool = True
+    # Tri-state passthrough to GameEstimator.vectorized_grid: None (default)
+    # vectorizes fixed-effect-only reg grids only when warm_start is False.
+    vectorized_grid: Optional[bool] = None
     evaluator_entity: Optional[str] = None
     # Bayesian reg-weight search (0 → grid over reg_weights lists instead)
     tuning_iters: int = 0
@@ -132,8 +135,17 @@ class TrainingParams:
     # summarizationOutputDir → BasicStatisticalSummary per shard). Relative
     # paths land under output_dir.
     summarization_output_dir: Optional[str] = None
+    # BEST saves only the selected model (best_model/); ALL additionally
+    # saves every grid point under models/<i>/ with a models.json manifest
+    # (reference: GameTrainingDriver's model output dir holds ALL trained
+    # models, tagged by their optimization configuration, alongside the
+    # best-model dir chosen on validation).
+    output_mode: str = "BEST"  # BEST | ALL
 
     def __post_init__(self):
+        if self.output_mode.upper() not in ("BEST", "ALL"):
+            raise ValueError(
+                f"output_mode must be BEST or ALL, got {self.output_mode!r}")
         self.coordinates = {
             k: (v if isinstance(v, CoordinateSpec) else CoordinateSpec(**v))
             for k, v in self.coordinates.items()
@@ -288,6 +300,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         warm_start=params.warm_start,
         evaluator_entity=params.evaluator_entity,
         normalization=normalization,
+        vectorized_grid=params.vectorized_grid,
     )
 
     with timers("train"):
@@ -310,6 +323,29 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             {n: index_maps[params.coordinates[n].feature_shard]
              for n in best.model.names()},
         )
+        if params.output_mode.upper() == "ALL":
+            manifest = []
+            for i, r in enumerate(results):
+                point_dir = os.path.join(params.output_dir, "models", str(i))
+                save_game_model(
+                    point_dir, r.model,
+                    {n: index_maps[params.coordinates[n].feature_shard]
+                     for n in r.model.names()},
+                )
+                manifest.append({
+                    "dir": point_dir,
+                    "validation_score": r.validation_score,
+                    "best": r is best,
+                    "reg_weights": {
+                        n: c.optimizer.reg_weight
+                        for n, c in r.configs.items()
+                    },
+                })
+            with open(os.path.join(params.output_dir, "models",
+                                   "models.json"), "w") as fh:
+                json.dump(manifest, fh, indent=2)
+            log.info("saved all %d models under %s", len(results),
+                     os.path.join(params.output_dir, "models"))
     log.info("timings: %s", timers.summary())
     return TrainingOutput(best, results, model_dir, timers.summary())
 

@@ -108,13 +108,16 @@ class GameEstimator:
     # defaults to the first random-effect coordinate's entity type.
     evaluator_entity: Optional[str] = None
     # Fixed-effect-only models whose config_grid varies nothing but the
-    # regularization weight run the WHOLE grid as one compiled program
+    # regularization weight can run the WHOLE grid as one compiled program
     # (models.training.train_glm_grid: vmapped lanes share every X pass).
     # Semantics difference vs the sequential path: lanes run concurrently,
     # so `warm_start` cannot chain models across grid points — every lane
     # starts from zeros (each still converges to its own optimum within
-    # tolerance). Set False to force the sequential warm-started sweep.
-    vectorized_grid: bool = True
+    # tolerance). Tri-state: None (default) vectorizes only when
+    # `warm_start` is False, so an explicitly requested warm-started sweep
+    # is never silently replaced; True forces the vectorized path (dropping
+    # warm starts); False forces the sequential path.
+    vectorized_grid: Optional[bool] = None
 
     @staticmethod
     def _dataset_key(cfg: CoordinateConfig) -> tuple:
@@ -225,7 +228,10 @@ class GameEstimator:
         # single solve (n_sweeps == 1, no custom update sequence) — with
         # n_sweeps > 1 the sequential path re-solves the coordinate each
         # sweep (extra warm-started iterations), which one lane can't mimic.
-        if (self.vectorized_grid and len(grid) >= 2 and self.n_sweeps == 1
+        vectorize = (self.vectorized_grid is True
+                     or (self.vectorized_grid is None
+                         and not self.warm_start))
+        if (vectorize and len(grid) >= 2 and self.n_sweeps == 1
                 and not self.locked and not self.incremental
                 and not initial_models):
             probe = self._fixed_only_reg_grid(grid)

@@ -174,6 +174,39 @@ def _train_run_sharded(batch, w0, obj, l1_lam, config, variance, mesh):
     )(batch, w0, obj, l1_lam)
 
 
+@partial(jax.jit, static_argnames=("config", "variance", "mesh"))
+def _train_run_sharded_grid(batch, w0, obj, l2s, l1s, config, variance,
+                            mesh):
+    """Reg-weight grid over a ShardedHybridRows batch: the vmapped lanes of
+    _train_run_grid inside the shard_map of _train_run_sharded — per-device
+    tails stay local, each lane's (value, grad) psums batch into one
+    collective per evaluation across the whole sweep."""
+    import dataclasses as _dc
+
+    axes = tuple(mesh.axis_names)
+    batch_spec = _hybrid_specs(batch.X, axes)
+    obj_spec = jax.tree_util.tree_map(lambda _: P(), obj)
+
+    def body(b, w0, obj, l2s, l1s):
+        bl = b._replace(X=b.X.local())
+
+        def one(l2v, l1v):
+            o = _dc.replace(obj, l2=l2v)
+            res = solve(o, bl, w0, config, l1_weight=l1v)
+            var = compute_variances(o, res.w, bl, variance)
+            return res, var
+
+        if l1s is None:
+            return jax.vmap(lambda l2v: one(l2v, None))(l2s)
+        return jax.vmap(one)(l2s, l1s)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(batch_spec, P(), obj_spec, P(), P()),
+        out_specs=P(),
+    )(batch, w0, obj, l2s, l1s)
+
+
 def _matrix_dim(X) -> int:
     return (X.n_features
             if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows))
@@ -193,6 +226,22 @@ def _init_w0(d, w0, norm):
     if norm is not None:
         return jnp.asarray(norm.to_normalized_space(np.asarray(w0)))
     return jnp.asarray(w0)
+
+
+def _sharded_prep(batch: GLMBatch, w0, mesh: Mesh):
+    """Shard-count check + device placement + psum axis name for a
+    ShardedHybridRows solve (shared by train_glm and train_glm_grid)."""
+    if batch.X.n_shards != mesh.devices.size:
+        raise ValueError(
+            f"ShardedHybridRows has {batch.X.n_shards} shards but the mesh "
+            f"has {mesh.devices.size} devices; rebuild with "
+            "data.dataset.shard_hybrid_batch(batch, mesh.devices.size)")
+    axes = tuple(mesh.axis_names)
+    batch = jax.device_put(
+        batch, _hybrid_specs(batch.X, axes,
+                             wrap=lambda s: NamedSharding(mesh, s)))
+    w0 = jax.device_put(w0, replicated(mesh))
+    return batch, w0, (axes[0] if len(axes) == 1 else axes)
 
 
 def _mesh_prep(batch: GLMBatch, w0, mesh: Mesh):
@@ -257,10 +306,8 @@ def train_glm_grid(
     import dataclasses as _dc
 
     d = _matrix_dim(batch.X)
-    if isinstance(batch.X, ShardedHybridRows) and mesh is not None:
-        raise ValueError(
-            "train_glm_grid does not yet run ShardedHybridRows under a "
-            "mesh; use SparseRows/dense with a mesh, or mesh=None")
+    sharded_hybrid = mesh is not None and isinstance(batch.X,
+                                                     ShardedHybridRows)
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
     weights = [float(wt) for wt in reg_weights]
@@ -280,11 +327,19 @@ def train_glm_grid(
         config, reg_weight=0.0,
         optimizer=(OptimizerType.OWLQN if use_owlqn
                    else config.effective_optimizer()))
-    obj = make_objective(task, config, d, normalization=norm)
-    if mesh is not None:
-        batch, w0 = _mesh_prep(batch, w0, mesh)
-    res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,
-                               variance)
+    axis_name = None
+    if sharded_hybrid:
+        batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
+    obj = make_objective(task, config, d, axis_name=axis_name,
+                         normalization=norm)
+    if sharded_hybrid:
+        res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
+                                           static_cfg, variance, mesh)
+    else:
+        if mesh is not None:
+            batch, w0 = _mesh_prep(batch, w0, mesh)
+        res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,
+                                   variance)
     # ONE host transfer for the whole sweep, then pure-numpy lane assembly:
     # per-lane device slicing would pay a dispatch round-trip per lane per
     # field (ruinous over a remote-tunnel link). The returned leaves are
@@ -417,8 +472,7 @@ def train_glm(
                                                      ShardedHybridRows)
     axis_name = None
     if sharded_hybrid:
-        axes = tuple(mesh.axis_names)
-        axis_name = axes[0] if len(axes) == 1 else axes
+        batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
     obj = make_objective(task, config, d, axis_name=axis_name,
                          prior_mean=prior_mean, prior_precision=prior_precision,
                          normalization=norm,
@@ -426,15 +480,6 @@ def train_glm(
                          fused=use_fused)
 
     if sharded_hybrid:
-        if batch.X.n_shards != mesh.devices.size:
-            raise ValueError(
-                f"ShardedHybridRows has {batch.X.n_shards} shards but the "
-                f"mesh has {mesh.devices.size} devices; rebuild with "
-                "data.dataset.shard_hybrid_batch(batch, mesh.devices.size)")
-        batch = jax.device_put(
-            batch, _hybrid_specs(batch.X, tuple(mesh.axis_names),
-                                 wrap=lambda s: NamedSharding(mesh, s)))
-        w0 = jax.device_put(w0, replicated(mesh))
         res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
                                       _static_config(config), variance, mesh)
     elif mesh is not None:

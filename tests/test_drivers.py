@@ -221,3 +221,51 @@ class TestSummarization:
         assert abs(float(s_fixed.mean[-1]) - 1.0) < 1e-6
         assert float(s_fixed.variance[-1]) < 1e-8
         assert 0.7 < float(s_fixed.std[0]) < 1.3
+
+
+class TestOutputModeAll:
+    def test_all_models_saved_with_manifest(self, job_dirs):
+        import json as _json
+
+        from photon_tpu.data.model_io import load_game_model
+
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(root / "out_all"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates={
+                **COORDINATES,
+                "fixed": {**COORDINATES["fixed"],
+                          "reg_weights": [0.1, 10.0]},
+            },
+            entity_fields=["userId"],
+            n_sweeps=1,
+            output_mode="ALL",
+        )
+        out = run_training(params)
+        with open(root / "out_all" / "models" / "models.json") as fh:
+            manifest = _json.load(fh)
+        assert len(manifest) == 2
+        assert sum(1 for m in manifest if m["best"]) == 1
+        regs = [m["reg_weights"]["fixed"] for m in manifest]
+        assert sorted(regs) == [0.1, 10.0]
+        for m in manifest:
+            gm, _ = load_game_model(m["dir"])
+            assert set(gm.names()) == {"fixed", "perUser"}
+            assert m["validation_score"] is not None
+
+    def test_bad_output_mode_rejected(self, job_dirs):
+        # fails fast at construction, before any training runs
+        root, *_ = job_dirs
+        with pytest.raises(ValueError, match="BEST or ALL"):
+            TrainingParams(
+                train_path=str(root / "train.avro"),
+                output_dir=str(root / "out_bad"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates=COORDINATES,
+                entity_fields=["userId"],
+                n_sweeps=1,
+                output_mode="SOME",
+            )

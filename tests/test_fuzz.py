@@ -1,0 +1,95 @@
+"""Randomized cross-product sanity: every (task × optimizer) on random
+problems with random weights/offsets must reach (or beat, modulo f32) the
+objective scipy's f64 L-BFGS-B finds on the IDENTICAL objective function.
+
+This is the breadth counterpart to the targeted parity tests: it sweeps the
+loss × solver matrix the reference exercises across its *FunctionTest and
+*OptimizerTest suites with fresh random draws each seed.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.models.training import make_objective, solve
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim import regularization as reg
+from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+
+TASKS = [
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.LINEAR_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+]
+OPTS = [OptimizerType.LBFGS, OptimizerType.TRON]
+
+
+def _random_problem(task, seed, n=300, d=8):
+    rng = np.random.default_rng(seed)
+    X = (rng.normal(size=(n, d)) * 0.5).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32) * 0.6
+    z = X @ w_true
+    if task is TaskType.LINEAR_REGRESSION:
+        y = (z + 0.2 * rng.normal(size=n)).astype(np.float32)
+    elif task is TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(z, -4, 4))).astype(np.float32)
+    else:
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+        if task is TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+            y = y  # hinge losses take {0,1} labels like the reference
+    weights = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+    offsets = (rng.normal(size=n) * 0.3).astype(np.float32)
+    return make_batch(X, y, weights=weights, offsets=offsets)
+
+
+def _scipy_optimum(obj, batch, d):
+    def fun(w):
+        return float(obj.value(jnp.asarray(w, jnp.float32), batch))
+
+    def jac(w):
+        return np.asarray(obj.grad(jnp.asarray(w, jnp.float32), batch),
+                          np.float64)
+
+    r = scipy.optimize.minimize(fun, np.zeros(d), jac=jac, method="L-BFGS-B",
+                                options={"maxiter": 500, "ftol": 1e-12})
+    return float(r.fun)
+
+
+@pytest.mark.parametrize("task", TASKS, ids=lambda t: t.name)
+@pytest.mark.parametrize("opt", OPTS, ids=lambda o: o.name)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_reaches_scipy_objective(task, opt, seed):
+    batch = _random_problem(task, seed)
+    d = batch.X.shape[1]
+    config = OptimizerConfig(optimizer=opt, max_iters=200, tolerance=1e-9,
+                             reg=reg.l2(), reg_weight=0.3,
+                             regularize_intercept=True)
+    obj = make_objective(task, config, d)
+    res = solve(obj, batch, jnp.zeros((d,), jnp.float32), config)
+    ours = float(res.value)
+    ref = _scipy_optimum(obj, batch, d)
+    # f32 solver vs f64 scipy on the same objective: equal to f32 slack.
+    assert ours <= ref * (1 + 1e-3) + 1e-3, (task, opt, seed, ours, ref)
+    assert np.isfinite(np.asarray(res.w)).all()
+
+
+@pytest.mark.parametrize("task", TASKS, ids=lambda t: t.name)
+def test_owlqn_zero_l1_equals_lbfgs(task):
+    """OWL-QN with λ=0 must coincide with plain L-BFGS (the pseudo-gradient
+    reduces to the gradient, the orthant projection to a no-op)."""
+    batch = _random_problem(task, seed=7)
+    d = batch.X.shape[1]
+    cfg_l = OptimizerConfig(max_iters=150, tolerance=1e-9, reg=reg.l2(),
+                            reg_weight=0.5)
+    obj = make_objective(task, cfg_l, d)
+    res_l = solve(obj, batch, jnp.zeros((d,), jnp.float32), cfg_l)
+    cfg_o = OptimizerConfig(optimizer=OptimizerType.OWLQN, max_iters=150,
+                            tolerance=1e-9, reg=reg.l2(), reg_weight=0.5)
+    res_o = solve(obj, batch, jnp.zeros((d,), jnp.float32), cfg_o,
+                  l1_weight=0.0)
+    np.testing.assert_allclose(float(res_o.value), float(res_l.value),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(res_o.w), np.asarray(res_l.w),
+                               atol=2e-3)

@@ -552,7 +552,7 @@ class TestVectorizedFixedGrid:
         est = GameEstimator(
             task=TaskType.LOGISTIC_REGRESSION,
             coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
-            n_sweeps=1)
+            n_sweeps=1, vectorized_grid=True)
         grid = [{"fixed": FixedEffectConfig(
             "fixed", dataclasses.replace(cfg, reg_weight=wt))}
             for wt in (0.1, 1e5)]
@@ -592,3 +592,35 @@ class TestVectorizedFixedGrid:
             n_sweeps=2)
         (r,) = est.fit(data)
         assert len(r.descent.objective_history) == 2
+
+    def test_default_respects_warm_start(self, rng):
+        """vectorized_grid=None + warm_start=True (the defaults) must keep
+        the sequential warm-started sweep — warm starts the user asked for
+        are never silently dropped."""
+        data = self._data(rng)
+        cfg = OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0,
+                              regularize_intercept=True)
+        grid = [{"fixed": FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg, reg_weight=wt))}
+            for wt in (0.5, 5.0)]
+
+        def run(**kw):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+                n_sweeps=1, **kw)
+            return est.fit(data, config_grid=grid)
+
+        default = run()                                  # warm_start=True
+        sequential = run(vectorized_grid=False)
+        for rd, rs in zip(default, sequential):
+            np.testing.assert_array_equal(
+                np.asarray(rd.model.coordinates["fixed"].model.coefficients.means),
+                np.asarray(rs.model.coordinates["fixed"].model.coefficients.means))
+        # warm_start=False defaults into the vectorized path
+        auto = run(warm_start=False)
+        forced = run(warm_start=False, vectorized_grid=True)
+        for ra, rf in zip(auto, forced):
+            np.testing.assert_array_equal(
+                np.asarray(ra.model.coordinates["fixed"].model.coefficients.means),
+                np.asarray(rf.model.coordinates["fixed"].model.coefficients.means))

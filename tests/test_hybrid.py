@@ -232,3 +232,31 @@ class TestShardedHybrid:
         np.testing.assert_allclose(np.asarray(m_sh.coefficients.means),
                                    np.asarray(m_ref.coefficients.means),
                                    atol=5e-3)
+
+    @pytest.mark.parametrize("l1", [False, True])
+    def test_grid_on_sharded_hybrid(self, power_law, rng, mesh8, l1):
+        """train_glm_grid over a ShardedHybridRows batch: vmapped lanes
+        inside the shard_map solver, parity with single-device grid lanes."""
+        from photon_tpu.data.dataset import shard_hybrid_batch
+        from photon_tpu.models.training import train_glm_grid
+
+        X = power_law
+        n = X.shape[0]
+        z = np.asarray(matvec(X, jnp.asarray(
+            rng.normal(size=X.n_features).astype(np.float32) * 0.5)))
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+        cfg = OptimizerConfig(max_iters=30,
+                              reg=reg.l1() if l1 else reg.l2(),
+                              reg_weight=0.0, regularize_intercept=True)
+        weights = [0.5, 5.0]
+        ref = train_glm_grid(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                             cfg, weights)
+        b = shard_hybrid_batch(make_batch(X, y), mesh8.devices.size,
+                               d_dense=32)
+        got = train_glm_grid(b, TaskType.LOGISTIC_REGRESSION, cfg, weights,
+                             mesh=mesh8)
+        for (m_r, _), (m_g, r_g) in zip(ref, got):
+            assert not bool(r_g.failed)
+            np.testing.assert_allclose(np.asarray(m_g.coefficients.means),
+                                       np.asarray(m_r.coefficients.means),
+                                       atol=5e-3)
