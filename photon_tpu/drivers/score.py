@@ -48,6 +48,16 @@ class ScoringParams:
     # raw margin vs mean response (reference: the driver's logistic scores
     # go through the sigmoid for the scored output)
     output_mean: bool = True
+    # Evaluators to run when labels are present (reference: evaluatorTypes
+    # on the scoring driver too); empty → the task's default. The first one
+    # populates ScoringOutput.metric (None if it could not be computed);
+    # all land in ScoringOutput.metrics.
+    evaluators: Sequence[str] = ()
+    # Entity-id column for sharded evaluators; defaults to the model's
+    # first random-effect coordinate's entity type — the SAME fallback the
+    # training driver's validation evaluators use, so SHARDED_* numbers
+    # are comparable between run_training and run_scoring.
+    evaluator_entity: Optional[str] = None
 
     def __post_init__(self):
         self.feature_shards = {
@@ -66,6 +76,7 @@ class ScoringOutput:
     scores: np.ndarray
     output_path: str
     metric: Optional[float] = None  # when labels were present
+    metrics: dict = dataclasses.field(default_factory=dict)  # name -> value
 
 
 def run_scoring(params: ScoringParams) -> ScoringOutput:
@@ -95,10 +106,44 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     scores = np.asarray(model.mean(margin) if params.output_mean else margin)
 
     metric = None
+    metrics: dict = {}
     if has_labels:
-        ev = default_evaluator(model.task)
-        metric = ev.evaluate(np.asarray(margin), data.y, data.weights)
-        log.info("%s on scored data: %.6f", ev.kind.name, metric)
+        from photon_tpu.evaluation.evaluator import (
+            evaluator_name,
+            parse_evaluator,
+        )
+
+        from photon_tpu.game.model import RandomEffectModel
+
+        evals = ([parse_evaluator(s) for s in params.evaluators]
+                 or [default_evaluator(model.task)])
+        entity = params.evaluator_entity
+        if entity is None:
+            # training-driver fallback: the first random-effect entity
+            entity = next(
+                (cm.entity_name for cm in model.coordinates.values()
+                 if isinstance(cm, RandomEffectModel)), None)
+        m = np.asarray(margin)
+        for ev in evals:
+            if ev.needs_groups:
+                if entity is None or entity not in data.entity_ids:
+                    log.warning(
+                        "skipping %s: entity id column %r not in data "
+                        "(set ScoringParams.evaluator_entity)",
+                        ev.kind.name, entity)
+                    continue
+                _, groups = np.unique(
+                    np.asarray(data.entity_ids[entity]), return_inverse=True)
+                ev_g = dataclasses.replace(ev,
+                                           num_groups=int(groups.max()) + 1)
+                metrics[evaluator_name(ev)] = ev_g.evaluate(
+                    m, data.y, data.weights, groups)
+            else:
+                metrics[evaluator_name(ev)] = ev.evaluate(
+                    m, data.y, data.weights)
+        # the FIRST evaluator's value, not whichever happened to compute
+        metric = metrics.get(evaluator_name(evals[0]))
+        log.info("metrics on scored data: %s", metrics)
 
     os.makedirs(params.output_dir, exist_ok=True)
     out_path = os.path.join(params.output_dir, "scores.avro")
@@ -115,7 +160,7 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
         ),
         SCORED_ITEM_SCHEMA,
     )
-    return ScoringOutput(scores, out_path, metric)
+    return ScoringOutput(scores, out_path, metric, metrics)
 
 
 def main(argv=None) -> None:

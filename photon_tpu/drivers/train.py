@@ -119,6 +119,12 @@ class TrainingParams:
     # vectorizes fixed-effect-only reg grids only when warm_start is False.
     vectorized_grid: Optional[bool] = None
     evaluator_entity: Optional[str] = None
+    # Validation evaluators (reference: GameTrainingDriver evaluatorTypes):
+    # the FIRST selects the best model; ALL are computed on the best model
+    # and reported in TrainingOutput.validation_metrics. Strings like
+    # "AUC", "RMSE", "PRECISION@5", "SHARDED_AUC". Empty → the task's
+    # default evaluator.
+    evaluators: Sequence[str] = ()
     # Bayesian reg-weight search (0 → grid over reg_weights lists instead)
     tuning_iters: int = 0
     tuning_range: tuple = (1e-4, 1e4)
@@ -167,6 +173,10 @@ class TrainingOutput:
     results: list
     model_dir: str
     timings: dict
+    # evaluator name -> value for the BEST model on validation, one entry
+    # per TrainingParams.evaluators (reference: the driver logs every
+    # configured validation evaluator, not only the selection metric).
+    validation_metrics: dict = dataclasses.field(default_factory=dict)
 
 
 def _apply_down_sampling(data: GameData, task: TaskType, rate: float,
@@ -285,8 +295,12 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         log.info("loaded initial model with coordinates %s",
                  list(initial_models))
 
+    from photon_tpu.evaluation.evaluator import evaluator_name, parse_evaluator
+
+    evals = [parse_evaluator(s) for s in params.evaluators]
     estimator = GameEstimator(
         task=task,
+        evaluator=evals[0] if evals else None,
         coordinate_configs={
             n: s.coordinate_config() for n, s in params.coordinates.items()
         },
@@ -315,6 +329,20 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
     best = estimator.best_model(results)
     if best.validation_score is not None:
         log.info("best validation score: %.6f", best.validation_score)
+
+    validation_metrics: dict = {}
+    if evals and validation is not None:
+        # evals[0] is the selection metric fit() already computed for the
+        # best model; only the extra evaluators need a fresh scoring pass.
+        validation_metrics[evaluator_name(evals[0])] = best.validation_score
+        if len(evals) > 1:
+            from photon_tpu.game.scoring import score_game
+
+            scores = score_game(best.model, validation.to_device())
+            for ev in evals[1:]:
+                validation_metrics[evaluator_name(ev)] = \
+                    estimator.evaluate_scores(ev, scores, validation)
+        log.info("validation metrics (best model): %s", validation_metrics)
 
     with timers("save"):
         model_dir = os.path.join(params.output_dir, "best_model")
@@ -347,7 +375,8 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             log.info("saved all %d models under %s", len(results),
                      os.path.join(params.output_dir, "models"))
     log.info("timings: %s", timers.summary())
-    return TrainingOutput(best, results, model_dir, timers.summary())
+    return TrainingOutput(best, results, model_dir, timers.summary(),
+                          validation_metrics=validation_metrics)
 
 
 def _tune(estimator: GameEstimator, params: TrainingParams, data,

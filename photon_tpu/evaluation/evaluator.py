@@ -99,6 +99,41 @@ class Evaluator:
         return float(fn(scores, labels, weights))
 
 
+def parse_evaluator(spec: str) -> Evaluator:
+    """Evaluator from its config-string form (reference: the driver's
+    evaluatorTypes strings, e.g. ``AUC``, ``RMSE``, ``PRECISION@5``).
+    Accepts EvaluatorType names case-insensitively with an optional ``@k``
+    or ``:k`` suffix for the precision evaluators."""
+    s = spec.strip().upper().replace("@", ":")
+    k = None
+    if ":" in s:
+        s, _, knum = s.partition(":")
+        k = int(knum)
+    s = s.strip()
+    if s == "PRECISION":
+        s = "PRECISION_AT_K"
+    try:
+        kind = EvaluatorType[s]
+    except KeyError:
+        raise ValueError(
+            f"unknown evaluator {spec!r}; valid: "
+            f"{[e.name for e in EvaluatorType]}") from None
+    if k is not None and kind not in (EvaluatorType.PRECISION_AT_K,
+                                      EvaluatorType.SHARDED_PRECISION_AT_K):
+        raise ValueError(
+            f"evaluator {spec!r}: @k only applies to the precision "
+            "evaluators (did you mean PRECISION@k?)")
+    return Evaluator(kind, k=10 if k is None else k)
+
+
+def evaluator_name(ev: Evaluator) -> str:
+    """Display/config name round-tripping parse_evaluator."""
+    if ev.kind in (EvaluatorType.PRECISION_AT_K,
+                   EvaluatorType.SHARDED_PRECISION_AT_K):
+        return f"{ev.kind.name}@{ev.k}"
+    return ev.kind.name
+
+
 def default_evaluator(task: TaskType) -> Evaluator:
     """Per-task default suite head (reference: Driver's TaskType → evaluator)."""
     if task is TaskType.LOGISTIC_REGRESSION:

@@ -361,6 +361,12 @@ class GameEstimator:
             results.append(r)
         return results
 
+    def evaluate_scores(self, evaluator: Evaluator, scores,
+                        validation: GameData) -> float:
+        """Public alias of the validation-metric computation (used by the
+        drivers to report extra evaluators on the best model)."""
+        return self._evaluate(evaluator, scores, validation)
+
     def _evaluate(self, evaluator: Evaluator, scores, validation: GameData) -> float:
         """Run the validation evaluator; sharded evaluators group by the
         estimator's `evaluator_entity` (default: the first random-effect

@@ -269,3 +269,97 @@ class TestOutputModeAll:
                 n_sweeps=1,
                 output_mode="SOME",
             )
+
+
+class TestMultipleEvaluators:
+    def test_selection_and_reporting(self, job_dirs):
+        root, *_ = job_dirs
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(root / "out_ev"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates={
+                **COORDINATES,
+                "fixed": {**COORDINATES["fixed"], "reg_weights": [0.1, 100.0]},
+            },
+            entity_fields=["userId"],
+            n_sweeps=1,
+            evaluators=["logistic_loss", "AUC", "precision@5",
+                        "sharded_auc"],
+            evaluator_entity="userId",
+        )
+        out = run_training(params)
+        # selection ran on LOGISTIC_LOSS (lower is better)
+        losses = [r.validation_score for r in out.results]
+        assert out.best.validation_score == min(losses)
+        m = out.validation_metrics
+        assert set(m) == {"LOGISTIC_LOSS", "AUC", "PRECISION_AT_K@5",
+                          "SHARDED_AUC"}
+        assert m["LOGISTIC_LOSS"] == pytest.approx(out.best.validation_score)
+        assert 0.5 < m["AUC"] <= 1.0
+        assert 0.0 <= m["PRECISION_AT_K@5"] <= 1.0
+
+    def test_parse_evaluator_specs(self):
+        from photon_tpu.evaluation.evaluator import (
+            EvaluatorType, evaluator_name, parse_evaluator)
+
+        ev = parse_evaluator("precision@3")
+        assert ev.kind is EvaluatorType.PRECISION_AT_K and ev.k == 3
+        assert evaluator_name(ev) == "PRECISION_AT_K@3"
+        assert parse_evaluator("rmse").kind is EvaluatorType.RMSE
+        with pytest.raises(ValueError, match="unknown evaluator"):
+            parse_evaluator("nope")
+
+    def test_scoring_driver_multiple_evaluators(self, job_dirs):
+        root, *_ = job_dirs
+        tr = run_training(TrainingParams(
+            train_path=str(root / "train.avro"),
+            output_dir=str(root / "out_sc_ev"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+        ))
+        sc = run_scoring(ScoringParams(
+            model_dir=tr.model_dir,
+            data_path=str(root / "validation.avro"),
+            output_dir=str(root / "scored_ev"),
+            feature_shards=FEATURE_SHARDS,
+            entity_fields=["userId"],
+            evaluators=["AUC", "logistic_loss", "sharded_auc"],
+        ))
+        assert set(sc.metrics) == {"AUC", "LOGISTIC_LOSS", "SHARDED_AUC"}
+        assert sc.metric == pytest.approx(sc.metrics["AUC"])
+        assert 0.5 < sc.metrics["AUC"] <= 1.0
+
+    def test_metric_none_when_first_evaluator_skipped(self, job_dirs,
+                                                      tmp_path):
+        """ScoringOutput.metric must honor the FIRST evaluator, not fall
+        back to a different metric's value (regression)."""
+        root, *_ = job_dirs
+        tr = run_training(TrainingParams(
+            train_path=str(root / "train.avro"),
+            output_dir=str(tmp_path / "o"),
+            feature_shards=FEATURE_SHARDS,
+            coordinates=COORDINATES,
+            entity_fields=["userId"],
+            n_sweeps=1,
+        ))
+        sc = run_scoring(ScoringParams(
+            model_dir=tr.model_dir,
+            data_path=str(root / "validation.avro"),
+            output_dir=str(tmp_path / "s"),
+            feature_shards=FEATURE_SHARDS,
+            entity_fields=["userId"],
+            evaluators=["sharded_auc", "AUC"],
+            evaluator_entity="missingEntity",
+        ))
+        assert sc.metric is None  # first evaluator was skipped
+        assert set(sc.metrics) == {"AUC"}
+
+    def test_bad_evaluator_k_suffix_rejected(self):
+        from photon_tpu.evaluation.evaluator import parse_evaluator
+
+        with pytest.raises(ValueError, match="only applies to the precision"):
+            parse_evaluator("AUC@5")
