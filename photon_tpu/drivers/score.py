@@ -123,21 +123,18 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
             entity = next(
                 (cm.entity_name for cm in model.coordinates.values()
                  if isinstance(cm, RandomEffectModel)), None)
+        from photon_tpu.evaluation.evaluator import evaluate_with_entity
+
         m = np.asarray(margin)
         for ev in evals:
             if ev.needs_groups:
-                if entity is None or entity not in data.entity_ids:
-                    log.warning(
-                        "skipping %s: entity id column %r not in data "
-                        "(set ScoringParams.evaluator_entity)",
-                        ev.kind.name, entity)
-                    continue
-                _, groups = np.unique(
-                    np.asarray(data.entity_ids[entity]), return_inverse=True)
-                ev_g = dataclasses.replace(ev,
-                                           num_groups=int(groups.max()) + 1)
-                metrics[evaluator_name(ev)] = ev_g.evaluate(
-                    m, data.y, data.weights, groups)
+                try:
+                    metrics[evaluator_name(ev)] = evaluate_with_entity(
+                        ev, m, data.y, data.weights, data.entity_ids, entity)
+                except ValueError as e:
+                    log.warning("skipping %s: %s (set "
+                                "ScoringParams.evaluator_entity)",
+                                ev.kind.name, e)
             else:
                 metrics[evaluator_name(ev)] = ev.evaluate(
                     m, data.y, data.weights)

@@ -340,8 +340,13 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
             scores = score_game(best.model, validation.to_device())
             for ev in evals[1:]:
-                validation_metrics[evaluator_name(ev)] = \
-                    estimator.evaluate_scores(ev, scores, validation)
+                try:
+                    validation_metrics[evaluator_name(ev)] = \
+                        estimator.evaluate_scores(ev, scores, validation)
+                except ValueError as e:
+                    # an extra metric must never destroy a finished run
+                    # (the model is saved below either way)
+                    log.warning("skipping %s: %s", ev.kind.name, e)
         log.info("validation metrics (best model): %s", validation_metrics)
 
     with timers("save"):

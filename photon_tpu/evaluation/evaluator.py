@@ -99,6 +99,24 @@ class Evaluator:
         return float(fn(scores, labels, weights))
 
 
+def evaluate_with_entity(evaluator: Evaluator, scores, labels, weights,
+                         entity_ids: dict, entity: Optional[str]) -> float:
+    """Shared sharded-evaluator path for GameEstimator and both drivers:
+    densify the raw entity-id column to group ids and evaluate. ONE
+    implementation so SHARDED_* numbers are comparable everywhere.
+    Raises ValueError when the entity column is missing."""
+    import numpy as np
+
+    if entity is None or entity not in entity_ids:
+        raise ValueError(
+            f"sharded evaluator {evaluator.kind.name} needs an entity id "
+            f"column; got {entity!r}, available: {list(entity_ids)}")
+    _, groups = np.unique(np.asarray(entity_ids[entity]),
+                          return_inverse=True)
+    ev = dataclasses.replace(evaluator, num_groups=int(groups.max()) + 1)
+    return ev.evaluate(scores, labels, weights, groups)
+
+
 def parse_evaluator(spec: str) -> Evaluator:
     """Evaluator from its config-string form (reference: the driver's
     evaluatorTypes strings, e.g. ``AUC``, ``RMSE``, ``PRECISION@5``).

@@ -12,8 +12,14 @@ P@K) are excluded from the average, as in the reference.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+
+# jit at the public entry points: one dispatch per metric call (the
+# static group/k counts key the cache) — essential over remote-tunnel
+# links where every un-jitted primitive is a round-trip.
 
 
 def _sort_by_group_then_key(groups, key):
@@ -33,6 +39,7 @@ def _mean_over_valid(per_group, valid):
     )
 
 
+@partial(jax.jit, static_argnames=("num_groups",))
 def grouped_auc(scores, labels, weights, groups, num_groups: int):
     """(per_group_auc, valid_mask, mean_over_valid).
 
@@ -74,6 +81,7 @@ def grouped_auc(scores, labels, weights, groups, num_groups: int):
     return per_group, valid, _mean_over_valid(per_group, valid)
 
 
+@partial(jax.jit, static_argnames=("num_groups", "k"))
 def grouped_precision_at_k(scores, labels, weights, groups, num_groups: int, k: int):
     """(per_group_p_at_k, valid_mask, mean_over_valid).
 

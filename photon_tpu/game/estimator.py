@@ -374,22 +374,17 @@ class GameEstimator:
         evaluators do."""
         if not evaluator.needs_groups:
             return evaluator.evaluate(scores, validation.y, validation.weights)
+        from photon_tpu.evaluation.evaluator import evaluate_with_entity
+
         entity = self.evaluator_entity
         if entity is None:
             for cfg in self.coordinate_configs.values():
                 if isinstance(cfg, RandomEffectConfig):
                     entity = cfg.entity_name
                     break
-        if entity is None or entity not in validation.entity_ids:
-            raise ValueError(
-                f"sharded evaluator {evaluator.kind} needs an entity id column; "
-                f"set evaluator_entity to one of {list(validation.entity_ids)}"
-            )
-        _, groups = np.unique(
-            np.asarray(validation.entity_ids[entity]), return_inverse=True
-        )
-        ev = dataclasses.replace(evaluator, num_groups=int(groups.max()) + 1)
-        return ev.evaluate(scores, validation.y, validation.weights, groups)
+        return evaluate_with_entity(evaluator, scores, validation.y,
+                                    validation.weights,
+                                    validation.entity_ids, entity)
 
     def best_model(self, results: list) -> GameFitResult:
         """Pick by validation metric with the evaluator's direction

@@ -2,10 +2,10 @@
 from photon_tpu.tuning.gp import GaussianProcess, fit_gp
 from photon_tpu.tuning.acquisition import expected_improvement, lower_confidence_bound
 from photon_tpu.tuning.search import SearchRange, SearchSpace, candidates
-from photon_tpu.tuning.tuner import TuningResult, tune
+from photon_tpu.tuning.tuner import TuningResult, tune, tune_glm_reg
 
 __all__ = [
     "GaussianProcess", "fit_gp", "expected_improvement",
     "lower_confidence_bound", "SearchRange", "SearchSpace", "candidates",
-    "TuningResult", "tune",
+    "TuningResult", "tune", "tune_glm_reg",
 ]

@@ -15,6 +15,7 @@ log-scaling), matching the reference's normalized search space.
 from __future__ import annotations
 
 import dataclasses
+from contextlib import nullcontext
 from typing import Callable
 
 import jax
@@ -22,6 +23,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.optim.lbfgs import minimize_lbfgs
+
+
+def _host_cpu():
+    """The GP surrogate is DRIVER-side math over tiny (n≤hundreds) matrices
+    (the reference fits it on the Spark driver too). Pin it to the host CPU
+    backend: on a remote-tunnel accelerator every eager primitive and every
+    re-trace (the observation count grows each round, so shapes never
+    repeat) would be a network round-trip, turning a millisecond fit into
+    minutes."""
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:  # no CPU backend registered (unusual)
+        return None
+
 
 JITTER = 1e-6
 # f32 Cholesky of a near-noiseless kernel Gram goes unstable; floor the
@@ -72,16 +87,18 @@ class GaussianProcess:
 
     def predict(self, Xq) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Posterior mean and stddev at query points (n_q, d)."""
-        kern = KERNELS[self.kernel_name]
-        Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
-                  self.amplitude, self.inv_lengthscales)
-        mean = Kq @ self.alpha
-        v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
-        var = jnp.maximum(
-            self.amplitude + self.noise - jnp.sum(v * v, axis=0), JITTER
-        )
-        return (mean * self.y_std + self.y_mean,
-                jnp.sqrt(var) * self.y_std)
+        cpu = _host_cpu()
+        with jax.default_device(cpu) if cpu is not None else nullcontext():
+            kern = KERNELS[self.kernel_name]
+            Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
+                      self.amplitude, self.inv_lengthscales)
+            mean = Kq @ self.alpha
+            v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
+            var = jnp.maximum(
+                self.amplitude + self.noise - jnp.sum(v * v, axis=0), JITTER
+            )
+            return (mean * self.y_std + self.y_mean,
+                    jnp.sqrt(var) * self.y_std)
 
 
 def _nll_builder(X, y, kernel_name):
@@ -113,7 +130,14 @@ def fit_gp(
 ) -> GaussianProcess:
     """Fit kernel hyperparameters by exact marginal-likelihood maximization
     (reference samples them; direct optimization is cheaper and determin-
-    istic). Observations are standardized internally."""
+    istic). Observations are standardized internally. Runs on the host CPU
+    backend (see _host_cpu)."""
+    cpu = _host_cpu()
+    with jax.default_device(cpu) if cpu is not None else nullcontext():
+        return _fit_gp_body(X, y, kernel, max_iters)
+
+
+def _fit_gp_body(X, y, kernel, max_iters) -> GaussianProcess:
     X = jnp.asarray(np.asarray(X, np.float32))
     y_raw = np.asarray(y, np.float32)
     y_mean = float(y_raw.mean())

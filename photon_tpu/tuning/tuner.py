@@ -34,7 +34,7 @@ class TuningResult:
 
 
 def tune(
-    evaluate: Callable[[np.ndarray], float],
+    evaluate: Optional[Callable[[np.ndarray], float]],
     space: SearchSpace,
     n_iters: int = 20,
     n_seed: int = 5,
@@ -43,6 +43,8 @@ def tune(
     kernel: str = "matern52",
     seed: int = 0,
     initial_observations: Optional[Sequence[tuple]] = None,
+    batch_size: int = 1,
+    evaluate_batch: Optional[Callable[[np.ndarray], Sequence[float]]] = None,
 ) -> TuningResult:
     """Minimize `evaluate` over `space` (reference: HyperparameterTuner.tune).
 
@@ -50,34 +52,67 @@ def tune(
     "random" or "sobol" (the reference's RandomSearch fallback).
     initial_observations: optional [(x_original, y)] to warm-start the GP
     (the reference seeds from prior runs' observations).
+
+    batch_size > 1 proposes that many candidates per GP round via the
+    constant-liar heuristic (each pick is fantasized at the incumbent best
+    before the next pick) and hands them to `evaluate_batch` TOGETHER —
+    the hook for evaluators that amortize a whole batch into one device
+    program (models.training.train_glm_grid; see `tune_glm_reg`). The
+    reference evaluates strictly one candidate per round. When
+    `evaluate_batch` is None, candidates are evaluated by looping
+    `evaluate`.
     """
     if n_iters < 1:
         raise ValueError("n_iters must be >= 1")
+    if batch_size < 1:
+        raise ValueError("batch_size must be >= 1")
+    if evaluate is None and evaluate_batch is None:
+        raise ValueError("pass evaluate or evaluate_batch")
+    if evaluate_batch is None:
+        evaluate_batch = lambda X: [float(evaluate(x)) for x in X]  # noqa: E731
     xs_unit: list = []
     ys: list = []
     for x0, y0 in initial_observations or ():
         xs_unit.append(space.to_unit(np.asarray(x0, np.float64)))
         ys.append(float(y0))
 
+    def run_batch(units) -> None:
+        X = np.stack([space.from_unit(u) for u in units])
+        for u, y in zip(units, evaluate_batch(X)):
+            xs_unit.append(u)
+            ys.append(float(y))
+
     if method in ("random", "sobol"):
         pool = candidates(space, n_iters, "sobol" if method == "sobol" else "random",
                           seed=seed)
-        for u in pool:
-            xs_unit.append(u)
-            ys.append(float(evaluate(space.from_unit(u))))
+        # honor batch_size here too: evaluate_batch implementations size
+        # their device program (train_glm_grid lanes) per chunk
+        for i in range(0, len(pool), batch_size):
+            run_batch(list(pool[i:i + batch_size]))
     elif method == "gp":
         n_seed = min(max(n_seed, 2), n_iters)
-        for u in candidates(space, n_seed, "sobol", seed=seed):
-            xs_unit.append(u)
-            ys.append(float(evaluate(space.from_unit(u))))
-        for it in range(n_iters - n_seed):
-            gp = fit_gp(np.asarray(xs_unit, np.float32), np.asarray(ys), kernel)
-            pool = candidates(space, n_candidates, "sobol", seed=seed + 1000 + it)
-            ei = np.asarray(expected_improvement(
-                gp, pool.astype(np.float32), float(np.min(ys))))
-            u = pool[int(np.argmax(ei))]
-            xs_unit.append(u)
-            ys.append(float(evaluate(space.from_unit(u))))
+        run_batch(list(candidates(space, n_seed, "sobol", seed=seed)))
+        done, it = n_seed, 0
+        while done < n_iters:
+            q = min(batch_size, n_iters - done)
+            pool = candidates(space, n_candidates, "sobol",
+                              seed=seed + 1000 + it)
+            Xf, Yf = list(xs_unit), list(ys)
+            lie = float(np.min(ys))
+            picks: list = []
+            for _ in range(q):
+                gp = fit_gp(np.asarray(Xf, np.float32), np.asarray(Yf),
+                            kernel)
+                ei = np.asarray(expected_improvement(
+                    gp, pool.astype(np.float32), lie))
+                idx = int(np.argmax(ei))
+                picks.append(pool[idx])
+                Xf.append(pool[idx])
+                Yf.append(lie)  # constant liar: fantasize at the incumbent
+                pool = np.delete(pool, idx, axis=0)
+            run_batch(picks)
+            done += q
+            it += 1
     else:
         raise ValueError(f"unknown tuning method {method!r}")
 
@@ -90,3 +125,50 @@ def tune(
         xs=space.from_unit(xs_unit_arr),
         ys=ys_arr,
     )
+
+
+def tune_glm_reg(
+    train_batch,
+    task,
+    config,
+    val_batch,
+    n_iters: int = 16,
+    batch_size: int = 4,
+    reg_range: tuple = (1e-4, 1e4),
+    evaluator=None,
+    mesh=None,
+    seed: int = 0,
+):
+    """Bayesian search over a GLM's regularization weight with BATCHED
+    evaluations: each GP round's `batch_size` candidates train as ONE
+    `train_glm_grid` program (lanes share every X pass) and score in one
+    batched pass — the TPU-native form of the reference's
+    one-Spark-job-per-candidate HyperparameterTuner loop.
+
+    Returns ``(best_model, best_reg_weight, TuningResult)``; the tuning
+    result's ``ys`` are the minimized metric values (AUC-like metrics are
+    negated, matching the tuner's convention).
+    """
+    from photon_tpu.evaluation.evaluator import default_evaluator
+    from photon_tpu.models.training import evaluate_glm_grid, train_glm_grid
+    from photon_tpu.tuning.search import SearchRange
+
+    evaluator = evaluator if evaluator is not None else default_evaluator(task)
+    space = SearchSpace([SearchRange(*reg_range, log_scale=True)])
+    models: dict = {}
+
+    def evaluate_batch(X) -> list:
+        weights = [float(x[0]) for x in X]
+        grid = train_glm_grid(train_batch, task, config, weights, mesh=mesh)
+        _, scores = evaluate_glm_grid(grid, val_batch, evaluator)
+        out = []
+        for wt, (model, _), s in zip(weights, grid, scores):
+            y = -s if evaluator.higher_is_better else s
+            models[wt] = model
+            out.append(y)
+        return out
+
+    result = tune(None, space, n_iters=n_iters, batch_size=batch_size,
+                  evaluate_batch=evaluate_batch, seed=seed)
+    best_wt = float(result.best_x[0])
+    return models[best_wt], best_wt, result

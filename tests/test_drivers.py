@@ -363,3 +363,24 @@ class TestMultipleEvaluators:
 
         with pytest.raises(ValueError, match="only applies to the precision"):
             parse_evaluator("AUC@5")
+
+    def test_sharded_extra_metric_never_destroys_run(self, job_dirs,
+                                                     tmp_path):
+        """A sharded EXTRA evaluator with no usable entity must be skipped
+        with a warning after training, not crash before the save
+        (regression)."""
+        import os
+
+        root, *_ = job_dirs
+        out = run_training(TrainingParams(
+            train_path=str(root / "train.avro"),
+            validation_path=str(root / "validation.avro"),
+            output_dir=str(tmp_path / "o"),
+            feature_shards={"fixedShard": FEATURE_SHARDS["fixedShard"]},
+            coordinates={"fixed": COORDINATES["fixed"]},  # no random effect
+            entity_fields=[],
+            n_sweeps=1,
+            evaluators=["AUC", "sharded_auc"],
+        ))
+        assert os.path.isdir(out.model_dir)  # model was saved
+        assert set(out.validation_metrics) == {"AUC"}  # sharded skipped
