@@ -4,7 +4,7 @@ Metric (SURVEY.md §6): rows·iters/sec/chip for distributed L-BFGS logistic
 training (the hot path under every GAME fixed-effect update; reference:
 DistributedGLMLossFunction + Breeze LBFGS on a 64-executor Spark cluster).
 
-The benchmarked workload is an 8-point regularization-weight grid solved by
+The benchmarked workload is a 16-point regularization-weight grid solved by
 `train_glm_grid` as ONE compiled program — the reference's grid-search mode
 (its standard model-selection workflow), which it runs as one full Spark
 job per weight. On TPU the vmapped lanes share every pass over X (the
@@ -38,7 +38,7 @@ BASELINE_CLUSTER_ROWS_ITERS_PER_SEC = 1.0e6
 N_ROWS = 1 << 19  # 524288
 N_FEATURES = 256
 MAX_ITERS = 40
-GRID = list(np.geomspace(1e-4, 1e-2, 8))  # 8 reg weights, one program
+GRID = list(np.geomspace(1e-4, 1e-2, 16))  # 16 reg weights, one program
 
 
 def make_problem(seed: int = 0):
