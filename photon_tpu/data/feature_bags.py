@@ -40,6 +40,19 @@ class FeatureShardConfig:
     # densify when the merged space is at most this wide; else SparseRows
     dense_threshold: int = 1024
 
+    @classmethod
+    def coerce(cls, v) -> "FeatureShardConfig":
+        """Accept an instance or its JSON-config dict form (the ONE place
+        the dict schema is interpreted — every driver's __post_init__ goes
+        through here)."""
+        if isinstance(v, cls):
+            return v
+        return cls(
+            bags=tuple(v["bags"]),
+            has_intercept=v.get("has_intercept", True),
+            dense_threshold=v.get("dense_threshold", 1024),
+        )
+
 
 def build_index_map(
     records: Sequence[dict],

@@ -6,8 +6,15 @@ from photon_tpu.drivers.train import (
     run_training,
 )
 from photon_tpu.drivers.score import ScoringOutput, ScoringParams, run_scoring
+from photon_tpu.drivers.index import (
+    IndexingOutput,
+    IndexingParams,
+    load_index_maps,
+    run_indexing,
+)
 
 __all__ = [
     "CoordinateSpec", "TrainingParams", "TrainingOutput", "run_training",
     "ScoringParams", "ScoringOutput", "run_scoring",
+    "IndexingParams", "IndexingOutput", "run_indexing", "load_index_maps",
 ]

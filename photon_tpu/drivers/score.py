@@ -61,12 +61,7 @@ class ScoringParams:
 
     def __post_init__(self):
         self.feature_shards = {
-            k: (v if isinstance(v, FeatureShardConfig)
-                else FeatureShardConfig(
-                    bags=tuple(v["bags"]),
-                    has_intercept=v.get("has_intercept", True),
-                    dense_threshold=v.get("dense_threshold", 1024),
-                ))
+            k: FeatureShardConfig.coerce(v)
             for k, v in self.feature_shards.items()
         }
 

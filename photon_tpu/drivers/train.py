@@ -114,6 +114,11 @@ class TrainingParams:
     variance_type: str = "none"
     down_sampling_rate: Optional[float] = None  # binary tasks: negatives only
     sparse_k: Optional[int] = None
+    # Directory of prebuilt frozen index maps (the indexing driver's
+    # output; reference: consuming FeatureIndexingJob's PalDB maps).
+    # Features absent from the maps — e.g. pruned by min_count — are
+    # dropped at ingestion instead of being assigned fresh ids.
+    index_map_dir: Optional[str] = None
     warm_start: bool = True
     # Tri-state passthrough to GameEstimator.vectorized_grid: None (default)
     # vectorizes fixed-effect-only reg grids only when warm_start is False.
@@ -157,12 +162,7 @@ class TrainingParams:
             for k, v in self.coordinates.items()
         }
         self.feature_shards = {
-            k: (v if isinstance(v, FeatureShardConfig)
-                else FeatureShardConfig(
-                    bags=tuple(v["bags"]),
-                    has_intercept=v.get("has_intercept", True),
-                    dense_threshold=v.get("dense_threshold", 1024),
-                ))
+            k: FeatureShardConfig.coerce(v)
             for k, v in self.feature_shards.items()
         }
 
@@ -225,8 +225,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         data_cfg = GameDataConfig(
             shards=params.feature_shards, entity_fields=tuple(params.entity_fields)
         )
+        prebuilt_maps = None
+        if params.index_map_dir:
+            from photon_tpu.drivers.index import load_index_map_dir
+
+            prebuilt_maps = load_index_map_dir(params.index_map_dir,
+                                               params.feature_shards)
         data, index_maps = read_game_data(
-            params.train_path, data_cfg, sparse_k=params.sparse_k)
+            params.train_path, data_cfg, index_maps=prebuilt_maps,
+            sparse_k=params.sparse_k)
         validation = None
         if params.validation_path:
             validation, _ = read_game_data(

@@ -45,6 +45,13 @@ FEATURE_SHARDS = {
     "fixedShard": {"bags": ["global"], "has_intercept": True},
     "userShard": {"bags": ["puser"], "has_intercept": False},
 }
+from photon_tpu.data.feature_bags import FeatureShardConfig
+
+FEATURE_SHARDS_TYPED = {
+    k: FeatureShardConfig(bags=tuple(v["bags"]),
+                          has_intercept=v["has_intercept"])
+    for k, v in FEATURE_SHARDS.items()
+}
 COORDINATES = {
     "fixed": {"feature_shard": "fixedShard", "reg_type": "l2",
               "reg_weight": 0.5, "max_iters": 40},
@@ -384,3 +391,114 @@ class TestMultipleEvaluators:
         ))
         assert os.path.isdir(out.model_dir)  # model was saved
         assert set(out.validation_metrics) == {"AUC"}  # sharded skipped
+
+
+class TestIndexingDriver:
+    def test_build_save_and_reuse(self, job_dirs, tmp_path):
+        from photon_tpu.data.ingest import GameDataConfig, read_game_data
+        from photon_tpu.drivers import (IndexingParams, load_index_maps,
+                                        run_indexing)
+
+        root, *_ = job_dirs
+        out = run_indexing(IndexingParams(
+            data_path=str(root / "train.avro"),
+            output_dir=str(tmp_path / "maps"),
+            feature_shards=FEATURE_SHARDS,
+        ))
+        assert out.n_records == 600
+        # fixedShard: age + ctr + intercept
+        assert out.sizes["fixedShard"] == 3
+        maps = load_index_maps(out.map_paths)
+        assert maps["fixedShard"].frozen
+        assert maps["fixedShard"].intercept_id == 2  # intercept LAST
+        # ingestion with the prebuilt maps matches implicit ingestion
+        cfg = GameDataConfig(shards=FEATURE_SHARDS_TYPED,
+                             entity_fields=("userId",))
+        d1, implicit = read_game_data(str(root / "train.avro"), cfg)
+        d2, _ = read_game_data(str(root / "train.avro"), cfg,
+                               index_maps=maps)
+        np.testing.assert_array_equal(
+            np.asarray(d1.shards["fixedShard"]),
+            np.asarray(d2.shards["fixedShard"]))
+
+    def test_min_count_prunes_rare_features(self, tmp_path):
+        from photon_tpu.data.ingest import training_example_schema
+        from photon_tpu.drivers import IndexingParams, run_indexing
+
+        schema = training_example_schema(feature_bags=("g",),
+                                         entity_fields=())
+        recs = []
+        for i in range(20):
+            feats = [{"name": "common", "term": "", "value": 1.0}]
+            if i == 0:
+                feats.append({"name": "rare", "term": "", "value": 1.0})
+            recs.append({"response": 1.0, "offset": None, "weight": None,
+                         "uid": str(i), "g": feats})
+        write_avro(str(tmp_path / "d.avro"), recs, schema)
+        out = run_indexing(IndexingParams(
+            data_path=str(tmp_path / "d.avro"),
+            output_dir=str(tmp_path / "maps"),
+            feature_shards={"s": {"bags": ["g"], "has_intercept": False}},
+            min_count=2,
+        ))
+        assert out.sizes["s"] == 1  # only "common" survives
+
+    def test_cli(self, job_dirs, tmp_path, capsys):
+        cfg = {
+            "data_path": str(job_dirs[0] / "train.avro"),
+            "output_dir": str(tmp_path / "m"),
+            "feature_shards": FEATURE_SHARDS,
+        }
+        p = tmp_path / "job.json"
+        p.write_text(json.dumps(cfg))
+        from photon_tpu.drivers.index import main
+
+        main(["--config", str(p)])
+        printed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert printed["sizes"]["fixedShard"] == 3
+
+    def test_training_driver_consumes_prebuilt_maps(self, tmp_path):
+        """index_map_dir: min_count pruning must carry through to the
+        trained model's feature space (the offline job's purpose)."""
+        from photon_tpu.data.ingest import training_example_schema
+        from photon_tpu.drivers import IndexingParams, run_indexing
+
+        schema = training_example_schema(feature_bags=("g",),
+                                         entity_fields=())
+        rng = np.random.default_rng(0)
+        recs = []
+        for i in range(120):
+            feats = [{"name": "a", "term": "", "value": float(rng.normal())},
+                     {"name": "b", "term": "", "value": float(rng.normal())}]
+            if i == 0:
+                feats.append({"name": "rare", "term": "", "value": 1.0})
+            recs.append({"response": float(rng.integers(0, 2)),
+                         "offset": None, "weight": None, "uid": str(i),
+                         "g": feats})
+        write_avro(str(tmp_path / "d.avro"), recs, schema)
+        shards = {"s": {"bags": ["g"], "has_intercept": True}}
+        idx = run_indexing(IndexingParams(
+            data_path=str(tmp_path / "d.avro"),
+            output_dir=str(tmp_path / "maps"),
+            feature_shards=shards, min_count=2))
+        assert idx.sizes["s"] == 3  # a, b, intercept — rare pruned
+        out = run_training(TrainingParams(
+            train_path=str(tmp_path / "d.avro"),
+            output_dir=str(tmp_path / "out"),
+            feature_shards=shards,
+            coordinates={"fixed": {"feature_shard": "s", "reg_type": "l2",
+                                   "reg_weight": 1.0, "max_iters": 15}},
+            n_sweeps=1,
+            index_map_dir=str(tmp_path / "maps")))
+        w = np.asarray(out.best.model.coordinates["fixed"]
+                       .model.coefficients.means)
+        assert w.shape == (3,)  # pruned width, not 4
+        with pytest.raises(FileNotFoundError, match="no map for shard"):
+            run_training(TrainingParams(
+                train_path=str(tmp_path / "d.avro"),
+                output_dir=str(tmp_path / "out2"),
+                feature_shards={"other": {"bags": ["g"]}},
+                coordinates={"fixed": {"feature_shard": "other",
+                                       "max_iters": 2}},
+                n_sweeps=1,
+                index_map_dir=str(tmp_path / "maps")))
