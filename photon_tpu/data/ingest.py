@@ -73,7 +73,12 @@ class GameDataConfig:
     weight_field: str = "weight"
 
 
-def _to_ntv(bag_entries) -> list:
+def normalize_bag(bag_entries) -> list:
+    """Raw Avro bag entries (dicts or NameTermValue) → NameTermValue list —
+    THE canonical interpretation of a feature bag. Everything that derives
+    feature keys (ingestion's build_index_map, the indexing driver's
+    counters) must go through here so prebuilt and implicit index maps
+    can never diverge."""
     out = []
     for e in bag_entries or ():
         if isinstance(e, NameTermValue):
@@ -82,6 +87,9 @@ def _to_ntv(bag_entries) -> list:
             out.append(NameTermValue(e["name"], e.get("term", ""),
                                      float(e["value"])))
     return out
+
+
+_to_ntv = normalize_bag  # internal alias (pre-existing call sites)
 
 
 def records_to_game_data(

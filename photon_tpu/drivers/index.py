@@ -62,13 +62,17 @@ def run_indexing(params: IndexingParams) -> IndexingOutput:
         records = read_avro(params.data_path)
 
     with timers("count"):
+        from photon_tpu.data.ingest import normalize_bag
+
         counts: dict[str, Counter] = {s: Counter() for s in params.feature_shards}
         for r in records:
             for shard, cfg in params.feature_shards.items():
                 c = counts[shard]
                 for bag in cfg.bags:
-                    for ntv in r.get(bag) or ():
-                        c[feature_key(ntv["name"], ntv.get("term") or "")] += 1
+                    # same normalization as ingestion, so the prebuilt map's
+                    # keys/order match an implicitly built one exactly
+                    for ntv in normalize_bag(r.get(bag)):
+                        c[feature_key(ntv.name, ntv.term)] += 1
 
     os.makedirs(params.output_dir, exist_ok=True)
     map_paths, sizes = {}, {}

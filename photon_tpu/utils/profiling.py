@@ -1,0 +1,35 @@
+"""Profiler hooks: capture device traces around any photon-tpu region.
+
+The reference leans on Spark's UI/event log for per-stage timing; the
+TPU-native equivalent is an XLA profiler trace (viewable in
+TensorBoard/XProf: per-op device time, HBM traffic, fusion boundaries).
+Wrap any region — a solve, a GAME sweep, a bench run — and point
+TensorBoard at the directory:
+
+    from photon_tpu.utils.profiling import trace
+    with trace("/tmp/photon-trace"):
+        train_glm(batch, task, config)
+
+`annotate` adds named spans visible inside the trace timeline (host-side
+scopes; device ops launched within are attributed to them).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device/host profiler trace of the enclosed region."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span for the trace timeline (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)

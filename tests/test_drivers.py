@@ -502,3 +502,22 @@ class TestIndexingDriver:
                                        "max_iters": 2}},
                 n_sweeps=1,
                 index_map_dir=str(tmp_path / "maps")))
+
+
+class TestProfiling:
+    def test_trace_writes_profile(self, tmp_path):
+        import os
+
+        import jax.numpy as jnp
+
+        from photon_tpu.utils.profiling import annotate, trace
+
+        with trace(str(tmp_path)):
+            with annotate("tiny-matmul"):
+                x = jnp.ones((64, 64))
+                (x @ x).block_until_ready()
+        found = []
+        for base, _, files in os.walk(tmp_path):
+            found += [f for f in files if f.endswith((".pb", ".json.gz",
+                                                      ".xplane.pb"))]
+        assert found, "profiler trace produced no files"
