@@ -15,9 +15,11 @@ objective.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import json
 import os
+import shutil
 from typing import Optional, Sequence
 
 import numpy as np
@@ -152,11 +154,33 @@ class TrainingParams:
     # models, tagged by their optimization configuration, alongside the
     # best-model dir chosen on validation).
     output_mode: str = "BEST"  # BEST | ALL
+    # Restart story for long grid sweeps (the analog of rerunning a died
+    # Spark job against its HDFS outputs). With resume=True (requires
+    # output_mode=ALL), every grid point is CHECKPOINTED to models/<i>/ +
+    # models.json as soon as it finishes training, and a rerun loads the
+    # points whose full configuration signature matches instead of
+    # retraining them — so set resume=True from the FIRST run of a long
+    # sweep, and a crash at point k costs only point k. Warm starts chain
+    # through loaded models. Grid mode only; incompatible with
+    # incremental_coordinates (per-point fits would drift the priors).
+    resume: bool = False
 
     def __post_init__(self):
         if self.output_mode.upper() not in ("BEST", "ALL"):
             raise ValueError(
                 f"output_mode must be BEST or ALL, got {self.output_mode!r}")
+        if self.resume and self.output_mode.upper() != "ALL":
+            raise ValueError(
+                "resume=True needs output_mode=ALL (completed grid points "
+                "are recovered from the models/ directory it writes)")
+        if self.resume and self.tuning_iters > 0:
+            raise ValueError(
+                "resume applies to grid mode only (tuning_iters must be 0)")
+        if self.resume and self.incremental_coordinates:
+            raise ValueError(
+                "resume is not supported with incremental_coordinates: "
+                "per-point fits would re-derive the priors from the "
+                "previous grid point instead of the user's initial model")
         self.coordinates = {
             k: (v if isinstance(v, CoordinateSpec) else CoordinateSpec(**v))
             for k, v in self.coordinates.items()
@@ -177,6 +201,8 @@ class TrainingOutput:
     # per TrainingParams.evaluators (reference: the driver logs every
     # configured validation evaluator, not only the selection metric).
     validation_metrics: dict = dataclasses.field(default_factory=dict)
+    # grid points recovered from a previous run's models/ (resume=True)
+    n_resumed: int = 0
 
 
 def _apply_down_sampling(data: GameData, task: TaskType, rate: float,
@@ -324,10 +350,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         vectorized_grid=params.vectorized_grid,
     )
 
+    n_resumed = 0
     with timers("train"):
         if params.tuning_iters > 0:
             results = _tune(estimator, params, data, validation, log,
                             initial_models)
+        elif params.resume:
+            results, n_resumed = _fit_grid_resumable(
+                estimator, params, data, validation, initial_models,
+                index_maps, log)
         else:
             results = estimator.fit(
                 data, validation=validation,
@@ -364,31 +395,186 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
              for n in best.model.names()},
         )
         if params.output_mode.upper() == "ALL":
+            models_dir = os.path.join(params.output_dir, "models")
+            os.makedirs(models_dir, exist_ok=True)
+            gsig = _global_signature(params)
             manifest = []
-            for i, r in enumerate(results):
-                point_dir = os.path.join(params.output_dir, "models", str(i))
-                save_game_model(
-                    point_dir, r.model,
-                    {n: index_maps[params.coordinates[n].feature_shard]
-                     for n in r.model.names()},
-                )
-                manifest.append({
-                    "dir": point_dir,
-                    "validation_score": r.validation_score,
-                    "best": r is best,
-                    "reg_weights": {
-                        n: c.optimizer.reg_weight
-                        for n, c in r.configs.items()
-                    },
-                })
-            with open(os.path.join(params.output_dir, "models",
-                                   "models.json"), "w") as fh:
-                json.dump(manifest, fh, indent=2)
+            for r in results:
+                sig = _point_signature(gsig, r.configs)
+                point_dir = _sig_dir(models_dir, sig)
+                if not os.path.isdir(point_dir):  # resumed/checkpointed
+                    save_game_model(
+                        point_dir, r.model,
+                        {n: index_maps[params.coordinates[n].feature_shard]
+                         for n in r.model.names()},
+                    )
+                manifest.append(_manifest_row(point_dir, r, best=r is best,
+                                              sig=sig))
+            # atomic manifest replace FIRST, then prune directories no row
+            # references — a crash between the two only leaves orphans
+            _write_manifest(os.path.join(models_dir, "models.json"),
+                            manifest)
+            keep = {os.path.basename(m["dir"]) for m in manifest}
+            keep.add("models.json")
+            for name in os.listdir(models_dir):
+                p = os.path.join(models_dir, name)
+                if os.path.isdir(p) and name not in keep:
+                    shutil.rmtree(p, ignore_errors=True)
             log.info("saved all %d models under %s", len(results),
                      os.path.join(params.output_dir, "models"))
     log.info("timings: %s", timers.summary())
     return TrainingOutput(best, results, model_dir, timers.summary(),
-                          validation_metrics=validation_metrics)
+                          validation_metrics=validation_metrics,
+                          n_resumed=n_resumed)
+
+
+def _global_signature(params: TrainingParams) -> str:
+    """Every training-wide knob that changes what a grid point's model
+    means: data, sweeps, normalization, sampling, warm-start mode, …
+    Baked into each point's signature so resume can never hand back a
+    model trained under different global settings."""
+    return repr((
+        params.task, params.n_sweeps,
+        tuple(params.update_sequence or ()),
+        params.normalization, params.data_validation,
+        params.down_sampling_rate, params.seed, params.sparse_k,
+        params.train_path, params.index_map_dir,
+        tuple(sorted(params.locked_coordinates)),
+        params.warm_start, params.variance_type,
+        tuple(sorted(
+            (k, tuple(v.bags), v.has_intercept, v.dense_threshold)
+            for k, v in params.feature_shards.items())),
+    ))
+
+
+def _point_signature(global_sig: str, configs: dict) -> str:
+    """global signature + every per-coordinate knob that changes the
+    trained model (not just reg weights — a stale model trained under
+    different settings must never be resumed as this one)."""
+    parts = []
+    for n, c in sorted(configs.items()):
+        o = c.optimizer
+        parts.append((
+            n, type(c).__name__, c.feature_shard,
+            getattr(c, "entity_name", None), getattr(c, "active_cap", None),
+            o.optimizer.value, o.max_iters, o.tolerance, o.history,
+            o.cg_max_iters, o.reg.reg_type.value, o.reg.alpha,
+            float(o.reg_weight), o.regularize_intercept,
+        ))
+    return global_sig + "|" + repr(parts)
+
+
+def _sig_dir(models_dir: str, sig: str) -> str:
+    """Content-keyed model directory: the layout is keyed by signature so
+    no write can ever clobber a directory another signature maps to."""
+    return os.path.join(models_dir,
+                        "m_" + hashlib.sha1(sig.encode()).hexdigest()[:16])
+
+
+def _manifest_row(point_dir: str, r, best: bool, sig: str) -> dict:
+    hist = r.descent.objective_history
+    return {
+        "dir": point_dir,
+        "validation_score": r.validation_score,
+        "best": best,
+        "reg_weights": {n: c.optimizer.reg_weight
+                        for n, c in r.configs.items()},
+        "config_sig": sig,
+        "objective": (float(hist[-1]) if hist else None),
+    }
+
+
+def _write_manifest(path: str, rows: list) -> None:
+    """Atomic replace: a preemption mid-write must never leave truncated
+    JSON (the resume feature's own failure scenario)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(rows, fh, indent=2)
+    os.replace(tmp, path)
+
+
+def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
+                        data, validation, initial_models, index_maps, log):
+    """Fit the grid one point at a time, CHECKPOINTING each point the
+    moment it finishes, and loading points a previous (possibly died) run
+    already completed. Warm starts chain through loaded models exactly as
+    through freshly trained ones (note: under warm starts a resumed
+    point's model reflects the chain it was originally trained in).
+
+    One deliberate trade-off: a FRESH run (nothing resumable) whose grid
+    the estimator would run as ONE vectorized program keeps that path —
+    it is a single device program and loses almost nothing on a crash;
+    per-point checkpointing engages exactly where it pays, on the slow
+    sequential sweeps."""
+    from photon_tpu.data.model_io import load_game_model
+    from photon_tpu.game.coordinate_descent import CoordinateDescentResult
+    from photon_tpu.game.estimator import GameFitResult
+
+    models_dir = os.path.join(params.output_dir, "models")
+    manifest_path = os.path.join(models_dir, "models.json")
+    completed: dict = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as fh:
+            for m in json.load(fh):
+                if m.get("config_sig") and os.path.isdir(m["dir"]):
+                    completed[m["config_sig"]] = m
+
+    grid = _config_grid(params.coordinates) or [
+        {n: s.coordinate_config() for n, s in params.coordinates.items()}
+    ]
+    base = {n: s.coordinate_config() for n, s in params.coordinates.items()}
+    gsig = _global_signature(params)
+    sigs = [_point_signature(gsig, {**base, **ov}) for ov in grid]
+    if (not any(s in completed for s in sigs)
+            and estimator.would_vectorize(grid, initial_models)):
+        # nothing to resume and the whole sweep is one device program:
+        # points are persisted together in the save phase.
+        return estimator.fit(data, validation=validation, config_grid=grid,
+                             initial_models=initial_models), 0
+
+    os.makedirs(models_dir, exist_ok=True)
+    # merge view keyed by signature: flushing a fresh point must never
+    # clobber manifest rows of completed points later in the grid order
+    manifest_by_sig = dict(completed)
+    results: list = []
+    n_resumed = 0
+    prev_models = dict(initial_models or {})
+    for overrides, sig in zip(grid, sigs):
+        configs = {**base, **overrides}
+        hit = completed.get(sig)
+        if hit is not None:
+            model, _ = load_game_model(hit["dir"])
+            obj = hit.get("objective")
+            r = GameFitResult(
+                model,
+                CoordinateDescentResult(
+                    model, [] if obj is None else [obj], {}),
+                configs,
+                validation_score=hit["validation_score"],
+            )
+            n_resumed += 1
+        else:
+            r = estimator.fit(data, validation=validation,
+                              config_grid=[overrides],
+                              initial_models=prev_models)[0]
+            point_dir = _sig_dir(models_dir, sig)
+            save_game_model(
+                point_dir, r.model,
+                {n: index_maps[params.coordinates[n].feature_shard]
+                 for n in r.model.names()})
+            manifest_by_sig[sig] = _manifest_row(point_dir, r, best=False,
+                                                 sig=sig)
+            # checkpoint the manifest NOW (atomically): a crash at the
+            # next point loses only that point ("best" flags are
+            # finalized in the save phase)
+            _write_manifest(manifest_path, list(manifest_by_sig.values()))
+        results.append(r)
+        if params.warm_start:
+            prev_models = dict(r.model.coordinates)
+    if n_resumed:
+        log.info("resumed %d/%d grid points from %s", n_resumed,
+                 len(grid), manifest_path)
+    return results, n_resumed
 
 
 def _tune(estimator: GameEstimator, params: TrainingParams, data,

@@ -223,23 +223,10 @@ class GameEstimator:
             # same validation shards.
             validation = validation.to_device()
 
-        # The vectorized path must be a semantic no-op apart from warm
-        # starts: engage only for true multi-point grids where a sweep is a
-        # single solve (n_sweeps == 1, no custom update sequence) — with
-        # n_sweeps > 1 the sequential path re-solves the coordinate each
-        # sweep (extra warm-started iterations), which one lane can't mimic.
-        vectorize = (self.vectorized_grid is True
-                     or (self.vectorized_grid is None
-                         and not self.warm_start))
-        if (vectorize and len(grid) >= 2 and self.n_sweeps == 1
-                and not self.locked and not self.incremental
-                and not initial_models):
+        if self.would_vectorize(grid, initial_models):
             probe = self._fixed_only_reg_grid(grid)
-            if probe is not None and (
-                    self.update_sequence is None
-                    or list(self.update_sequence) == [probe[0]]):
-                return self._fit_fixed_grid(probe, data, validation,
-                                            evaluator, dataset_cache)
+            return self._fit_fixed_grid(probe, data, validation,
+                                        evaluator, dataset_cache)
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})
@@ -283,6 +270,27 @@ class GameEstimator:
             if self.warm_start:
                 prev_models = dict(descent.model.coordinates)
         return results
+
+    def would_vectorize(self, grid, initial_models=None) -> bool:
+        """Whether fit(config_grid=grid) would take the vectorized
+        fixed-effect path. The vectorized path must be a semantic no-op
+        apart from warm starts: engage only for true multi-point grids
+        where a sweep is a single solve (n_sweeps == 1, no custom update
+        sequence) — with n_sweeps > 1 the sequential path re-solves the
+        coordinate each sweep (extra warm-started iterations), which one
+        lane can't mimic. Public so the training driver's resume logic can
+        make the same call without duplicating the gate."""
+        vectorize = (self.vectorized_grid is True
+                     or (self.vectorized_grid is None
+                         and not self.warm_start))
+        if not (vectorize and len(grid) >= 2 and self.n_sweeps == 1
+                and not self.locked and not self.incremental
+                and not initial_models):
+            return False
+        probe = self._fixed_only_reg_grid(grid)
+        return probe is not None and (
+            self.update_sequence is None
+            or list(self.update_sequence) == [probe[0]])
 
     def _fixed_only_reg_grid(self, grid):
         """(name, base_config, [reg_weight per grid point]) when the model

@@ -521,3 +521,211 @@ class TestProfiling:
             found += [f for f in files if f.endswith((".pb", ".json.gz",
                                                       ".xplane.pb"))]
         assert found, "profiler trace produced no files"
+
+
+class TestResume:
+    def test_resume_skips_completed_points(self, job_dirs, tmp_path):
+        root, *_ = job_dirs
+
+        def make(resume):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1,
+                output_mode="ALL",
+                resume=resume,
+            )
+
+        first = run_training(make(resume=False))
+        assert first.n_resumed == 0
+        second = run_training(make(resume=True))
+        assert second.n_resumed == 2  # both points loaded, nothing retrained
+        for a, b in zip(first.results, second.results):
+            assert b.validation_score == pytest.approx(a.validation_score)
+            wa = np.asarray(
+                a.model.coordinates["fixed"].model.coefficients.means)
+            wb = np.asarray(
+                b.model.coordinates["fixed"].model.coefficients.means)
+            np.testing.assert_allclose(wb, wa, atol=1e-6)
+        assert (second.best.configs["fixed"].optimizer.reg_weight
+                == first.best.configs["fixed"].optimizer.reg_weight)
+
+    def test_resume_trains_only_missing_points(self, job_dirs, tmp_path):
+        import shutil
+
+        root, *_ = job_dirs
+
+        def make(weights, resume):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": weights},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1,
+                output_mode="ALL",
+                resume=resume,
+            )
+
+        run_training(make([0.1], resume=False))
+        # widen the grid; the 0.1 point must load, 10.0 must train fresh
+        out = run_training(make([0.1, 10.0], resume=True))
+        assert out.n_resumed == 1
+        assert len(out.results) == 2
+        regs = [r.configs["fixed"].optimizer.reg_weight for r in out.results]
+        assert regs == [0.1, 10.0]
+
+    def test_resume_requires_all_mode(self, job_dirs):
+        root, *_ = job_dirs
+        with pytest.raises(ValueError, match="output_mode=ALL"):
+            TrainingParams(
+                train_path=str(root / "train.avro"),
+                output_dir="x",
+                feature_shards=FEATURE_SHARDS,
+                coordinates=COORDINATES,
+                resume=True,
+            )
+
+    def test_died_job_resumes_from_checkpoints(self, job_dirs, tmp_path,
+                                               monkeypatch):
+        """Crash mid-grid: completed points were checkpointed as they
+        finished, so the rerun retrains only the rest (regression: nothing
+        was persisted until the whole grid succeeded)."""
+        from photon_tpu.game.estimator import GameEstimator
+
+        root, *_ = job_dirs
+
+        def make():
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 1.0, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL", resume=True,
+            )
+
+        real_fit = GameEstimator.fit
+        calls = {"n": 0}
+
+        def dying_fit(self, *a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 3:  # die while training the third point
+                raise RuntimeError("simulated preemption")
+            return real_fit(self, *a, **kw)
+
+        monkeypatch.setattr(GameEstimator, "fit", dying_fit)
+        with pytest.raises(RuntimeError, match="preemption"):
+            run_training(make())
+        monkeypatch.setattr(GameEstimator, "fit", real_fit)
+        out = run_training(make())
+        assert out.n_resumed == 2  # the two checkpointed points loaded
+        assert len(out.results) == 3
+
+    def test_changed_config_is_not_resumed(self, job_dirs, tmp_path):
+        """Any hyperparameter change invalidates the checkpoint (regression:
+        matching on reg weights alone reloaded stale models)."""
+        root, *_ = job_dirs
+
+        def make(max_iters):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "max_iters": max_iters,
+                              "reg_weights": [0.1, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL", resume=True,
+            )
+
+        run_training(make(max_iters=40))
+        out = run_training(make(max_iters=41))
+        assert out.n_resumed == 0  # different config signature → retrain
+
+    def test_resume_objective_selection_without_validation(self, job_dirs,
+                                                           tmp_path):
+        """Loaded points carry their recorded training objective, so
+        best-by-objective selection survives a resume (regression: empty
+        history compared as +inf)."""
+        root, *_ = job_dirs
+
+        def make():
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 1000.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL", resume=True,
+            )
+
+        first = run_training(make())
+        second = run_training(make())
+        assert second.n_resumed == 2
+        assert (second.best.configs["fixed"].optimizer.reg_weight
+                == first.best.configs["fixed"].optimizer.reg_weight)
+
+    def test_resume_rejects_incremental(self, job_dirs):
+        root, *_ = job_dirs
+        with pytest.raises(ValueError, match="incremental"):
+            TrainingParams(
+                train_path=str(root / "train.avro"),
+                output_dir="x", feature_shards=FEATURE_SHARDS,
+                coordinates=COORDINATES, output_mode="ALL", resume=True,
+                incremental_coordinates=["fixed"],
+                initial_model_dir="y")
+
+    def test_global_config_change_is_not_resumed(self, job_dirs, tmp_path):
+        """Changing a training-wide knob (n_sweeps here) must invalidate
+        every checkpoint (regression: signature covered only per-coordinate
+        settings, so stale models were silently reloaded)."""
+        root, *_ = job_dirs
+
+        def make(n_sweeps):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=n_sweeps, output_mode="ALL", resume=True,
+            )
+
+        run_training(make(n_sweeps=1))
+        out = run_training(make(n_sweeps=2))
+        assert out.n_resumed == 0
+        # and same-config rerun still resumes fully
+        out2 = run_training(make(n_sweeps=2))
+        assert out2.n_resumed == 2
