@@ -15,8 +15,10 @@ objective tracking.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from photon_tpu.game.fixed_effect import FixedEffectCoordinate
@@ -34,9 +36,17 @@ class CoordinateDescentResult:
     coordinate_stats: dict  # name -> list of per-update OptResult / RETrainStats
 
 
-def _total_objective(task: TaskType, y, weights, total_score) -> float:
+# The descent loop's glue is jitted so each coordinate update costs a fixed
+# handful of device dispatches (train, score, offsets, objective) — eager
+# per-primitive dispatch here dominated warm sweeps over remote-tunnel
+# links. The offsets sum is game.scoring._sum_scores (one shared jit cache).
+from photon_tpu.game.scoring import _sum_scores  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("task",))
+def _objective_at(task, y, weights, offsets, score):
     loss, _, _ = loss_fns(task)
-    return float(jnp.sum(jnp.asarray(weights) * loss(total_score, jnp.asarray(y))))
+    return jnp.sum(weights * loss(offsets + score, y))
 
 
 def coordinate_descent(
@@ -78,7 +88,6 @@ def coordinate_descent(
     y = jnp.asarray(y, jnp.float32)
     weights = jnp.asarray(weights, jnp.float32)
     base = jnp.asarray(base_offsets, jnp.float32)
-    n = y.shape[0]
 
     # Scores of any pre-existing models participate as offsets from the start
     # (reference: CoordinateDescent seeds offsets from the initial GameModel).
@@ -89,7 +98,6 @@ def coordinate_descent(
         for name in coordinates
         if name in models
     }
-    zero = jnp.zeros((n,), jnp.float32)
 
     objective_history: list = []
     coordinate_stats: dict = {name: [] for name in update_sequence}
@@ -99,18 +107,22 @@ def coordinate_descent(
             if name in locked:
                 continue
             coord = coordinates[name]
-            others = sum(
-                (s for o, s in scores.items() if o != name), start=zero
-            )
-            model, stats = coord.train(base + others,
+            offsets_full = _sum_scores(
+                base, tuple(s for o, s in scores.items() if o != name))
+            model, stats = coord.train(offsets_full,
                                        warm_start=models.get(name),
                                        prior=priors.get(name))
             models[name] = model
             scores[name] = coord.score(model)
             coordinate_stats[name].append(stats)
-            total = base + others + scores[name]
-            objective_history.append(_total_objective(task, y, weights, total))
+            # device scalar now; host conversion is deferred below so the
+            # descent loop never blocks on a readback mid-sweep
+            objective_history.append(
+                _objective_at(task, y, weights, offsets_full, scores[name]))
 
+    # one concurrent device_get for every deferred scalar (a float() per
+    # entry would pay one tunnel round-trip each)
+    objective_history = [float(v) for v in jax.device_get(objective_history)]
     ordered = {name: models[name] for name in update_sequence}
     for name in coordinates:  # score-only coordinates outside the sequence
         if name in models and name not in ordered:

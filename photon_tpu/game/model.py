@@ -35,6 +35,21 @@ class FixedEffectModel:
         return self.model.score(X)
 
 
+def _padded_coeffs(coefficients, dense_ids):
+    """(n, d) per-row coefficient gather; id == E selects the appended zero
+    row — THE unseen-entity convention, shared by scoring and the
+    incremental-prior path (coeffs_for)."""
+    d = coefficients.shape[1]
+    padded = jnp.concatenate(
+        [coefficients, jnp.zeros((1, d), coefficients.dtype)])
+    return padded[dense_ids]
+
+
+@jax.jit
+def _re_score_jit(coefficients, X, dense_ids):
+    return score_rows(X, _padded_coeffs(coefficients, dense_ids))
+
+
 def score_rows(X: Matrix, coeff_rows: jax.Array) -> jax.Array:
     """Rowwise margin x_i · c_i with a per-row coefficient vector (n, d)."""
     if isinstance(X, SparseRows):
@@ -91,13 +106,10 @@ class RandomEffectModel:
 
     def coeffs_for(self, dense_ids) -> jax.Array:
         """(n, d) per-row coefficients; id == E selects the zero row."""
-        padded = jnp.concatenate(
-            [self.coefficients, jnp.zeros((1, self.dim), self.coefficients.dtype)]
-        )
-        return padded[jnp.asarray(dense_ids)]
+        return _padded_coeffs(self.coefficients, jnp.asarray(dense_ids))
 
     def score(self, X: Matrix, dense_ids) -> jax.Array:
-        return score_rows(X, self.coeffs_for(dense_ids))
+        return _re_score_jit(self.coefficients, X, jnp.asarray(dense_ids))
 
     def model_for(self, key) -> GeneralizedLinearModel:
         """Single entity's GLM view (reference: RandomEffectModel.getModel)."""

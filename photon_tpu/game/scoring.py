@@ -29,13 +29,19 @@ def coordinate_scores(model: GameModel, data: GameData) -> dict:
     return out
 
 
+@jax.jit
+def _sum_scores(base, score_tuple):
+    out = base
+    for s in score_tuple:
+        out = out + s
+    return out
+
+
 def score_game(model: GameModel, data: GameData) -> jax.Array:
     """Total raw score: base offsets + Σ coordinate margins
     (reference: GameScoringDriver's scoreGameModel)."""
-    total = jnp.asarray(data.offsets, jnp.float32)
-    for s in coordinate_scores(model, data).values():
-        total = total + s
-    return total
+    return _sum_scores(jnp.asarray(data.offsets, jnp.float32),
+                       tuple(coordinate_scores(model, data).values()))
 
 
 def predict_mean(model: GameModel, data: GameData) -> jax.Array:

@@ -55,11 +55,13 @@ class GeneralizedLinearModel:
 
     def score(self, X: Matrix, offsets=0.0) -> jax.Array:
         """Raw margin x·w + offset (reference: computeScore)."""
-        return matvec(X, self.coefficients.means) + offsets
+        return _margin_jit(X, self.coefficients.means,
+                           jnp.asarray(offsets, jnp.float32))
 
     def predict_mean(self, X: Matrix, offsets=0.0) -> jax.Array:
         """Mean response via the inverse link (reference: computeMean)."""
-        return mean_fn(self.task)(self.score(X, offsets))
+        return _mean_jit(self.task, X, self.coefficients.means,
+                         jnp.asarray(offsets, jnp.float32))
 
     def predict_class(self, X: Matrix, offsets=0.0, threshold=0.5) -> jax.Array:
         """Binary decision for classification tasks."""
@@ -68,6 +70,18 @@ class GeneralizedLinearModel:
         if self.task is TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
             return (self.score(X, offsets) >= 0.0).astype(jnp.int32)
         raise ValueError(f"{self.task} is not a classification task")
+
+
+# Jitted at the entry point: one device dispatch per scoring call instead
+# of one per primitive (matters over remote-tunnel links).
+@jax.jit
+def _margin_jit(X, w, offsets):
+    return matvec(X, w) + offsets
+
+
+@partial(jax.jit, static_argnames=("task",))
+def _mean_jit(task, X, w, offsets):
+    return mean_fn(task)(matvec(X, w) + offsets)
 
 
 @jax.jit
