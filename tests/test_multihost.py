@@ -204,3 +204,36 @@ def test_sharded_hybrid_on_hybrid_mesh(rng, hybrid_mesh):
     np.testing.assert_allclose(np.asarray(m_sh.coefficients.means),
                                np.asarray(m_ref.coefficients.means),
                                atol=5e-3)
+
+
+def test_sharded_hybrid_grid_on_hybrid_mesh(rng, hybrid_mesh):
+    """Reg-weight grid over ShardedHybridRows on the 2-D (replica × data)
+    mesh: lanes vmapped inside shard_map, psums over both axes."""
+    import scipy.sparse as sp
+
+    from photon_tpu.data.dataset import shard_hybrid_batch
+    from photon_tpu.data.matrix import from_scipy_csr
+    from photon_tpu.models.training import train_glm_grid
+    from photon_tpu.optim.config import OptimizerConfig as OC
+
+    n, d, k = 512, 32, 6
+    cols = rng.integers(0, d, size=(n, k))
+    M = sp.csr_matrix((rng.normal(size=n * k).astype(np.float32),
+                       (np.repeat(np.arange(n), k), cols.ravel())),
+                      shape=(n, d))
+    M.sum_duplicates()
+    X = from_scipy_csr(M)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    cfg = OC(max_iters=25, reg=reg.l2(), reg_weight=0.0,
+             regularize_intercept=True)
+    ref = train_glm_grid(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                         cfg, [0.5, 5.0])
+    b = shard_hybrid_batch(make_batch(X, y), hybrid_mesh.devices.size,
+                           d_dense=8)
+    got = train_glm_grid(b, TaskType.LOGISTIC_REGRESSION, cfg, [0.5, 5.0],
+                         mesh=hybrid_mesh)
+    for (m_r, _), (m_g, r_g) in zip(ref, got):
+        assert not bool(r_g.failed)
+        np.testing.assert_allclose(np.asarray(m_g.coefficients.means),
+                                   np.asarray(m_r.coefficients.means),
+                                   atol=5e-3)
