@@ -1,0 +1,56 @@
+"""Grid-lane scaling (docs/PERF.md's table): throughput of the vmapped
+reg-weight sweep vs lane count on the headline bench problem.
+
+Run: python benches/grid_lanes.py [--lanes 8 16 32]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--lanes", type=int, nargs="+", default=[8, 16, 32])
+    p.add_argument("--reps", type=int, default=4)
+    args = p.parse_args()
+
+    import jax
+
+    import bench
+    from photon_tpu.models.training import train_glm_grid
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    batch = jax.device_put(bench.make_problem())
+    jax.block_until_ready(batch.X)
+    cfg = OptimizerConfig(max_iters=bench.MAX_ITERS, tolerance=0.0,
+                          reg=l2(), reg_weight=0.0)
+    for g in args.lanes:
+        weights = list(np.geomspace(1e-4, 1e-2, g))
+
+        def run():
+            return train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                                  weights)
+
+        grid = run()  # compile
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            grid = run()
+            best = min(best, time.perf_counter() - t0)
+        iters = sum(int(r.iterations) for _, r in grid)
+        print(f"G={g:3d}: {best * 1e3:6.0f} ms  {iters:4d} lane-iters  "
+              f"{bench.N_ROWS * iters / best:.3e} rows*iters/sec")
+
+
+if __name__ == "__main__":
+    main()
