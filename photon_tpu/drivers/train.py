@@ -149,15 +149,19 @@ class TrainingParams:
     # paths land under output_dir.
     summarization_output_dir: Optional[str] = None
     # BEST saves only the selected model (best_model/); ALL additionally
-    # saves every grid point under models/<i>/ with a models.json manifest
-    # (reference: GameTrainingDriver's model output dir holds ALL trained
-    # models, tagged by their optimization configuration, alongside the
+    # saves every grid point under models/m_<sha1-prefix>/ — directories
+    # are keyed by the point's full configuration signature, and
+    # models/models.json is the authoritative index mapping each row to
+    # its directory, scores, and reg weights (reference:
+    # GameTrainingDriver's model output dir holds ALL trained models,
+    # tagged by their optimization configuration, alongside the
     # best-model dir chosen on validation).
     output_mode: str = "BEST"  # BEST | ALL
     # Restart story for long grid sweeps (the analog of rerunning a died
     # Spark job against its HDFS outputs). With resume=True (requires
-    # output_mode=ALL), every grid point is CHECKPOINTED to models/<i>/ +
-    # models.json as soon as it finishes training, and a rerun loads the
+    # output_mode=ALL), every grid point is CHECKPOINTED to its
+    # models/m_<hash>/ dir + models.json as soon as it finishes training,
+    # and a rerun loads the
     # points whose full configuration signature matches instead of
     # retraining them — so set resume=True from the FIRST run of a long
     # sweep, and a crash at point k costs only point k. Warm starts chain
@@ -399,10 +403,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             os.makedirs(models_dir, exist_ok=True)
             gsig = _global_signature(params)
             manifest = []
-            for r in results:
-                sig = _point_signature(gsig, r.configs)
+            sigs = _point_signatures(gsig, [r.configs for r in results])
+            for r, sig in zip(results, sigs):
                 point_dir = _sig_dir(models_dir, sig)
-                if not os.path.isdir(point_dir):  # resumed/checkpointed
+                # Skip the write only when THIS run already persisted or
+                # signature-verified the point (resume mode). A non-resume
+                # run into a reused output_dir must overwrite: the
+                # signature keys on train_path, not file content, so an
+                # existing dir may hold a model from stale data.
+                if not params.resume or not os.path.isdir(point_dir):
                     save_game_model(
                         point_dir, r.model,
                         {n: index_maps[params.coordinates[n].feature_shard]
@@ -441,10 +450,35 @@ def _global_signature(params: TrainingParams) -> str:
         params.train_path, params.index_map_dir,
         tuple(sorted(params.locked_coordinates)),
         params.warm_start, params.variance_type,
+        # validation knobs: a resumed point's stored validation_score is
+        # only comparable to fresh points' scores if it was computed on
+        # the same validation data with the same SELECTION metric
+        # (evaluators[0]). Extra evaluators are reporting-only and are
+        # recomputed fresh on the best model every run, so they must not
+        # invalidate checkpoints.
+        params.validation_path,
+        (params.evaluators[0] if params.evaluators else None),
+        params.evaluator_entity,
         tuple(sorted(
             (k, tuple(v.bags), v.has_intercept, v.dense_threshold)
             for k, v in params.feature_shards.items())),
     ))
+
+
+def _point_signatures(global_sig: str, configs_list) -> list:
+    """Signatures for a whole grid, disambiguating DUPLICATE points: under
+    warm starts two identical configs at different grid positions train
+    different models (different warm-start chains), so the k-th occurrence
+    of a signature gets a '#k' suffix. Occurrence order is stable under
+    grid widening, so resume still matches."""
+    seen: dict = {}
+    out = []
+    for configs in configs_list:
+        sig = _point_signature(global_sig, configs)
+        k = seen.get(sig, 0)
+        seen[sig] = k + 1
+        out.append(sig if k == 0 else f"{sig}#{k}")
+    return out
 
 
 def _point_signature(global_sig: str, configs: dict) -> str:
@@ -524,7 +558,7 @@ def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
     ]
     base = {n: s.coordinate_config() for n, s in params.coordinates.items()}
     gsig = _global_signature(params)
-    sigs = [_point_signature(gsig, {**base, **ov}) for ov in grid]
+    sigs = _point_signatures(gsig, [{**base, **ov} for ov in grid])
     if (not any(s in completed for s in sigs)
             and estimator.would_vectorize(grid, initial_models)):
         # nothing to resume and the whole sweep is one device program:

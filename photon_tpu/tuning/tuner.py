@@ -155,20 +155,23 @@ def tune_glm_reg(
 
     evaluator = evaluator if evaluator is not None else default_evaluator(task)
     space = SearchSpace([SearchRange(*reg_range, log_scale=True)])
-    models: dict = {}
+    # models in evaluation order, so the winner is recovered by
+    # observation INDEX — keying a dict on the round-tripped float weight
+    # would silently depend on two from_unit paths staying bitwise equal
+    models: list = []
 
     def evaluate_batch(X) -> list:
         weights = [float(x[0]) for x in X]
         grid = train_glm_grid(train_batch, task, config, weights, mesh=mesh)
         _, scores = evaluate_glm_grid(grid, val_batch, evaluator)
         out = []
-        for wt, (model, _), s in zip(weights, grid, scores):
+        for (model, _), s in zip(grid, scores):
             y = -s if evaluator.higher_is_better else s
-            models[wt] = model
+            models.append(model)
             out.append(y)
         return out
 
     result = tune(None, space, n_iters=n_iters, batch_size=batch_size,
                   evaluate_batch=evaluate_batch, seed=seed)
-    best_wt = float(result.best_x[0])
-    return models[best_wt], best_wt, result
+    best = int(np.argmin(result.ys))
+    return models[best], float(result.xs[best, 0]), result

@@ -729,3 +729,125 @@ class TestResume:
         # and same-config rerun still resumes fully
         out2 = run_training(make(n_sweeps=2))
         assert out2.n_resumed == 2
+
+    def test_changed_validation_is_not_resumed(self, job_dirs, tmp_path):
+        """Resume must not reuse stored validation_scores when the
+        validation data or selection metric changed — the scores would be
+        incomparable to freshly trained points' scores and silently
+        corrupt best-model selection (regression: signature omitted
+        validation_path/evaluators)."""
+        root, *_ = job_dirs
+        other_val = tmp_path / "validation2.avro"
+        _write_game_avro(other_val, 300, seed=7)
+
+        def make(validation_path, evaluators=()):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(validation_path),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL", resume=True,
+                evaluators=evaluators,
+            )
+
+        run_training(make(root / "validation.avro"))
+        out = run_training(make(other_val))
+        assert out.n_resumed == 0  # different validation data → retrain
+        # changing the selection metric also invalidates the checkpoints
+        out2 = run_training(make(other_val, evaluators=("RMSE",)))
+        assert out2.n_resumed == 0
+        # unchanged rerun still resumes fully
+        out3 = run_training(make(other_val, evaluators=("RMSE",)))
+        assert out3.n_resumed == 2
+
+    def test_all_mode_overwrites_stale_point_dirs(self, job_dirs, tmp_path):
+        """A non-resume ALL run into a reused output_dir must overwrite
+        existing signature-keyed dirs: the signature keys on train_path,
+        not file content, so an existing dir may hold a stale model
+        (regression: the save phase skipped any dir that existed)."""
+        import shutil
+
+        from photon_tpu.data.model_io import load_game_model
+
+        root, *_ = job_dirs
+
+        def make():
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 10.0]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL",
+            )
+
+        run_training(make())
+        models_dir = tmp_path / "out" / "models"
+        with open(models_dir / "models.json") as fh:
+            manifest = json.load(fh)
+        # tamper: swap one point's on-disk model for the other's, the
+        # observable effect of train_path's content having changed
+        a, b = (m["dir"] for m in manifest[:2])
+        shutil.rmtree(a)
+        shutil.copytree(b, a)
+        out = run_training(make())
+        for r, m in zip(out.results, manifest):
+            on_disk, _ = load_game_model(m["dir"])
+            want = np.asarray(
+                r.model.coordinates["fixed"].model.coefficients.means)
+            got = np.asarray(
+                on_disk.coordinates["fixed"].model.coefficients.means)
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_duplicate_grid_points_get_distinct_dirs(self, job_dirs,
+                                                     tmp_path):
+        """Two identical grid points train different models under warm
+        starts (different warm-start chains); their signatures must not
+        collide on one models/m_<hash>/ dir (regression: the second save
+        overwrote the first, and resume handed both points one model)."""
+        from photon_tpu.data.model_io import load_game_model
+
+        root, *_ = job_dirs
+
+        def make(resume):
+            return TrainingParams(
+                train_path=str(root / "train.avro"),
+                validation_path=str(root / "validation.avro"),
+                output_dir=str(tmp_path / "out"),
+                feature_shards=FEATURE_SHARDS,
+                coordinates={
+                    **COORDINATES,
+                    "fixed": {**COORDINATES["fixed"],
+                              "reg_weights": [0.1, 0.1]},
+                },
+                entity_fields=["userId"],
+                n_sweeps=1, output_mode="ALL", resume=resume,
+            )
+
+        first = run_training(make(resume=False))
+        models_dir = tmp_path / "out" / "models"
+        with open(models_dir / "models.json") as fh:
+            manifest = json.load(fh)
+        assert len({m["dir"] for m in manifest}) == 2
+        for r, m in zip(first.results, manifest):
+            on_disk, _ = load_game_model(m["dir"])
+            np.testing.assert_allclose(
+                np.asarray(
+                    on_disk.coordinates["fixed"].model.coefficients.means),
+                np.asarray(
+                    r.model.coordinates["fixed"].model.coefficients.means),
+                atol=1e-6)
+        # and a resumed rerun recovers BOTH points
+        second = run_training(make(resume=True))
+        assert second.n_resumed == 2
