@@ -5,6 +5,10 @@ Synthetic mixed-effect logistic problem — 1M rows, 64-dim fixed effect,
 2 sweeps), warm refit, scoring, and AUC vs the fixed effect alone.
 
 Run: python benches/game_scale.py [--rows 1000000] [--entities 50000]
+
+Grid mode (--grid N): N-point reg-weight grid over BOTH coordinates,
+vectorized (lane-axis coordinate descent, game.grid) vs sequential —
+the reference's model-selection workflow, one Spark job per point there.
 """
 from __future__ import annotations
 
@@ -26,6 +30,8 @@ def main() -> None:
     p.add_argument("--d-fixed", type=int, default=64)
     p.add_argument("--d-re", type=int, default=8)
     p.add_argument("--sweeps", type=int, default=2)
+    p.add_argument("--grid", type=int, default=0,
+                   help="N-point reg grid: vectorized vs sequential timing")
     args = p.parse_args()
 
     import jax
@@ -63,16 +69,64 @@ def main() -> None:
                           entity_ids={"member": ids})
     print(f"GameData.build (entity bucketing): {time.perf_counter() - t0:.1f}s")
 
-    est = GameEstimator(
-        task=TaskType.LOGISTIC_REGRESSION,
-        coordinate_configs={
+    cfg_f = OptimizerConfig(max_iters=30, reg=l2(), reg_weight=1.0)
+    cfg_r = OptimizerConfig(max_iters=15, reg=l2(), reg_weight=5.0)
+    coordinate_configs = {
+        "fixed": FixedEffectConfig("fixed", cfg_f),
+        "per_member": RandomEffectConfig("member", "re", cfg_r),
+    }
+
+    if args.grid:
+        import dataclasses
+        import itertools
+
+        if args.grid < 2:
+            p.error("--grid needs at least 2 points (the vectorized path "
+                    "only engages for true multi-point grids)")
+        G = args.grid
+        wf = np.logspace(-1, 1, max(2, int(np.ceil(np.sqrt(G)))))
+        wr = np.logspace(0, 1.5, max(2, int(np.ceil(G / len(wf)))))
+        pairs = list(itertools.product(wf, wr))[:G]
+        grid = [{
             "fixed": FixedEffectConfig(
-                "fixed", OptimizerConfig(max_iters=30, reg=l2(),
-                                         reg_weight=1.0)),
+                "fixed", dataclasses.replace(cfg_f, reg_weight=float(a))),
             "per_member": RandomEffectConfig(
                 "member", "re",
-                OptimizerConfig(max_iters=15, reg=l2(), reg_weight=5.0)),
-        },
+                dataclasses.replace(cfg_r, reg_weight=float(b))),
+        } for a, b in pairs]
+
+        def run(vectorized):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=coordinate_configs,
+                n_sweeps=args.sweeps, warm_start=False,
+                vectorized_grid=vectorized)
+            if vectorized:
+                assert est.would_vectorize(grid, data=data), \
+                    "grid would not take the vectorized path"
+            t0 = time.perf_counter()
+            out = est.fit(data, config_grid=grid)
+            cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            est.fit(data, config_grid=grid)
+            warm = time.perf_counter() - t0
+            return out, cold, warm
+
+        rv, cold_v, warm_v = run(True)
+        rs, cold_s, warm_s = run(False)
+        print(f"grid {len(grid)} points, {args.sweeps} sweeps:")
+        print(f"  vectorized (lane-axis): cold {cold_v:.1f}s warm {warm_v:.1f}s")
+        print(f"  sequential:             cold {cold_s:.1f}s warm {warm_s:.1f}s")
+        print(f"  warm speedup: {warm_s / warm_v:.1f}x")
+        for a, b in zip(rv, rs):
+            dv = abs(a.descent.objective_history[-1]
+                     - b.descent.objective_history[-1])
+            assert dv / abs(b.descent.objective_history[-1]) < 1e-2, dv
+        return
+
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs=coordinate_configs,
         n_sweeps=args.sweeps,
     )
     t0 = time.perf_counter()

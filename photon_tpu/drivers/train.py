@@ -404,14 +404,23 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             gsig = _global_signature(params)
             manifest = []
             sigs = _point_signatures(gsig, [r.configs for r in results])
+            # Skip rewriting only points the CURRENT resume run persisted or
+            # signature-verified — i.e. rows in the manifest it just wrote
+            # (rows are appended atomically only AFTER a successful model
+            # save, so a partially-written dir from a crash mid-save is
+            # never in the manifest and gets overwritten here). A bare
+            # directory-existence check would publish such a partial dir.
+            checkpointed: set = set()
+            if params.resume:
+                mpath = os.path.join(models_dir, "models.json")
+                if os.path.exists(mpath):
+                    with open(mpath) as fh:
+                        checkpointed = {
+                            m.get("config_sig") for m in json.load(fh)
+                            if os.path.isdir(m.get("dir", ""))}
             for r, sig in zip(results, sigs):
                 point_dir = _sig_dir(models_dir, sig)
-                # Skip the write only when THIS run already persisted or
-                # signature-verified the point (resume mode). A non-resume
-                # run into a reused output_dir must overwrite: the
-                # signature keys on train_path, not file content, so an
-                # existing dir may hold a model from stale data.
-                if not params.resume or not os.path.isdir(point_dir):
+                if sig not in checkpointed:
                     save_game_model(
                         point_dir, r.model,
                         {n: index_maps[params.coordinates[n].feature_shard]
@@ -560,7 +569,7 @@ def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
     gsig = _global_signature(params)
     sigs = _point_signatures(gsig, [{**base, **ov} for ov in grid])
     if (not any(s in completed for s in sigs)
-            and estimator.would_vectorize(grid, initial_models)):
+            and estimator.would_vectorize(grid, initial_models, data=data)):
         # nothing to resume and the whole sweep is one device program:
         # points are persisted together in the save phase.
         return estimator.fit(data, validation=validation, config_grid=grid,

@@ -223,10 +223,26 @@ class GameEstimator:
             # same validation shards.
             validation = validation.to_device()
 
+        chain_warm = self.warm_start
         if self.would_vectorize(grid, initial_models):
-            probe = self._fixed_only_reg_grid(grid)
-            return self._fit_fixed_grid(probe, data, validation,
-                                        evaluator, dataset_cache)
+            if self.n_sweeps == 1:
+                probe = self._fixed_only_reg_grid(grid)
+                if probe is not None and self._fixed_seq_ok(probe):
+                    # single fixed effect, one sweep: the leanest form —
+                    # the whole grid is ONE train_glm_grid program
+                    return self._fit_fixed_grid(probe, data, validation,
+                                                evaluator, dataset_cache)
+            lanes = self._game_grid_probe(grid)
+            if lanes is not None:
+                if self._grid_data_supported(data):
+                    return self._fit_game_grid(lanes, data, validation,
+                                               evaluator, dataset_cache,
+                                               coord_cache)
+                # Vectorization was requested (and its contract is "lanes
+                # never chain warm starts across grid points"); keep that
+                # contract on the unsupported-layout fallback so results do
+                # not depend on the matrix representation.
+                chain_warm = False
 
         results: list[GameFitResult] = []
         prev_models = dict(initial_models or {})
@@ -267,30 +283,149 @@ class GameEstimator:
                     evaluator, scores, validation
                 )
             results.append(result)
-            if self.warm_start:
+            if chain_warm:
                 prev_models = dict(descent.model.coordinates)
         return results
 
-    def would_vectorize(self, grid, initial_models=None) -> bool:
-        """Whether fit(config_grid=grid) would take the vectorized
-        fixed-effect path. The vectorized path must be a semantic no-op
-        apart from warm starts: engage only for true multi-point grids
-        where a sweep is a single solve (n_sweeps == 1, no custom update
-        sequence) — with n_sweeps > 1 the sequential path re-solves the
-        coordinate each sweep (extra warm-started iterations), which one
-        lane can't mimic. Public so the training driver's resume logic can
-        make the same call without duplicating the gate."""
+    def would_vectorize(self, grid, initial_models=None, data=None) -> bool:
+        """Whether fit(config_grid=grid) would take a vectorized grid path:
+        either the one-program fixed-effect path (single fixed coordinate,
+        n_sweeps == 1) or the general lane-axis GAME grid (game.grid:
+        fixed + random effects, any n_sweeps — each lane runs the same
+        sweeps the sequential path would). Both paths are semantic no-ops
+        apart from warm starts ACROSS grid points (lanes run concurrently
+        from zeros; a forced vectorized_grid=True keeps that contract even
+        on fallback). Public so the training driver's resume logic can make
+        the same call without duplicating the gate. Pass ``data`` to also
+        check the matrix layouts the lane path supports — without it, the
+        answer can be a false positive for Sharded/HybridRows shards
+        (fit() would fall back to the sequential path)."""
         vectorize = (self.vectorized_grid is True
                      or (self.vectorized_grid is None
                          and not self.warm_start))
-        if not (vectorize and len(grid) >= 2 and self.n_sweeps == 1
+        if not (vectorize and len(grid) >= 2
                 and not self.locked and not self.incremental
                 and not initial_models):
             return False
-        probe = self._fixed_only_reg_grid(grid)
-        return probe is not None and (
-            self.update_sequence is None
-            or list(self.update_sequence) == [probe[0]])
+        if self.n_sweeps == 1:
+            probe = self._fixed_only_reg_grid(grid)
+            if probe is not None and self._fixed_seq_ok(probe):
+                return True
+        if self._game_grid_probe(grid) is None:
+            return False
+        return data is None or self._grid_data_supported(data)
+
+    def _fixed_seq_ok(self, probe) -> bool:
+        return (self.update_sequence is None
+                or list(self.update_sequence) == [probe[0]])
+
+    def _game_grid_probe(self, grid) -> Optional[dict]:
+        """{name: [reg_weight per grid point]} when the grid is expressible
+        as lane weights over the base configs — every override varies ONLY
+        its coordinate's reg weight — and nothing on the model needs the
+        sequential path (no projection, no normalization); None otherwise."""
+        if any(v is not None for v in self.normalization.values()):
+            return None
+        names = set(self.coordinate_configs)
+        if self.update_sequence is not None and \
+                set(self.update_sequence) - names:
+            return None
+        for cfg in self.coordinate_configs.values():
+            if isinstance(cfg, RandomEffectConfig) and cfg.projection is not None:
+                return None
+        lanes: dict = {n: [] for n in names}
+        for overrides in grid:
+            if set(overrides) - names:
+                return None
+            for n, base in self.coordinate_configs.items():
+                cfg = overrides.get(n, base)
+                if type(cfg) is not type(base):
+                    return None
+                strip = lambda c: dataclasses.replace(  # noqa: E731
+                    c, optimizer=dataclasses.replace(c.optimizer,
+                                                     reg_weight=0.0))
+                if strip(cfg) != strip(base):
+                    return None
+                lanes[n].append(float(cfg.optimizer.reg_weight))
+        return lanes
+
+    def _grid_data_supported(self, data: GameData) -> bool:
+        """Matrix layouts the lane-axis grid can run: dense or SparseRows.
+        HybridRows' flat COO tail has no (entity, lane) batched form, and
+        ShardedHybridRows needs the shard_map solver route."""
+        from photon_tpu.data.matrix import HybridRows, ShardedHybridRows
+
+        for cfg in self.coordinate_configs.values():
+            X = data.shards[cfg.feature_shard]
+            if isinstance(X, ShardedHybridRows):
+                return False
+            if isinstance(X, HybridRows) and (
+                    self.mesh is not None
+                    or not isinstance(cfg, FixedEffectConfig)):
+                return False
+        return True
+
+    def _fit_game_grid(self, lanes: dict, data: GameData, validation,
+                       evaluator: Evaluator, dataset_cache,
+                       coord_cache) -> list:
+        """The lane-axis GAME grid (game.grid.fit_game_grid): every grid
+        point is a lane of one vectorized coordinate descent."""
+        import jax.numpy as jnp
+
+        from photon_tpu.game.grid import fit_game_grid, lane_re_margins
+        from photon_tpu.models.glm import _score_many
+
+        configs = self.coordinate_configs
+        datasets = {}
+        for name, cfg in configs.items():
+            key = self._dataset_key(cfg)
+            if key not in dataset_cache:
+                dataset_cache[key] = self._build_dataset(data, cfg)
+            datasets[name] = dataset_cache[key]
+        coords = self._build_coordinates(datasets, configs, coord_cache)
+        outcome = fit_game_grid(
+            coords, lanes, data.y, data.weights, data.offsets, self.task,
+            update_sequence=self.update_sequence, n_sweeps=self.n_sweeps,
+            mesh=self.mesh)
+
+        G = len(next(iter(lanes.values())))
+        val_scores = None
+        if validation is not None:
+            total = jnp.asarray(validation.offsets, jnp.float32)[None, :]
+            for name in outcome.lane_models[0].names():
+                cfg = configs[name]
+                Xv = validation.shards[cfg.feature_shard]
+                if isinstance(cfg, FixedEffectConfig):
+                    total = total + _score_many(
+                        jnp.asarray(outcome.stacked[name]), Xv, 0.0)
+                else:
+                    model0 = outcome.lane_models[0].coordinates[name]
+                    ids = model0.dense_ids(
+                        np.asarray(validation.entity_ids[cfg.entity_name]))
+                    total = total + lane_re_margins(
+                        jnp.asarray(outcome.stacked[name]), Xv,
+                        jnp.asarray(ids))
+            val_scores = np.asarray(total)
+
+        results = []
+        for g in range(G):
+            configs_g = {
+                name: dataclasses.replace(
+                    cfg, optimizer=dataclasses.replace(
+                        cfg.optimizer, reg_weight=lanes[name][g]))
+                for name, cfg in configs.items()
+            }
+            descent = CoordinateDescentResult(
+                model=outcome.lane_models[g],
+                objective_history=outcome.objective_histories[g],
+                coordinate_stats=outcome.coordinate_stats[g],
+            )
+            r = GameFitResult(outcome.lane_models[g], descent, configs_g)
+            if val_scores is not None:
+                r.validation_score = self._evaluate(
+                    evaluator, val_scores[g], validation)
+            results.append(r)
+        return results
 
     def _fixed_only_reg_grid(self, grid):
         """(name, base_config, [reg_weight per grid point]) when the model

@@ -48,10 +48,6 @@ def _pad_axis0(tree, target: int):
     return jax.tree_util.tree_map(pad, tree)
 
 
-def _slice_axis0(tree, start: int, size: int):
-    return jax.tree_util.tree_map(lambda x: x[start:start + size], tree)
-
-
 # XLA-TPU compile time grows superlinearly in the vmapped lane count (~3s at
 # 512 lanes, ~100s at 39k), so big entity blocks are solved in fixed-size
 # lane chunks: one compile per block SHAPE, many cheap dispatches.
@@ -60,6 +56,8 @@ _MAX_SOLVE_LANES = 4096
 # Module-level solver cache keyed on (with_prior, weight-normalized config,
 # variance type); the Objective and the L1 weight are runtime ARGUMENTS, so
 # reg-weight grids and repeated estimator fits all share compilations.
+# Entries are (jitted_fn, raw_vmapped_fn): the raw form feeds the
+# scan-over-chunks dispatcher below.
 _RE_SOLVERS: dict = {}
 
 
@@ -67,9 +65,9 @@ def _re_solver(with_prior: bool, cfg, variance):
     import dataclasses as _dc
 
     key = (with_prior, cfg, variance)
-    fn = _RE_SOLVERS.get(key)
-    if fn is not None:
-        return fn
+    fns = _RE_SOLVERS.get(key)
+    if fns is not None:
+        return fns
 
     def one(obj, lam, batch, w0):
         res = solve(obj, batch, w0, cfg, l1_weight=lam)
@@ -90,12 +88,63 @@ def _re_solver(with_prior: bool, cfg, variance):
     # batches the entire while_loop solver across entities. obj/lam are
     # broadcast (in_axes None): shared by every lane.
     if with_prior:
-        fn = jax.jit(jax.vmap(one_with_prior,
-                              in_axes=(None, None, 0, 0, 0, 0)))
+        raw = jax.vmap(one_with_prior, in_axes=(None, None, 0, 0, 0, 0))
     else:
-        fn = jax.jit(jax.vmap(one, in_axes=(None, None, 0, 0)))
-    _RE_SOLVERS[key] = fn
+        raw = jax.vmap(one, in_axes=(None, None, 0, 0))
+    fns = (jax.jit(raw), raw)
+    _RE_SOLVERS[key] = fns
+    return fns
+
+
+# jitted scan-over-chunks wrappers, keyed on the raw vmapped solver: a block
+# bigger than one lane chunk runs as lax.scan over its equal-shape chunks —
+# ONE device dispatch per block (launch latency paid once, not once per
+# chunk; over a remote tunnel each dispatch costs ~100 ms) while compile
+# cost stays that of a single chunk.
+_SCAN_DISPATCH: dict = {}
+
+
+def _scan_dispatch(raw_fn):
+    fn = _SCAN_DISPATCH.get(raw_fn)
+    if fn is None:
+        def run(head, stacked):
+            def body(_, part):
+                return None, raw_fn(*head, *part)
+
+            _, outs = jax.lax.scan(body, None, stacked)
+            return outs
+
+        fn = jax.jit(run)
+        _SCAN_DISPATCH[raw_fn] = fn
     return fn
+
+
+def dispatch_chunked(solver_fns, head: tuple, args: tuple, chunk: int,
+                     e_pad: int, mesh):
+    """Run a bucket's vmapped solves in `chunk`-entity pieces.
+
+    One chunk → the plain jitted solver. Multiple chunks → leaves reshaped
+    to (k, chunk, ...) and lax.scan'd: one dispatch, single-chunk compile
+    cost, finished chunks retired as the scan advances. ``head`` holds the
+    broadcast arguments (objective, reg weights), ``args`` the
+    entity-leading ones (batch, w0, priors), already padded to e_pad.
+    """
+    jit_fn, raw_fn = solver_fns
+    if e_pad == chunk:
+        if mesh is not None:
+            args = jax.device_put(args, data_sharding(mesh))
+        return jit_fn(*head, *args)
+    k = e_pad // chunk
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape((k, chunk) + x.shape[1:]), args)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stacked = jax.device_put(
+            stacked, NamedSharding(mesh, P(None, tuple(mesh.axis_names))))
+    outs = _scan_dispatch(raw_fn)(head, stacked)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((e_pad,) + x.shape[2:]), outs)
 
 
 def _next_pow2_int(x: int) -> int:
@@ -169,8 +218,8 @@ class RandomEffectCoordinate:
 
         Chunk size: next power of two of the entity count, capped at
         _MAX_SOLVE_LANES (and rounded to a mesh multiple) — so every block
-        compiles at a small fixed lane count and large blocks become
-        multiple dispatches of the SAME compiled program.
+        compiles at a small fixed lane count; larger blocks lax.scan over
+        their chunks in ONE dispatch (dispatch_chunked).
         """
         n_dev = self.mesh.devices.size if self.mesh is not None else 1
         chunk = min(_MAX_SOLVE_LANES, _next_pow2_int(max(e_real, 1)))
@@ -178,17 +227,8 @@ class RandomEffectCoordinate:
         e_pad = pad_to_multiple(e_real, chunk)
         args = (batch, w0) + ((pm, pp) if pm is not None else ())
         args = _pad_axis0(args, e_pad)
-        outs = []
-        for c0 in range(0, e_pad, chunk):
-            part = _slice_axis0(args, c0, chunk)
-            if self.mesh is not None:
-                part = jax.device_put(part, data_sharding(self.mesh))
-            outs.append(solver(obj, lam, *part))
-        if len(outs) == 1:
-            return outs[0]
-        # None leaves (variance off) are structural and skipped by tree_map.
-        return jax.tree_util.tree_map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *outs)
+        return dispatch_chunked(solver, (obj, lam), args, chunk, e_pad,
+                                self.mesh)
 
     def train(
         self,

@@ -281,6 +281,32 @@ def _train_run_grid(batch, w0, obj, l2s, l1s, config, variance):
     return jax.vmap(one)(l2s, l1s)
 
 
+def lane_weight_arrays(config: OptimizerConfig, reg_weights):
+    """(l2s, l1s, static_config) for a grid's per-lane regularization
+    weights — THE one place the lane routing lives (shared by
+    train_glm_grid and game.grid): an L1/elastic-net sweep runs OWL-QN
+    lanes even though the base config's own weight carries no L1 term (the
+    reference's forced-OWLQN-on-L1 rule, applied per sweep), and the
+    static config is weight-normalized so every sweep shares one compiled
+    program."""
+    import dataclasses as _dc
+
+    weights = [float(wt) for wt in reg_weights]
+    l2s = jnp.asarray([config.reg.l2_weight(wt) for wt in weights],
+                      jnp.float32)
+    use_owlqn = (config.effective_optimizer() is OptimizerType.OWLQN
+                 or any(config.reg.l1_weight(wt) > 0.0 for wt in weights))
+    l1s = None
+    if use_owlqn:
+        l1s = jnp.asarray([config.reg.l1_weight(wt) for wt in weights],
+                          jnp.float32)
+    static_cfg = _dc.replace(
+        config, reg_weight=0.0,
+        optimizer=(OptimizerType.OWLQN if use_owlqn
+                   else config.effective_optimizer()))
+    return l2s, l1s, static_cfg
+
+
 def train_glm_grid(
     batch: GLMBatch,
     task: TaskType,
@@ -303,30 +329,13 @@ def train_glm_grid(
     (they run concurrently); every lane starts from ``w0``. Convergence is
     tracked per lane.
     """
-    import dataclasses as _dc
-
     d = _matrix_dim(batch.X)
     sharded_hybrid = mesh is not None and isinstance(batch.X,
                                                      ShardedHybridRows)
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
     weights = [float(wt) for wt in reg_weights]
-    l2s = jnp.asarray([config.reg.l2_weight(wt) for wt in weights],
-                      jnp.float32)
-    # Route by the GRID weights, not config.reg_weight (usually 0 here):
-    # an L1/elastic-net grid must run OWL-QN lanes even though the config's
-    # own weight carries no L1 term (the reference's forced-OWLQN-on-L1
-    # rule, applied per sweep).
-    use_owlqn = (config.effective_optimizer() is OptimizerType.OWLQN
-                 or any(config.reg.l1_weight(wt) > 0.0 for wt in weights))
-    l1s = None
-    if use_owlqn:
-        l1s = jnp.asarray([config.reg.l1_weight(wt) for wt in weights],
-                          jnp.float32)
-    static_cfg = _dc.replace(
-        config, reg_weight=0.0,
-        optimizer=(OptimizerType.OWLQN if use_owlqn
-                   else config.effective_optimizer()))
+    l2s, l1s, static_cfg = lane_weight_arrays(config, weights)
     axis_name = None
     if sharded_hybrid:
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)

@@ -560,10 +560,12 @@ class TestVectorizedFixedGrid:
         best = est.best_model(results)
         assert best.configs["fixed"].optimizer.reg_weight == 0.1
 
-    def test_fast_path_disengages_for_sweeps_and_single_fit(self, rng):
-        """n_sweeps>1 (or no real grid) must keep the sequential path —
-        regression: the fast path silently replaced the second warm-started
-        sweep with one solve from zeros."""
+    def test_sweeps_route_to_lane_path_with_full_semantics(self, rng):
+        """n_sweeps>1 no longer disengages vectorization: it routes to the
+        lane-axis grid (game.grid), whose lanes run BOTH warm-started
+        sweeps — the original regression (the one-solve fast path silently
+        replacing the second sweep) must stay fixed, now by semantics
+        rather than by falling back."""
         data = self._data(rng)
         cfg = OptimizerConfig(max_iters=15, reg=reg.l2(), reg_weight=1.0,
                               regularize_intercept=True)
@@ -579,13 +581,18 @@ class TestVectorizedFixedGrid:
             return est.fit(data, config_grid=grid)
 
         fast_flag, slow = run(True), run(False)
-        # identical code path ⇒ bitwise-identical coefficients
         for rf, rs in zip(fast_flag, slow):
-            np.testing.assert_array_equal(
+            # two objective entries per point: the second sweep really ran
+            assert len(rf.descent.objective_history) == 2
+            np.testing.assert_allclose(
                 np.asarray(rf.model.coordinates["fixed"].model.coefficients.means),
-                np.asarray(rs.model.coordinates["fixed"].model.coefficients.means))
-        # plain fit() (no config_grid) likewise stays sequential: two sweeps
-        # progress further than the one-solve fast path would.
+                np.asarray(rs.model.coordinates["fixed"].model.coefficients.means),
+                atol=5e-3)
+            np.testing.assert_allclose(rf.descent.objective_history,
+                                       rs.descent.objective_history,
+                                       rtol=2e-3)
+        # plain fit() (no config_grid) stays sequential: two sweeps
+        # progress further than one solve from zeros would.
         est = GameEstimator(
             task=TaskType.LOGISTIC_REGRESSION,
             coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
@@ -624,6 +631,172 @@ class TestVectorizedFixedGrid:
             np.testing.assert_array_equal(
                 np.asarray(ra.model.coordinates["fixed"].model.coefficients.means),
                 np.asarray(rf.model.coordinates["fixed"].model.coefficients.means))
+
+
+class TestVectorizedGameGrid:
+    """Mixed (fixed + random effect) reg-weight grids run as lanes of one
+    vectorized coordinate descent (game.grid.fit_game_grid)."""
+
+    def _mixed(self, rng, n_entities=25):
+        data, w_fixed, w_re, ent = _mixed_effect_logistic(
+            rng, n_entities=n_entities, d_fixed=6, d_re=3, rows_lo=5,
+            rows_hi=40)
+        val, *_ = _mixed_effect_logistic(
+            rng, n_entities=n_entities, d_fixed=6, d_re=3, rows_lo=3,
+            rows_hi=20)
+        return data, val
+
+    def _configs(self, cfg_f, cfg_r):
+        return {"fixed": FixedEffectConfig("fixed", cfg_f),
+                "per_e": RandomEffectConfig("entity", "per_entity", cfg_r)}
+
+    def _grid(self, cfg_f, cfg_r, pairs):
+        return [{"fixed": FixedEffectConfig(
+                     "fixed", dataclasses.replace(cfg_f, reg_weight=wf)),
+                 "per_e": RandomEffectConfig(
+                     "entity", "per_entity",
+                     dataclasses.replace(cfg_r, reg_weight=wr))}
+                for wf, wr in pairs]
+
+    def test_mixed_grid_matches_sequential(self, rng):
+        """The top round-3 deliverable: lane-axis GAME grid == sequential
+        per point (mirroring the fixed-only pin above), with per-lane
+        sweeps, validation scores, histories, and RE stats."""
+        data, val = self._mixed(rng)
+        cfg_f = OptimizerConfig(max_iters=25, reg=reg.l2(), reg_weight=0.1)
+        cfg_r = OptimizerConfig(max_iters=20, reg=reg.l2(), reg_weight=1.0)
+        grid = self._grid(cfg_f, cfg_r,
+                          [(0.05, 0.5), (0.05, 5.0), (0.5, 0.5), (0.5, 5.0)])
+
+        def run(vectorized):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=self._configs(cfg_f, cfg_r),
+                n_sweeps=2, warm_start=False, vectorized_grid=vectorized)
+            if vectorized:
+                assert est.would_vectorize(grid)
+            return est.fit(data, validation=val, config_grid=grid)
+
+        fast, slow = run(True), run(False)
+        assert len(fast) == len(slow) == 4
+        for rf, rs in zip(fast, slow):
+            np.testing.assert_allclose(
+                np.asarray(rf.model["fixed"].model.coefficients.means),
+                np.asarray(rs.model["fixed"].model.coefficients.means),
+                atol=5e-3)
+            np.testing.assert_allclose(
+                np.asarray(rf.model["per_e"].coefficients),
+                np.asarray(rs.model["per_e"].coefficients), atol=2e-2)
+            assert abs(rf.validation_score - rs.validation_score) < 5e-3
+            # 2 sweeps × 2 coordinates = 4 objective entries, same curve
+            assert len(rf.descent.objective_history) == 4
+            np.testing.assert_allclose(rf.descent.objective_history,
+                                       rs.descent.objective_history,
+                                       rtol=2e-3)
+            assert (rf.configs["per_e"].optimizer.reg_weight
+                    == rs.configs["per_e"].optimizer.reg_weight)
+            stats = rf.descent.coordinate_stats["per_e"][0]
+            assert stats.n_entities == 25
+            assert stats.n_converged + stats.n_failed <= 25
+        # stronger RE regularization must shrink the per-entity coefficients
+        norm_small = np.linalg.norm(np.asarray(fast[0].model["per_e"].coefficients))
+        norm_big = np.linalg.norm(np.asarray(fast[1].model["per_e"].coefficients))
+        assert norm_big < norm_small
+
+    def test_l1_grid_runs_owlqn_lanes(self, rng):
+        """An elastic-net sweep routes the lane solves through OWL-QN and
+        matches the sequential path (sparsity included)."""
+        data, val = self._mixed(rng)
+        cfg_f = OptimizerConfig(max_iters=30, reg=reg.l1(), reg_weight=0.1)
+        cfg_r = OptimizerConfig(max_iters=20, reg=reg.l2(), reg_weight=1.0)
+        grid = self._grid(cfg_f, cfg_r, [(0.5, 1.0), (8.0, 1.0)])
+
+        def run(vectorized):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=self._configs(cfg_f, cfg_r),
+                n_sweeps=1, warm_start=False, vectorized_grid=vectorized)
+            return est.fit(data, config_grid=grid)
+
+        fast, slow = run(True), run(False)
+        for rf, rs in zip(fast, slow):
+            wf = np.asarray(rf.model["fixed"].model.coefficients.means)
+            ws = np.asarray(rs.model["fixed"].model.coefficients.means)
+            np.testing.assert_allclose(wf, ws, atol=5e-3)
+            np.testing.assert_array_equal(wf == 0.0, ws == 0.0)
+        # the strong-L1 lane is genuinely sparser
+        w_hi = np.asarray(fast[1].model["fixed"].model.coefficients.means)
+        assert (w_hi == 0.0).sum() > 0
+
+    def test_runs_on_mesh(self, rng, mesh8):
+        """The lane path under a mesh (entity-axis sharded RE chunks,
+        row-sharded fixed batch) matches the single-device lane path."""
+        data, val = self._mixed(rng)
+        cfg_f = OptimizerConfig(max_iters=20, reg=reg.l2(), reg_weight=0.1)
+        cfg_r = OptimizerConfig(max_iters=15, reg=reg.l2(), reg_weight=1.0)
+        grid = self._grid(cfg_f, cfg_r, [(0.05, 0.5), (0.5, 5.0)])
+
+        def run(mesh):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=self._configs(cfg_f, cfg_r),
+                n_sweeps=1, warm_start=False, vectorized_grid=True,
+                mesh=mesh)
+            return est.fit(data, validation=val, config_grid=grid)
+
+        on_mesh, single = run(mesh8), run(None)
+        for rm, r1 in zip(on_mesh, single):
+            np.testing.assert_allclose(
+                np.asarray(rm.model["fixed"].model.coefficients.means),
+                np.asarray(r1.model["fixed"].model.coefficients.means),
+                atol=5e-3)
+            np.testing.assert_allclose(
+                np.asarray(rm.model["per_e"].coefficients),
+                np.asarray(r1.model["per_e"].coefficients), atol=2e-2)
+
+    def test_gate_probes(self, rng):
+        """_game_grid_probe accepts reg-only mixed grids and rejects
+        anything the lane path cannot replicate."""
+        from photon_tpu.game.projector import ProjectionConfig, ProjectorType
+
+        cfg_f = OptimizerConfig(max_iters=10, reg=reg.l2(), reg_weight=0.1)
+        cfg_r = OptimizerConfig(max_iters=10, reg=reg.l2(), reg_weight=1.0)
+        grid = self._grid(cfg_f, cfg_r, [(0.1, 1.0), (1.0, 2.0)])
+
+        def make(**kw):
+            return GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=self._configs(cfg_f, cfg_r),
+                warm_start=False, **kw)
+
+        est = make()
+        lanes = est._game_grid_probe(grid)
+        assert lanes == {"fixed": [0.1, 1.0], "per_e": [1.0, 2.0]}
+        assert est.would_vectorize(grid)
+        # n_sweeps > 1 is supported by the mixed path
+        assert make(n_sweeps=3).would_vectorize(grid)
+        # grid varying a non-reg knob → sequential
+        bad = [dict(g) for g in grid]
+        bad[1]["fixed"] = FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg_f, reg_weight=1.0, max_iters=11))
+        assert est._game_grid_probe(bad) is None
+        # projection on the RE coordinate → sequential
+        proj = make()
+        proj.coordinate_configs["per_e"] = RandomEffectConfig(
+            "entity", "per_entity", cfg_r,
+            projection=ProjectionConfig(ProjectorType.RANDOM, 2))
+        assert proj._game_grid_probe(grid) is None
+        # normalization → sequential
+        from photon_tpu.data.normalization import NormalizationType
+
+        normed = make(
+            normalization={"fixed": NormalizationType.STANDARDIZATION})
+        assert normed._game_grid_probe(grid) is None
+        # warm_start=True default → sequential (never silently dropped)
+        warm = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs=self._configs(cfg_f, cfg_r))
+        assert not warm.would_vectorize(grid)
 
 
 def test_poisson_game_end_to_end(rng):
