@@ -1,0 +1,52 @@
+"""Shared synthetic GAME problem for the benches (game_scale / game_auc):
+one definition so the two PERF.md tables describe the SAME workload."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def add_game_args(parser) -> None:
+    parser.add_argument("--rows", type=int, default=1_000_000)
+    parser.add_argument("--entities", type=int, default=50_000)
+    parser.add_argument("--d-fixed", type=int, default=64)
+    parser.add_argument("--d-re", type=int, default=8)
+
+
+def planted_effects(d_fixed: int, d_re: int, entities: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=d_fixed).astype(np.float32) * 0.3
+    u_true = rng.normal(size=(entities, d_re)).astype(np.float32)
+    return w_true, u_true
+
+
+def make_game_data(n_rows: int, entities: int, w_true, u_true, seed: int):
+    """(GameData, y) rows of the planted mixed-effect logistic model."""
+    from photon_tpu.game.dataset import GameData
+
+    rng = np.random.default_rng(seed)
+    d_fixed, d_re = w_true.shape[0], u_true.shape[1]
+    Xf = rng.normal(size=(n_rows, d_fixed)).astype(np.float32)
+    Xr = rng.normal(size=(n_rows, d_re)).astype(np.float32)
+    ids = rng.integers(0, entities, size=n_rows)
+    margin = Xf @ w_true + np.einsum("nd,nd->n", Xr, u_true[ids])
+    y = (rng.uniform(size=n_rows) < 1 / (1 + np.exp(-margin))).astype(
+        np.float32)
+    return GameData.build(y, shards={"fixed": Xf, "re": Xr},
+                          entity_ids={"member": ids}), y
+
+
+def default_configs():
+    """The benches' coordinate configs (fixed + per-member RE)."""
+    from photon_tpu.game.estimator import (
+        FixedEffectConfig,
+        RandomEffectConfig,
+    )
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    cfg_f = OptimizerConfig(max_iters=30, reg=l2(), reg_weight=1.0)
+    cfg_r = OptimizerConfig(max_iters=15, reg=l2(), reg_weight=5.0)
+    return cfg_f, cfg_r, {
+        "fixed": FixedEffectConfig("fixed", cfg_f),
+        "per_member": RandomEffectConfig("member", "re", cfg_r),
+    }

@@ -24,21 +24,19 @@ import numpy as np
 
 
 def main() -> None:
+    from _game_problem import add_game_args, make_game_data, planted_effects
+    from _game_problem import default_configs
+
     p = argparse.ArgumentParser()
-    p.add_argument("--rows", type=int, default=1_000_000)
-    p.add_argument("--entities", type=int, default=50_000)
-    p.add_argument("--d-fixed", type=int, default=64)
-    p.add_argument("--d-re", type=int, default=8)
+    add_game_args(p)
     p.add_argument("--sweeps", type=int, default=2)
     p.add_argument("--grid", type=int, default=0,
                    help="N-point reg grid: vectorized vs sequential timing")
     args = p.parse_args()
 
-    import jax
     import jax.numpy as jnp
 
     from photon_tpu.evaluation.metrics import auc
-    from photon_tpu.game.dataset import GameData
     from photon_tpu.game.estimator import (
         FixedEffectConfig,
         GameEstimator,
@@ -48,33 +46,18 @@ def main() -> None:
     from photon_tpu.models.training import train_glm
     from photon_tpu.data.dataset import make_batch
     from photon_tpu.ops.losses import TaskType
-    from photon_tpu.optim.config import OptimizerConfig
     from photon_tpu.optim.regularization import l2
+    from photon_tpu.optim.config import OptimizerConfig
 
-    rng = np.random.default_rng(0)
     n, E = args.rows, args.entities
+    w_true, u_true = planted_effects(args.d_fixed, args.d_re, E)
     t0 = time.perf_counter()
-    Xf = rng.normal(size=(n, args.d_fixed)).astype(np.float32)
-    Xr = rng.normal(size=(n, args.d_re)).astype(np.float32)
-    ids = rng.integers(0, E, size=n)
-    w_true = rng.normal(size=args.d_fixed).astype(np.float32) * 0.3
-    u_true = rng.normal(size=(E, args.d_re)).astype(np.float32)
-    margin = Xf @ w_true + np.einsum("nd,nd->n", Xr, u_true[ids])
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
-    print(f"data gen: {time.perf_counter() - t0:.1f}s "
+    data, y = make_game_data(n, E, w_true, u_true, seed=1)
+    Xf = np.asarray(data.shards["fixed"])
+    print(f"data gen + GameData.build: {time.perf_counter() - t0:.1f}s "
           f"({n} rows, {E} entities)")
 
-    t0 = time.perf_counter()
-    data = GameData.build(y, shards={"fixed": Xf, "re": Xr},
-                          entity_ids={"member": ids})
-    print(f"GameData.build (entity bucketing): {time.perf_counter() - t0:.1f}s")
-
-    cfg_f = OptimizerConfig(max_iters=30, reg=l2(), reg_weight=1.0)
-    cfg_r = OptimizerConfig(max_iters=15, reg=l2(), reg_weight=5.0)
-    coordinate_configs = {
-        "fixed": FixedEffectConfig("fixed", cfg_f),
-        "per_member": RandomEffectConfig("member", "re", cfg_r),
-    }
+    cfg_f, cfg_r, coordinate_configs = default_configs()
 
     if args.grid:
         import dataclasses

@@ -59,13 +59,19 @@ class HybridRows:
 
     The reference has no analog (JVM sparse vectors are cheap to walk);
     this is the TPU-first representation of its 10M-feature regime.
+
+    Residency contract: leaves may be HOST numpy (what `to_hybrid` builds —
+    so callers can cast to bf16 before paying the transfer) or device
+    arrays; `jax.device_put(hybrid)` moves the whole pytree once. Put it on
+    device before repeated jitted use, or every call re-transfers the
+    multi-GB dense block.
     """
 
-    dense: jax.Array       # (n, d_sel) values of the selected hot columns
-    dense_cols: jax.Array  # (d_sel,) original column ids of the dense block
-    tail_rows: jax.Array   # (m,) int32 row ids, ascending (padding: row 0)
-    tail_cols: jax.Array   # (m,) int32 original column ids (padding: 0)
-    tail_vals: jax.Array   # (m,) tail values (padding: 0.0)
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot-column values
+    dense_cols: jax.Array | np.ndarray  # (d_sel,) original column ids
+    tail_rows: jax.Array | np.ndarray   # (m,) int32 row ids, ascending
+    tail_cols: jax.Array | np.ndarray   # (m,) int32 original column ids
+    tail_vals: jax.Array | np.ndarray   # (m,) tail values (padding: 0.0)
     n_features: int
 
     @property
@@ -102,13 +108,17 @@ class ShardedHybridRows:
     Tail padding entries use (row = n_local-1, col = 0, val = 0): zero
     values contribute nothing, and padding with the LAST local row keeps
     each shard's row ids ascending for the sorted segment_sum in matvec.
+
+    Residency contract: as HybridRows — `shard_hybrid` builds host numpy
+    leaves (dense inherits the input's residency); models.training's
+    `_sharded_prep` does the one device_put into the mesh sharding.
     """
 
-    dense: jax.Array       # (n, d_sel) hot-column values, rows shardable
-    dense_cols: jax.Array  # (d_sel,) original column ids (replicated)
-    tail_rows: jax.Array   # (S, m) int32 LOCAL row ids, ascending per shard
-    tail_cols: jax.Array   # (S, m) int32 original column ids
-    tail_vals: jax.Array   # (S, m) tail values (padding: 0.0)
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot-column values
+    dense_cols: jax.Array | np.ndarray  # (d_sel,) original column ids
+    tail_rows: jax.Array | np.ndarray   # (S, m) int32 LOCAL row ids, ascending
+    tail_cols: jax.Array | np.ndarray   # (S, m) int32 original column ids
+    tail_vals: jax.Array | np.ndarray   # (S, m) tail values (padding: 0.0)
     n_features: int
 
     @property
@@ -167,9 +177,21 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
 
     pos = col_to_pos[ind]  # (n, k); -1 = stays sparse
     hot = (pos >= 0) & nnz_mask
-    dense = np.zeros((n, d_sel), np.float32)
     rows = np.repeat(np.arange(n), k).reshape(n, k)
-    np.add.at(dense, (rows[hot], pos[hot]), val[hot])
+    # bincount over flat (row, pos) ids: C-speed accumulation — np.add.at
+    # is an order of magnitude slower at the 10M-feature bench scale.
+    # Chunked over row ranges so the float64 bincount scratch stays bounded
+    # (~1 GB) at billion-cell n×d_sel scale.
+    dense = np.empty((n, d_sel), np.float32)
+    row_chunk = max(1, (1 << 27) // max(d_sel, 1))
+    for r0 in range(0, n, row_chunk):
+        r1 = min(n, r0 + row_chunk)
+        h = hot[r0:r1]
+        flat_ids = ((rows[r0:r1][h] - r0) * np.int64(d_sel) + pos[r0:r1][h])
+        dense[r0:r1] = np.bincount(
+            flat_ids, weights=val[r0:r1][h].astype(np.float64),
+            minlength=(r1 - r0) * d_sel,
+        ).astype(np.float32).reshape(r1 - r0, d_sel)
     # Flat row-sorted COO tail: exactly the cold nnz, no per-row padding
     # (row-major traversal keeps rows ascending for the sorted segment_sum
     # in matvec). One zero sentinel entry keeps the arrays non-empty.
@@ -182,12 +204,16 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
         tail_rows = np.zeros(1, np.int64)
         tail_cols = np.zeros(1, np.int64)
         tail_vals = np.zeros(1, np.float32)
+    # HOST leaves: the caller decides when (and in what dtype) to transfer —
+    # e.g. cast_features to bf16 FIRST, then one device_put. An eager
+    # jnp.asarray here would ship the dense block f32 over the link (at
+    # bench scale, gigabytes) before any cast could halve it.
     return HybridRows(
-        dense=jnp.asarray(dense),
-        dense_cols=jnp.asarray(sel.astype(np.int32)),
-        tail_rows=jnp.asarray(tail_rows.astype(np.int32)),
-        tail_cols=jnp.asarray(tail_cols.astype(np.int32)),
-        tail_vals=jnp.asarray(tail_vals.astype(np.float32)),
+        dense=dense,
+        dense_cols=sel.astype(np.int32),
+        tail_rows=tail_rows.astype(np.int32),
+        tail_cols=tail_cols.astype(np.int32),
+        tail_vals=tail_vals.astype(np.float32),
         n_features=d,
     )
 
@@ -226,12 +252,14 @@ def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
         rows[s, :c] = tr[lo:hi] - s * n_local
         cols[s, :c] = tc[lo:hi]
         vals[s, :c] = tv[lo:hi]
+    # Host leaves (dense keeps the input's residency); the one transfer
+    # happens at _sharded_prep's device_put into the mesh sharding.
     return ShardedHybridRows(
         dense=X.dense,
-        dense_cols=X.dense_cols,
-        tail_rows=jnp.asarray(rows),
-        tail_cols=jnp.asarray(cols),
-        tail_vals=jnp.asarray(vals),
+        dense_cols=np.asarray(X.dense_cols),
+        tail_rows=rows,
+        tail_cols=cols,
+        tail_vals=vals,
         n_features=X.n_features,
     )
 

@@ -251,9 +251,12 @@ def minimize_lbfgs_margin(
         dphi0 = jnp.where(bad_dir, -jnp.dot(s.g, s.g), dphi0)
 
         dz = obj.direction_margin(direction, batch)  # X pass 1
+        # One O(d) pass for the regularizer's ray coefficients; every Wolfe
+        # trial below is then O(n) elementwise with zero (d,) work.
+        ray = obj.ray_reg_coeffs(s.w, direction)
 
         def phi(a):
-            return obj.phi_at(s.z, dz, a, s.w, direction, batch)
+            return obj.phi_at_ray(s.z, dz, a, ray, batch)
 
         a_init = jnp.where(s.count > 0, 1.0,
                            1.0 / jnp.maximum(jnp.linalg.norm(direction), 1.0))
