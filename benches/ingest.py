@@ -61,6 +61,23 @@ def main() -> None:
         assert data.n == n
         print(f"{name:10s}: {dt:6.2f}s  ({n / dt:,.0f} rec/s)")
 
+    # streaming (bounded-memory chunks) must hold the one-shot throughput
+    from photon_tpu.data.streaming import (
+        build_index_maps_streaming,
+        iter_game_chunks,
+    )
+
+    maps = build_index_maps_streaming(path, cfg)
+    for name, use_native in (("stream py", False), ("stream C++", True)):
+        t0 = time.perf_counter()
+        stream, chunks = iter_game_chunks(path, cfg, maps, chunk_rows=8192,
+                                          use_native=use_native)
+        total = sum(chunk.n for chunk in chunks)
+        dt = time.perf_counter() - t0
+        assert total == n
+        print(f"{name:10s}: {dt:6.2f}s  ({n / dt:,.0f} rec/s; "
+              f"peak arena {stream.peak_arena_bytes / 1e6:.1f} MB)")
+
 
 if __name__ == "__main__":
     main()

@@ -272,9 +272,11 @@ class AvroContainerReader:
             self.sync = f.read(SYNC_SIZE)
             self._data_offset = f.tell()
 
-    def blocks(self) -> Iterator[tuple[int, bytes]]:
+    def blocks(self, skip_payload: bool = False) -> Iterator[tuple[int, bytes]]:
         """(record count, decompressed payload) per container block — the
-        unit the native C++ decoder consumes."""
+        unit the native C++ decoder consumes. With ``skip_payload`` the
+        payload is seeked over without reading or decompressing (the
+        streaming layer's header-only row-count scan) and b"" is yielded."""
         with open(self.path, "rb") as f:
             f.seek(self._data_offset)
             while True:
@@ -284,13 +286,17 @@ class AvroContainerReader:
                 f.seek(-1, os.SEEK_CUR)
                 count = _read_long(f)
                 size = _read_long(f)
-                payload = f.read(size)
-                if len(payload) != size:
-                    raise EOFError(f"{self.path}: truncated block")
+                if skip_payload:
+                    f.seek(size, os.SEEK_CUR)
+                    payload = b""
+                else:
+                    payload = f.read(size)
+                    if len(payload) != size:
+                        raise EOFError(f"{self.path}: truncated block")
                 sync = f.read(SYNC_SIZE)
                 if sync != self.sync:
                     raise ValueError(f"{self.path}: bad sync marker")
-                if self.codec == "deflate":
+                if not skip_payload and self.codec == "deflate":
                     payload = zlib.decompress(payload, -15)
                 yield count, payload
 
@@ -301,16 +307,23 @@ class AvroContainerReader:
                 yield read_datum(buf, self.schema)
 
 
+def avro_paths(path) -> list:
+    """One file, or every .avro file of a directory in sorted order — THE
+    file-selection convention (the reference's HDFS-folder input), shared
+    by the one-shot, native, and streaming readers."""
+    if os.path.isdir(path):
+        return [os.path.join(path, n) for n in sorted(os.listdir(path))
+                if n.endswith(".avro")]
+    return [str(path)]
+
+
 def read_avro(path) -> list:
     """All records of one container file (or every .avro file in a dir,
     matching the reference's HDFS-folder input convention)."""
-    if os.path.isdir(path):
-        out = []
-        for name in sorted(os.listdir(path)):
-            if name.endswith(".avro"):
-                out.extend(AvroContainerReader(os.path.join(path, name)))
-        return out
-    return list(AvroContainerReader(path))
+    out: list = []
+    for p in avro_paths(path):
+        out.extend(AvroContainerReader(p))
+    return out
 
 
 def write_avro(

@@ -111,10 +111,9 @@ def read_game_data_native(
     """Native-decoder twin of ingest.read_game_data; None when inapplicable."""
     if not native.available():
         return None
-    import os
+    from photon_tpu.data.avro_io import avro_paths
 
-    paths = ([os.path.join(path, n) for n in sorted(os.listdir(path))
-              if n.endswith(".avro")] if os.path.isdir(path) else [path])
+    paths = avro_paths(path)
     if not paths:
         return None
     readers = [AvroContainerReader(p) for p in paths]

@@ -1,0 +1,537 @@
+"""Streaming ingestion: block-streamed Avro → bounded-memory GameData.
+
+Reference parity: com.linkedin.photon.ml.data.avro.AvroDataReader reads
+partitioned HDFS data through Spark — the dataset never materializes on one
+host. The TPU-native analog here:
+
+- `iter_game_chunks`: an iterator of GameData CHUNKS, assembled container
+  block by container block (native C++ decoder when available, pure Python
+  otherwise). Host arena stays bounded by ~2 chunks regardless of dataset
+  size; multi-file inputs stream file after file.
+- `build_index_maps_streaming`: the training-path first pass — feature-key →
+  id maps built over the same block stream, nothing else materialized
+  (reference: FeatureIndexingJob's offline pass).
+- `stream_to_device`: chunks go STRAIGHT into their device placement — per
+  device, a preallocated host buffer of exactly one shard (n/D rows) fills
+  from the chunk stream, is device_put to its device, and is released; the
+  global array is assembled with `jax.make_array_from_single_device_arrays`.
+  Peak host memory is one device-shard + one chunk, so a dataset bounded by
+  the MESH's total HBM (the 1B-row regime) ingests through a small host.
+
+Chunks are container-block-aligned: a chunk closes at the first block
+boundary at or after `chunk_rows`, so concatenating the chunks reproduces
+the one-shot `read_game_data` result exactly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+from photon_tpu.data.avro_io import (
+    AvroContainerReader,
+    avro_paths,
+    read_datum,
+)
+from photon_tpu.data.feature_bags import coo_to_matrix
+from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_tpu.data.ingest import (
+    GameDataConfig,
+    normalize_bag,
+    records_to_game_data,
+)
+from photon_tpu.game.dataset import GameData
+
+
+def scan_row_counts(path) -> list:
+    """Per-file record counts from the container block HEADERS only — no
+    payload decompression, no record decode. Cheap enough to run before
+    streaming so device buffers can be preallocated exactly."""
+    counts = []
+    for p in avro_paths(path):
+        rd = AvroContainerReader(p)
+        counts.append(sum(c for c, _ in rd.blocks(skip_payload=True)))
+    return counts
+
+
+def _frozen_maps_or_raise(config: GameDataConfig, index_maps) -> dict:
+    index_maps = dict(index_maps or {})
+    missing = [s for s in config.shards if s not in index_maps]
+    if missing:
+        raise ValueError(
+            f"streaming ingestion needs frozen index maps for every shard "
+            f"(missing {missing}); run build_index_maps_streaming (or the "
+            "FeatureIndexingDriver) first — ids cannot be assigned "
+            "on-the-fly once early chunks have already been emitted")
+    return index_maps
+
+
+def build_index_maps_streaming(
+    path,
+    config: GameDataConfig,
+    index_maps: Optional[dict] = None,
+) -> dict:
+    """One bounded-memory pass assigning feature ids (first-seen order,
+    bags in shard-config order — identical to ingest.build_index_map).
+    Existing maps in `index_maps` are kept as-is. Runs through the native
+    block decoder when it applies (a pure-Python pass over a 1B-row input
+    would gate the fast chunk stream behind days of record decoding)."""
+    index_maps = dict(index_maps or {})
+    todo = {s: cfg for s, cfg in config.shards.items() if s not in index_maps}
+    if not todo:
+        return index_maps
+    if not index_maps:  # all shards building: the native pass applies
+        nat = _build_maps_native(path, config)
+        if nat is not None:
+            return nat
+    building = {s: IndexMap() for s in todo}
+    bag_names = sorted({b for cfg in todo.values() for b in cfg.bags})
+    for p in avro_paths(path):
+        for rec in AvroContainerReader(p):
+            norm = {b: normalize_bag(rec.get(b)) for b in bag_names}
+            for s, cfg in todo.items():
+                imap = building[s]
+                for bag in cfg.bags:
+                    for ntv in norm[bag]:
+                        imap.index_of(feature_key(ntv.name, ntv.term))
+    for s, cfg in todo.items():
+        if cfg.has_intercept:
+            building[s].index_of(INTERCEPT_KEY)
+        index_maps[s] = building[s].freeze()
+    return index_maps
+
+
+def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
+    """Native block-decode pass in BUILD mode, per-block arrays discarded —
+    id assignment mirrors read_game_data_native exactly (same stores, same
+    first-seen order). None when the native path doesn't apply."""
+    from photon_tpu import native
+    from photon_tpu.data.native_ingest import compile_plan
+
+    if not native.available():
+        return None
+    paths = avro_paths(path)
+    if not paths:
+        return None
+    readers = [AvroContainerReader(p) for p in paths]
+    plan0 = compile_plan(readers[0].schema, config)
+    if plan0 is None:
+        return None
+    for rd in readers[1:]:
+        if compile_plan(rd.schema, config) != plan0:
+            return None
+    shard_names = list(config.shards)
+    stores = [native.NativeIndexStore(capacity_hint=1024)
+              for _ in shard_names]
+    plan = _decode_plan(plan0, config, shard_names)
+    for rd in readers:
+        for count, payload in rd.blocks():
+            dec = native.decode_block(payload, count, 0, plan, stores, True)
+            if not dec.ok:
+                raise ValueError(f"{rd.path}: malformed Avro block")
+            dec.free()
+    out = {}
+    for si, s in enumerate(shard_names):
+        cfg = config.shards[s]
+        imap = IndexMap({k: i for i, k in
+                         enumerate(stores[si].keys_in_order())},
+                        frozen=True, has_intercept=cfg.has_intercept)
+        if cfg.has_intercept:
+            imap.index_of(INTERCEPT_KEY)  # no-op id; records metadata
+        out[s] = imap
+    return out
+
+
+def _decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
+    """The decode_block plan tuple from a compiled schema plan (shared by
+    the map-build pass and the chunk stream; mirrors
+    native_ingest.read_game_data_native's store/bag wiring)."""
+    ops, aux, vkinds, bag_names = plan0
+    sb_off, sb_idx = [0], []
+    for s in shard_names:
+        sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
+        sb_off.append(len(sb_idx))
+    return (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
+            np.asarray(vkinds or [0], np.int32),
+            np.asarray(sb_off, np.int32),
+            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
+
+
+@dataclasses.dataclass
+class ChunkStream:
+    """Iterator state + arena accounting for one streaming read.
+
+    `peak_arena_bytes` tracks the maximum bytes of numpy buffers the
+    assembler held live at any point — the test contract is that it stays
+    ≤ ~2 chunks regardless of how many files/rows stream through.
+    """
+
+    config: GameDataConfig
+    index_maps: dict
+    chunk_rows: int
+    sparse_k: Optional[int]
+    peak_arena_bytes: int = 0
+
+    def _note(self, live_bytes: int) -> None:
+        if live_bytes > self.peak_arena_bytes:
+            self.peak_arena_bytes = live_bytes
+
+
+def _chunk_nbytes(data: GameData) -> int:
+    """Numeric-buffer bytes of one assembled chunk (entity-id object arrays
+    are host pointers either way and excluded)."""
+    from photon_tpu.data.matrix import SparseRows
+
+    total = data.y.nbytes + data.weights.nbytes + data.offsets.nbytes
+    for X in data.shards.values():
+        if isinstance(X, SparseRows):
+            total += X.indices.nbytes + X.values.nbytes
+        else:
+            total += X.nbytes
+    return int(total)
+
+
+def iter_game_chunks(
+    path,
+    config: GameDataConfig,
+    index_maps: dict,
+    chunk_rows: int = 65536,
+    sparse_k: Optional[int] = None,
+    use_native: Optional[bool] = None,
+) -> tuple[ChunkStream, Iterator[GameData]]:
+    """(stream handle, iterator of GameData chunks) over one file or a
+    directory of .avro files. Needs frozen index maps for EVERY shard
+    (training: build them with `build_index_maps_streaming` first;
+    scoring: reuse the training maps — reference behavior).
+
+    Chunks close at container-block boundaries, so sizes are
+    ≥ `chunk_rows` (except the last) and concatenation equals the one-shot
+    read. `use_native` as in ingest.read_game_data.
+    """
+    index_maps = _frozen_maps_or_raise(config, index_maps)
+    stream = ChunkStream(config, index_maps, chunk_rows, sparse_k)
+    if use_native is not False:
+        # Availability / plannability checked EAGERLY (before the first
+        # next()), so a forced use_native=True fails at the call site.
+        it = _native_chunks(path, stream)
+        if it is not None:
+            return stream, it
+        if use_native:
+            raise RuntimeError(
+                "native streaming requested but unavailable (toolchain "
+                "missing or schema not plannable)")
+    return stream, _python_chunks(path, stream)
+
+
+def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
+    """Pure-Python fallback: records buffered per chunk, then the standard
+    records→GameData assembly with the frozen maps. Chunks close at
+    container-BLOCK boundaries, exactly like the native path, so chunking
+    is identical whichever decoder runs."""
+    import io
+
+    buf: list = []
+
+    def flush():
+        data, _ = records_to_game_data(buf, stream.config, stream.index_maps,
+                                       stream.sparse_k)
+        # the record buffer and the assembled chunk coexist briefly
+        stream._note(2 * _chunk_nbytes(data))
+        buf.clear()
+        return data
+
+    for p in avro_paths(path):
+        rd = AvroContainerReader(p)
+        for count, payload in rd.blocks():
+            b = io.BytesIO(payload)
+            buf.extend(read_datum(b, rd.schema) for _ in range(count))
+            if len(buf) >= stream.chunk_rows:
+                yield flush()
+    if buf:
+        yield flush()
+
+
+def _native_chunks(path, stream: ChunkStream):
+    """C++ block decoder path; None when unavailable/unplannable."""
+    from photon_tpu import native
+    from photon_tpu.data.native_ingest import compile_plan
+
+    if not native.available():
+        return None
+    paths = avro_paths(path)
+    if not paths:
+        return None
+    readers = [AvroContainerReader(p) for p in paths]
+    config = stream.config
+    plan0 = compile_plan(readers[0].schema, config)
+    if plan0 is None:
+        return None
+    for rd in readers[1:]:
+        if compile_plan(rd.schema, config) != plan0:
+            return None  # schema drift across files: caller falls back
+
+    shard_names = list(config.shards)
+    stores = []
+    for s in shard_names:
+        imap = stream.index_maps[s]
+        keys = imap.keys_in_order()
+        if imap.has_intercept:
+            keys = keys[:-1]
+        stores.append(native.NativeIndexStore.from_keys(keys))
+    plan = _decode_plan(plan0, config, shard_names)
+
+    def generator():
+        ys, offs, wts = [], [], []
+        coos = [[] for _ in shard_names]
+        ents = [[] for _ in config.entity_fields]
+        rows_in_chunk = 0
+        live = 0
+
+        def assemble() -> GameData:
+            nonlocal rows_in_chunk, live
+            n = rows_in_chunk
+            y = np.concatenate(ys).astype(np.float32)
+            offsets = np.concatenate(offs).astype(np.float32)
+            weights = np.concatenate(wts).astype(np.float32)
+            shards = {}
+            for si, s in enumerate(shard_names):
+                cfg = config.shards[s]
+                imap = stream.index_maps[s]
+                rows = np.concatenate([c[0] for c in coos[si]])
+                cols = np.concatenate([c[1] for c in coos[si]]).astype(
+                    np.int64)
+                vals = np.concatenate([c[2] for c in coos[si]])
+                if cfg.has_intercept:
+                    rows = np.concatenate(
+                        [rows, np.arange(n, dtype=np.int64)])
+                    cols = np.concatenate(
+                        [cols, np.full(n, imap.intercept_id, np.int64)])
+                    vals = np.concatenate([vals, np.ones(n, np.float32)])
+                shards[s] = coo_to_matrix(rows, cols, vals, n,
+                                          imap.n_features,
+                                          cfg.dense_threshold,
+                                          k=stream.sparse_k)
+            ids = {}
+            for e_i, e in enumerate(config.entity_fields):
+                col = np.concatenate(ents[e_i])
+                if any(v is None for v in col):
+                    raise ValueError(f"records missing entity id {e!r}")
+                ids[e] = np.asarray([str(v) for v in col])
+            out = GameData(y, weights, offsets, shards, ids)
+            # block pieces + the assembled chunk coexist briefly
+            stream._note(live + _chunk_nbytes(out))
+            ys.clear(); offs.clear(); wts.clear()                  # noqa: E702
+            for c in coos:
+                c.clear()
+            for e in ents:
+                e.clear()
+            rows_in_chunk = 0
+            live = 0
+            return out
+
+        for rd in readers:
+            for count, payload in rd.blocks():
+                dec = native.decode_block(payload, count, rows_in_chunk,
+                                          plan, stores, False)
+                if not dec.ok:
+                    raise ValueError(f"{rd.path}: malformed Avro block")
+                y, y_set = dec.scalars(0)
+                if not y_set.all():
+                    raise ValueError(f"{rd.path}: record missing response")
+                off, off_set = dec.scalars(1)
+                wt, wt_set = dec.scalars(2)
+                ys.append(y)
+                offs.append(np.where(off_set, off, 0.0))
+                wts.append(np.where(wt_set, wt, 1.0))
+                live += y.nbytes * 3
+                for si in range(len(shard_names)):
+                    c = dec.coo(si)
+                    coos[si].append(c)
+                    live += sum(a.nbytes for a in c)
+                for e in range(len(config.entity_fields)):
+                    ents[e].append(dec.entities(e))
+                dec.free()
+                rows_in_chunk += count
+                if rows_in_chunk >= stream.chunk_rows:
+                    yield assemble()
+        if rows_in_chunk:
+            yield assemble()
+
+    return generator()
+
+
+def stream_to_device(
+    path,
+    config: GameDataConfig,
+    index_maps: dict,
+    mesh=None,
+    chunk_rows: int = 65536,
+    sparse_k: Optional[int] = None,
+    use_native: Optional[bool] = None,
+    feature_dtype=None,
+) -> tuple[GameData, int]:
+    """Stream a dataset STRAIGHT into its device placement.
+
+    With a mesh: rows are contiguously sharded over all mesh axes; per
+    device a preallocated host buffer of exactly one shard fills from the
+    chunk stream, is device_put onto ITS device, and is released — host
+    peak = one shard + one chunk, not the dataset. Rows pad (weight 0) to a
+    device multiple, entity ids pad with "". Without a mesh: one
+    preallocated buffer and a single transfer.
+
+    `feature_dtype` (e.g. jnp.bfloat16) casts feature VALUES as chunks
+    arrive — the storage-dtype path of data.dataset.cast_features without a
+    full-size intermediate.
+
+    Returns (GameData with device-resident y/weights/offsets/shards, n_real)
+    — entity ids stay host-side numpy (they factorize on host). n_real is
+    the unpadded row count.
+    """
+    import jax
+
+    from photon_tpu.data.matrix import SparseRows
+
+    index_maps = _frozen_maps_or_raise(config, index_maps)
+    n_real = sum(scan_row_counts(path))
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    from photon_tpu.parallel.mesh import pad_to_multiple
+
+    n_pad = pad_to_multiple(max(n_real, 1), n_dev)
+    n_local = n_pad // n_dev
+    devices = (list(mesh.devices.reshape(-1)) if mesh is not None
+               else [None])
+
+    # Per-shard layout decided ONCE from the frozen maps (chunk-independent).
+    dense_shards = {}
+    for s, cfg in config.shards.items():
+        d = index_maps[s].n_features
+        if d > cfg.dense_threshold and sparse_k is None:
+            raise ValueError(
+                f"shard {s!r} is sparse (d={d} > dense_threshold="
+                f"{cfg.dense_threshold}): stream_to_device needs a fixed "
+                "sparse_k so per-device SparseRows shards share one shape")
+        dense_shards[s] = d <= cfg.dense_threshold
+
+    f_dtype = np.float32 if feature_dtype is None else feature_dtype
+
+    def alloc_local():
+        buf = {
+            "y": np.zeros(n_local, np.float32),
+            "weights": np.zeros(n_local, np.float32),
+            "offsets": np.zeros(n_local, np.float32),
+        }
+        for s in config.shards:
+            d = index_maps[s].n_features
+            if dense_shards[s]:
+                buf[s] = np.zeros((n_local, d), f_dtype)
+            else:
+                buf[s] = (np.zeros((n_local, sparse_k), np.int32),
+                          np.zeros((n_local, sparse_k), f_dtype))
+        return buf
+
+    shard_parts: dict = {k: [] for k in ("y", "weights", "offsets",
+                                         *config.shards)}
+    entity_cols: dict = {e: [] for e in config.entity_fields}
+
+    def ship(buf):
+        """device_put one completed local shard onto its device."""
+        dev = devices[len(shard_parts["y"])] if mesh is not None else None
+        for key in shard_parts:
+            v = buf[key]
+            if isinstance(v, tuple):
+                shard_parts[key].append(tuple(
+                    jax.device_put(a, dev) for a in v))
+            else:
+                shard_parts[key].append(jax.device_put(v, dev))
+
+    buf = alloc_local()
+    filled = 0  # rows filled in the current local buffer
+    row = 0     # global row cursor
+
+    stream, chunks = iter_game_chunks(path, config, index_maps,
+                                      chunk_rows=chunk_rows,
+                                      sparse_k=sparse_k,
+                                      use_native=use_native)
+    for chunk in chunks:
+        c0 = 0
+        n_c = chunk.n
+        for e in config.entity_fields:
+            entity_cols[e].append(np.asarray(chunk.entity_ids[e]))
+        # ONE host materialization per chunk — inside the fill loop a chunk
+        # straddling many device buffers would re-fetch the whole matrix
+        # once per straddled shard (coo_to_matrix returns device arrays)
+        host = {"y": np.asarray(chunk.y),
+                "weights": np.asarray(chunk.weights),
+                "offsets": np.asarray(chunk.offsets)}
+        for s in config.shards:
+            X = chunk.shards[s]
+            host[s] = (np.asarray(X) if dense_shards[s]
+                       else (np.asarray(X.indices), np.asarray(X.values)))
+        while c0 < n_c:
+            take = min(n_c - c0, n_local - filled)
+            sl = slice(c0, c0 + take)
+            dst = slice(filled, filled + take)
+            buf["y"][dst] = host["y"][sl]
+            buf["weights"][dst] = host["weights"][sl]
+            buf["offsets"][dst] = host["offsets"][sl]
+            for s in config.shards:
+                if dense_shards[s]:
+                    buf[s][dst] = host[s][sl].astype(f_dtype)
+                else:
+                    ind, val = buf[s]
+                    h_ind, h_val = host[s]
+                    k_c = h_ind.shape[1]
+                    ind[dst, :k_c] = h_ind[sl]
+                    val[dst, :k_c] = h_val[sl].astype(f_dtype)
+            filled += take
+            c0 += take
+            row += take
+            if filled == n_local and mesh is not None:
+                ship(buf)
+                buf = alloc_local() if row < n_real else None
+                filled = 0
+    if buf is not None and (filled or not shard_parts["y"]):
+        ship(buf)
+
+    if mesh is not None:
+        # pad the tail: remaining devices get all-zero (weight-0) shards
+        while len(shard_parts["y"]) < n_dev:
+            ship(alloc_local())
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+
+        def assemble(parts, width=None):
+            if isinstance(parts[0], tuple):
+                return tuple(assemble([p[i] for p in parts])
+                             for i in range(len(parts[0])))
+            shape = (n_pad,) + parts[0].shape[1:]
+            spec = P(axes) if parts[0].ndim == 1 else P(axes, None)
+            return jax.make_array_from_single_device_arrays(
+                shape, NamedSharding(mesh, spec), parts)
+
+        leaves = {k: assemble(v) for k, v in shard_parts.items()}
+    else:
+        leaves = {k: (tuple(v[0]) if isinstance(v[0], tuple) else v[0])
+                  for k, v in shard_parts.items()}
+
+    shards = {}
+    for s in config.shards:
+        v = leaves[s]
+        if dense_shards[s]:
+            shards[s] = v
+        else:
+            shards[s] = SparseRows(v[0], v[1], index_maps[s].n_features)
+
+    ids = {}
+    for e in config.entity_fields:
+        col = (np.concatenate(entity_cols[e]) if entity_cols[e]
+               else np.zeros(0, object))
+        pad = np.full(n_pad - n_real, "", dtype=object)
+        ids[e] = np.asarray([str(v) for v in np.concatenate([col, pad])])
+
+    data = GameData(leaves["y"], leaves["weights"], leaves["offsets"],
+                    shards, ids)
+    return data, n_real

@@ -1,0 +1,238 @@
+"""Streaming ingestion: bounded-memory chunks == one-shot read; direct
+per-device placement (SURVEY/VERDICT: the reference never holds the dataset
+on one host — Spark streams partitions; these tests pin our analog)."""
+import numpy as np
+import pytest
+
+from photon_tpu.data.avro_io import write_avro
+from photon_tpu.data.ingest import (
+    GameDataConfig,
+    read_game_data,
+    training_example_schema,
+)
+from photon_tpu.data.matrix import SparseRows
+from photon_tpu.data.streaming import (
+    build_index_maps_streaming,
+    iter_game_chunks,
+    scan_row_counts,
+    stream_to_device,
+)
+from photon_tpu.data.feature_bags import FeatureShardConfig
+
+
+def _write_files(root, n_files=3, rows_per_file=400, seed=0, wide=False):
+    """Multi-file GAME dataset; `wide` adds a high-cardinality bag so the
+    shard goes down the SparseRows path."""
+    rng = np.random.default_rng(seed)
+    schema = training_example_schema(feature_bags=("f", "g"),
+                                     entity_fields=("member",))
+    paths = []
+    for fi in range(n_files):
+        records = []
+        for i in range(rows_per_file):
+            f_bag = [{"name": "age", "term": "", "value": float(rng.normal())},
+                     {"name": "ctr", "term": "", "value": float(rng.normal())}]
+            if wide:
+                g_bag = [{"name": f"id{int(v)}", "term": "t",
+                          "value": float(rng.normal())}
+                         for v in rng.integers(0, 500, size=3)]
+            else:
+                g_bag = [{"name": "bias", "term": "", "value": 1.0}]
+            records.append({
+                "response": float(rng.integers(0, 2)),
+                "offset": float(rng.normal()) if i % 3 == 0 else None,
+                "weight": 2.0 if i % 5 == 0 else None,
+                "uid": f"r{fi}_{i}",
+                "member": f"m{int(rng.integers(0, 37))}",
+                "f": f_bag, "g": g_bag,
+            })
+        p = root / f"part-{fi:03d}.avro"
+        write_avro(p, records, schema, block_records=130)
+        paths.append(p)
+    return root
+
+
+def _config(wide=False):
+    return GameDataConfig(
+        shards={
+            "dense": FeatureShardConfig(bags=("f",), has_intercept=True),
+            "other": FeatureShardConfig(
+                bags=("g",), has_intercept=not wide,
+                dense_threshold=4 if wide else 1024),
+        },
+        entity_fields=("member",),
+    )
+
+
+def _assert_chunks_equal_one_shot(root, config, use_native, sparse_k=None,
+                                  chunk_rows=300):
+    one_shot, maps = read_game_data(str(root), config, sparse_k=sparse_k,
+                                    use_native=use_native)
+    maps2 = build_index_maps_streaming(str(root), config)
+    for s in config.shards:
+        assert maps2[s].keys_in_order() == maps[s].keys_in_order()
+    stream, chunks = iter_game_chunks(str(root), config, maps2,
+                                      chunk_rows=chunk_rows,
+                                      sparse_k=sparse_k,
+                                      use_native=use_native)
+    parts = list(chunks)
+    assert len(parts) >= 2  # actually streamed in pieces
+    assert sum(p.n for p in parts) == one_shot.n
+    np.testing.assert_array_equal(
+        np.concatenate([p.y for p in parts]), one_shot.y)
+    np.testing.assert_array_equal(
+        np.concatenate([p.weights for p in parts]), one_shot.weights)
+    np.testing.assert_array_equal(
+        np.concatenate([p.offsets for p in parts]), one_shot.offsets)
+    np.testing.assert_array_equal(
+        np.concatenate([p.entity_ids["member"] for p in parts]),
+        one_shot.entity_ids["member"])
+    for s in config.shards:
+        X1 = one_shot.shards[s]
+        if isinstance(X1, SparseRows):
+            ind = np.concatenate([np.asarray(p.shards[s].indices)
+                                  for p in parts])
+            val = np.concatenate([np.asarray(p.shards[s].values)
+                                  for p in parts])
+            np.testing.assert_array_equal(ind, np.asarray(X1.indices))
+            np.testing.assert_array_equal(val, np.asarray(X1.values))
+        else:
+            np.testing.assert_array_equal(
+                np.concatenate([np.asarray(p.shards[s]) for p in parts]),
+                np.asarray(X1))
+    return stream, parts
+
+
+class TestChunkStream:
+    def test_python_chunks_match_one_shot(self, tmp_path):
+        root = _write_files(tmp_path)
+        _assert_chunks_equal_one_shot(root, _config(), use_native=False)
+
+    def test_native_chunks_match_one_shot(self, tmp_path):
+        from photon_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        root = _write_files(tmp_path)
+        _assert_chunks_equal_one_shot(root, _config(), use_native=True)
+
+    def test_sparse_chunks_match_one_shot(self, tmp_path):
+        root = _write_files(tmp_path, wide=True)
+        _assert_chunks_equal_one_shot(root, _config(wide=True),
+                                      use_native=False, sparse_k=4)
+
+    def test_bounded_arena(self, tmp_path):
+        """Peak assembler arena ≤ 2× the largest chunk, however many files
+        and rows stream through (the VERDICT 'bounded peak RSS' contract)."""
+        from photon_tpu.data.streaming import _chunk_nbytes
+
+        root = _write_files(tmp_path, n_files=6, rows_per_file=500)
+        config = _config()
+        maps = build_index_maps_streaming(str(root), config)
+        for use_native in (False, None):
+            stream, chunks = iter_game_chunks(str(root), config, maps,
+                                              chunk_rows=250,
+                                              use_native=use_native)
+            biggest = 0
+            n_chunks = 0
+            for chunk in chunks:
+                biggest = max(biggest, _chunk_nbytes(chunk))
+                n_chunks += 1
+            assert n_chunks >= 6
+            assert stream.peak_arena_bytes <= 2 * biggest + (1 << 16)
+
+    def test_scan_row_counts(self, tmp_path):
+        root = _write_files(tmp_path, n_files=4, rows_per_file=123)
+        assert scan_row_counts(str(root)) == [123] * 4
+
+    def test_requires_frozen_maps(self, tmp_path):
+        root = _write_files(tmp_path)
+        with pytest.raises(ValueError, match="frozen index maps"):
+            iter_game_chunks(str(root), _config(), {})
+
+
+class TestStreamToDevice:
+    def test_single_device_matches_one_shot(self, tmp_path):
+        root = _write_files(tmp_path)
+        config = _config()
+        one_shot, maps = read_game_data(str(root), config)
+        data, n_real = stream_to_device(str(root), config, maps,
+                                        chunk_rows=300)
+        assert n_real == one_shot.n
+        np.testing.assert_array_equal(np.asarray(data.y), one_shot.y)
+        np.testing.assert_array_equal(np.asarray(data.weights),
+                                      one_shot.weights)
+        np.testing.assert_array_equal(
+            np.asarray(data.shards["dense"]),
+            np.asarray(one_shot.shards["dense"]))
+        np.testing.assert_array_equal(data.entity_ids["member"],
+                                      one_shot.entity_ids["member"])
+
+    def test_mesh_sharded_matches_one_shot(self, tmp_path, mesh8):
+        """Chunks land on their devices directly; the assembled global
+        array equals the one-shot host read padded to the mesh."""
+        root = _write_files(tmp_path, n_files=3, rows_per_file=333)
+        config = _config()
+        one_shot, maps = read_game_data(str(root), config)
+        data, n_real = stream_to_device(str(root), config, maps, mesh=mesh8,
+                                        chunk_rows=250)
+        assert n_real == one_shot.n == 999
+        n_pad = data.y.shape[0]
+        assert n_pad % 8 == 0
+        got_y = np.asarray(data.y)
+        np.testing.assert_array_equal(got_y[:n_real], one_shot.y)
+        assert (np.asarray(data.weights)[n_real:] == 0.0).all()  # padding
+        np.testing.assert_array_equal(
+            np.asarray(data.shards["dense"])[:n_real],
+            np.asarray(one_shot.shards["dense"]))
+        # really sharded: one addressable shard per device, rows split
+        shards = data.y.addressable_shards
+        assert len(shards) == 8
+        assert all(s.data.shape[0] == n_pad // 8 for s in shards)
+
+    def test_mesh_sparse_and_bf16(self, tmp_path, mesh8):
+        import jax.numpy as jnp
+
+        root = _write_files(tmp_path, wide=True)
+        config = _config(wide=True)
+        one_shot, maps = read_game_data(str(root), config, sparse_k=4)
+        data, n_real = stream_to_device(
+            str(root), config, maps, mesh=mesh8, chunk_rows=400,
+            sparse_k=4, feature_dtype=jnp.bfloat16)
+        X = data.shards["other"]
+        assert isinstance(X, SparseRows)
+        assert X.values.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(X.indices)[:n_real],
+            np.asarray(one_shot.shards["other"].indices))
+        np.testing.assert_allclose(
+            np.asarray(X.values, dtype=np.float32)[:n_real],
+            np.asarray(one_shot.shards["other"].values),
+            rtol=0.01, atol=1e-3)  # bf16 rounding
+
+    def test_sparse_without_k_raises(self, tmp_path):
+        root = _write_files(tmp_path, wide=True)
+        config = _config(wide=True)
+        maps = build_index_maps_streaming(str(root), config)
+        with pytest.raises(ValueError, match="sparse_k"):
+            stream_to_device(str(root), config, maps)
+
+    def test_streamed_data_trains(self, tmp_path):
+        """End to end: streamed device-resident data fits a GLM."""
+        from photon_tpu.data.dataset import make_batch
+        from photon_tpu.models.training import train_glm
+        from photon_tpu.ops.losses import TaskType
+        from photon_tpu.optim import regularization as reg
+        from photon_tpu.optim.config import OptimizerConfig
+
+        root = _write_files(tmp_path)
+        config = _config()
+        maps = build_index_maps_streaming(str(root), config)
+        data, n_real = stream_to_device(str(root), config, maps,
+                                        chunk_rows=300)
+        batch = make_batch(data.shards["dense"], data.y,
+                           weights=data.weights, offsets=data.offsets)
+        model, res = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0))
+        assert np.isfinite(np.asarray(model.coefficients.means)).all()
