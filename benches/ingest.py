@@ -71,6 +71,7 @@ def main() -> None:
     for name, use_native in (("stream py", False), ("stream C++", True)):
         t0 = time.perf_counter()
         stream, chunks = iter_game_chunks(path, cfg, maps, chunk_rows=8192,
+                                          sparse_k=args.bag_nnz + 1,  # + intercept
                                           use_native=use_native)
         total = sum(chunk.n for chunk in chunks)
         dt = time.perf_counter() - t0

@@ -5,8 +5,11 @@ AvroDataReader) — the reference reads TrainingExampleAvro/GameDatum records
 from HDFS Avro container files. photon-tpu implements the container format
 directly (no Avro dependency in this image): header magic ``Obj\\x01``, file
 metadata (schema JSON + codec), 16-byte sync marker, then blocks of
-(record count, byte size, payload, sync). Codecs: ``null`` and ``deflate``
-(raw zlib, the two the reference's Hadoop jobs produce).
+(record count, byte size, payload, sync). Codecs: ``null``, ``deflate``
+(raw zlib) and ``snappy`` (raw block + 4-byte big-endian CRC32 of the
+uncompressed bytes, per the Avro spec) — the three the reference's Hadoop
+jobs produce; snappy is vendored (data.snappy pure Python, with a C++
+decompressor in photon_tpu.native for the ingest hot path).
 ``photon_tpu.native`` adds an optional C++ block decoder for the hot
 NameTermValue path; this module is the complete fallback.
 
@@ -245,6 +248,26 @@ def write_datum(out, schema, value) -> None:
 # --------------------------------------------------------------------------
 
 
+def _snappy_block_uncompress(path, payload: bytes) -> bytes:
+    """Avro snappy block: raw snappy + 4-byte big-endian CRC32 of the
+    uncompressed bytes. Decompresses through the C++ runtime when present
+    (the ingest hot path), pure Python otherwise."""
+    if len(payload) < 4:
+        raise ValueError(f"{path}: snappy block too short for its CRC")
+    raw, (crc,) = payload[:-4], struct.unpack(">I", payload[-4:])
+    from photon_tpu import native
+
+    if native.available():
+        out = native.snappy_uncompress(raw)
+    else:
+        from photon_tpu.data import snappy as _snappy
+
+        out = _snappy.uncompress(raw)
+    if zlib.crc32(out) & 0xFFFFFFFF != crc:
+        raise ValueError(f"{path}: snappy block CRC mismatch")
+    return out
+
+
 class AvroContainerReader:
     """Iterate records of one Avro object container file."""
 
@@ -266,7 +289,7 @@ class AvroContainerReader:
                     meta[k] = _read_bytes(f)
             self.metadata = meta
             self.codec = meta.get("avro.codec", b"null").decode("utf-8")
-            if self.codec not in ("null", "deflate"):
+            if self.codec not in ("null", "deflate", "snappy"):
                 raise ValueError(f"{path}: unsupported codec {self.codec!r}")
             self.schema = parse_schema(meta["avro.schema"].decode("utf-8"))
             self.sync = f.read(SYNC_SIZE)
@@ -298,6 +321,8 @@ class AvroContainerReader:
                     raise ValueError(f"{self.path}: bad sync marker")
                 if not skip_payload and self.codec == "deflate":
                     payload = zlib.decompress(payload, -15)
+                elif not skip_payload and self.codec == "snappy":
+                    payload = _snappy_block_uncompress(self.path, payload)
                 yield count, payload
 
     def __iter__(self) -> Iterator[dict]:
@@ -335,7 +360,7 @@ def write_avro(
     block_records: int = 4096,
 ) -> None:
     """Write one container file (fixture/test/model output path)."""
-    if codec not in ("null", "deflate"):
+    if codec not in ("null", "deflate", "snappy"):
         raise ValueError(f"unsupported codec {codec!r}")
     parsed = parse_schema(schema)
     schema_json = schema if isinstance(schema, str) else json.dumps(schema)
@@ -363,6 +388,12 @@ def write_avro(
             if codec == "deflate":
                 c = zlib.compressobj(6, zlib.DEFLATED, -15)
                 payload = c.compress(payload) + c.flush()
+            elif codec == "snappy":
+                from photon_tpu.data import snappy as _snappy
+
+                crc = zlib.crc32(payload) & 0xFFFFFFFF
+                payload = (_snappy.compress(payload)
+                           + struct.pack(">I", crc))
             _write_long(f, len(block))
             _write_long(f, len(payload))
             f.write(payload)

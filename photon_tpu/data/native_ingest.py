@@ -102,6 +102,36 @@ def compile_plan(schema, config: GameDataConfig):
     return ops, aux, vkinds, bag_names
 
 
+def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
+    """The decode_block plan tuple from a compiled schema plan — store s
+    consumes its shard's bags IN CONFIG ORDER (id-assignment parity with
+    build_index_map's `for bag in config.bags` loop). Shared by the
+    one-shot reader and data.streaming."""
+    ops, aux, vkinds, bag_names = plan0
+    sb_off, sb_idx = [0], []
+    for s in shard_names:
+        sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
+        sb_off.append(len(sb_idx))
+    return (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
+            np.asarray(vkinds or [0], np.int32),
+            np.asarray(sb_off, np.int32),
+            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
+
+
+def frozen_stores(config: GameDataConfig, index_maps: dict,
+                  shard_names) -> list:
+    """One native store per shard, preloaded from its FROZEN index map
+    (intercept excluded — it is appended as a COO column, not looked up)."""
+    stores = []
+    for s in shard_names:
+        imap = index_maps[s]
+        keys = imap.keys_in_order()
+        if imap.has_intercept:
+            keys = keys[:-1]
+        stores.append(native.NativeIndexStore.from_keys(keys))
+    return stores
+
+
 def read_game_data_native(
     path,
     config: GameDataConfig,
@@ -120,36 +150,18 @@ def read_game_data_native(
     plan0 = compile_plan(readers[0].schema, config)
     if plan0 is None:
         return None
-    ops, aux, vkinds, bag_names = plan0
-
     shard_names = list(config.shards)
     index_maps = dict(index_maps or {})
-    stores, build_flags = [], []
-    for s in shard_names:
-        imap = index_maps.get(s)
-        if imap is None:
-            stores.append(native.NativeIndexStore(capacity_hint=1024))
-            build_flags.append(True)
-        else:
-            keys = imap.keys_in_order()
-            if imap.has_intercept:
-                keys = keys[:-1]
-            stores.append(native.NativeIndexStore.from_keys(keys))
-            build_flags.append(False)
+    build_flags = [index_maps.get(s) is None for s in shard_names]
     if len(set(build_flags)) > 1:
         return None  # mixed build/frozen per call is not supported natively
     build_mode = build_flags[0] if build_flags else True
-
-    # Store s consumes its shard's bags IN CONFIG ORDER (id-assignment
-    # parity with build_index_map's `for bag in config.bags` loop).
-    sb_off, sb_idx = [0], []
-    for s in shard_names:
-        sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
-        sb_off.append(len(sb_idx))
-    plan = (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
-            np.asarray(vkinds or [0], np.int32),
-            np.asarray(sb_off, np.int32),
-            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
+    if build_mode:
+        stores = [native.NativeIndexStore(capacity_hint=1024)
+                  for _ in shard_names]
+    else:
+        stores = frozen_stores(config, index_maps, shard_names)
+    plan = build_decode_plan(plan0, config, shard_names)
 
     ys, offs, wts = [], [], []
     coos = [[] for _ in shard_names]

@@ -55,7 +55,8 @@ def scan_row_counts(path) -> list:
     return counts
 
 
-def _frozen_maps_or_raise(config: GameDataConfig, index_maps) -> dict:
+def _frozen_maps_or_raise(config: GameDataConfig, index_maps,
+                          sparse_k=None) -> dict:
     index_maps = dict(index_maps or {})
     missing = [s for s in config.shards if s not in index_maps]
     if missing:
@@ -64,6 +65,20 @@ def _frozen_maps_or_raise(config: GameDataConfig, index_maps) -> dict:
             f"(missing {missing}); run build_index_maps_streaming (or the "
             "FeatureIndexingDriver) first — ids cannot be assigned "
             "on-the-fly once early chunks have already been emitted")
+    unfrozen = [s for s in config.shards if not index_maps[s].frozen]
+    if unfrozen:
+        raise ValueError(
+            f"streaming ingestion needs FROZEN index maps; {unfrozen} are "
+            "mutable — fresh ids assigned mid-stream would shift column "
+            "meanings between chunks")
+    for s, cfg in config.shards.items():
+        if index_maps[s].n_features > cfg.dense_threshold and sparse_k is None:
+            raise ValueError(
+                f"shard {s!r} is sparse (d={index_maps[s].n_features} > "
+                f"dense_threshold={cfg.dense_threshold}): streaming needs a "
+                "fixed sparse_k so every chunk's SparseRows share one "
+                "nnz width (per-chunk max widths would make chunks "
+                "non-concatenable)")
     return index_maps
 
 
@@ -124,7 +139,9 @@ def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
     shard_names = list(config.shards)
     stores = [native.NativeIndexStore(capacity_hint=1024)
               for _ in shard_names]
-    plan = _decode_plan(plan0, config, shard_names)
+    from photon_tpu.data.native_ingest import build_decode_plan
+
+    plan = build_decode_plan(plan0, config, shard_names)
     for rd in readers:
         for count, payload in rd.blocks():
             dec = native.decode_block(payload, count, 0, plan, stores, True)
@@ -143,19 +160,6 @@ def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
     return out
 
 
-def _decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
-    """The decode_block plan tuple from a compiled schema plan (shared by
-    the map-build pass and the chunk stream; mirrors
-    native_ingest.read_game_data_native's store/bag wiring)."""
-    ops, aux, vkinds, bag_names = plan0
-    sb_off, sb_idx = [0], []
-    for s in shard_names:
-        sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
-        sb_off.append(len(sb_idx))
-    return (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
-            np.asarray(vkinds or [0], np.int32),
-            np.asarray(sb_off, np.int32),
-            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
 
 
 @dataclasses.dataclass
@@ -209,7 +213,7 @@ def iter_game_chunks(
     ≥ `chunk_rows` (except the last) and concatenation equals the one-shot
     read. `use_native` as in ingest.read_game_data.
     """
-    index_maps = _frozen_maps_or_raise(config, index_maps)
+    index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
     stream = ChunkStream(config, index_maps, chunk_rows, sparse_k)
     if use_native is not False:
         # Availability / plannability checked EAGERLY (before the first
@@ -271,15 +275,11 @@ def _native_chunks(path, stream: ChunkStream):
         if compile_plan(rd.schema, config) != plan0:
             return None  # schema drift across files: caller falls back
 
+    from photon_tpu.data.native_ingest import build_decode_plan, frozen_stores
+
     shard_names = list(config.shards)
-    stores = []
-    for s in shard_names:
-        imap = stream.index_maps[s]
-        keys = imap.keys_in_order()
-        if imap.has_intercept:
-            keys = keys[:-1]
-        stores.append(native.NativeIndexStore.from_keys(keys))
-    plan = _decode_plan(plan0, config, shard_names)
+    stores = frozen_stores(config, stream.index_maps, shard_names)
+    plan = build_decode_plan(plan0, config, shard_names)
 
     def generator():
         ys, offs, wts = [], [], []
@@ -392,7 +392,7 @@ def stream_to_device(
 
     from photon_tpu.data.matrix import SparseRows
 
-    index_maps = _frozen_maps_or_raise(config, index_maps)
+    index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
     n_real = sum(scan_row_counts(path))
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     from photon_tpu.parallel.mesh import pad_to_multiple
@@ -403,47 +403,41 @@ def stream_to_device(
                else [None])
 
     # Per-shard layout decided ONCE from the frozen maps (chunk-independent).
-    dense_shards = {}
-    for s, cfg in config.shards.items():
-        d = index_maps[s].n_features
-        if d > cfg.dense_threshold and sparse_k is None:
-            raise ValueError(
-                f"shard {s!r} is sparse (d={d} > dense_threshold="
-                f"{cfg.dense_threshold}): stream_to_device needs a fixed "
-                "sparse_k so per-device SparseRows shards share one shape")
-        dense_shards[s] = d <= cfg.dense_threshold
-
+    dense_shards = {s: index_maps[s].n_features <= cfg.dense_threshold
+                    for s, cfg in config.shards.items()}
     f_dtype = np.float32 if feature_dtype is None else feature_dtype
+    SCALARS = ("y", "weights", "offsets")
 
+    # Scalar columns and user-named shards live in SEPARATE namespaces —
+    # a shard literally named "y"/"weights"/"offsets" must not collide.
     def alloc_local():
-        buf = {
-            "y": np.zeros(n_local, np.float32),
-            "weights": np.zeros(n_local, np.float32),
-            "offsets": np.zeros(n_local, np.float32),
-        }
+        scal = {k: np.zeros(n_local, np.float32) for k in SCALARS}
+        mats = {}
         for s in config.shards:
             d = index_maps[s].n_features
             if dense_shards[s]:
-                buf[s] = np.zeros((n_local, d), f_dtype)
+                mats[s] = np.zeros((n_local, d), f_dtype)
             else:
-                buf[s] = (np.zeros((n_local, sparse_k), np.int32),
-                          np.zeros((n_local, sparse_k), f_dtype))
-        return buf
+                mats[s] = (np.zeros((n_local, sparse_k), np.int32),
+                           np.zeros((n_local, sparse_k), f_dtype))
+        return scal, mats
 
-    shard_parts: dict = {k: [] for k in ("y", "weights", "offsets",
-                                         *config.shards)}
+    scal_parts: dict = {k: [] for k in SCALARS}
+    mat_parts: dict = {s: [] for s in config.shards}
     entity_cols: dict = {e: [] for e in config.entity_fields}
 
     def ship(buf):
         """device_put one completed local shard onto its device."""
-        dev = devices[len(shard_parts["y"])] if mesh is not None else None
-        for key in shard_parts:
-            v = buf[key]
+        scal, mats = buf
+        dev = devices[len(scal_parts["y"])] if mesh is not None else None
+        for k in SCALARS:
+            scal_parts[k].append(jax.device_put(scal[k], dev))
+        for s, v in mats.items():
             if isinstance(v, tuple):
-                shard_parts[key].append(tuple(
-                    jax.device_put(a, dev) for a in v))
+                mat_parts[s].append(tuple(jax.device_put(a, dev)
+                                          for a in v))
             else:
-                shard_parts[key].append(jax.device_put(v, dev))
+                mat_parts[s].append(jax.device_put(v, dev))
 
     buf = alloc_local()
     filled = 0  # rows filled in the current local buffer
@@ -461,26 +455,27 @@ def stream_to_device(
         # ONE host materialization per chunk — inside the fill loop a chunk
         # straddling many device buffers would re-fetch the whole matrix
         # once per straddled shard (coo_to_matrix returns device arrays)
-        host = {"y": np.asarray(chunk.y),
-                "weights": np.asarray(chunk.weights),
-                "offsets": np.asarray(chunk.offsets)}
+        host_scal = {"y": np.asarray(chunk.y),
+                     "weights": np.asarray(chunk.weights),
+                     "offsets": np.asarray(chunk.offsets)}
+        host_mat = {}
         for s in config.shards:
             X = chunk.shards[s]
-            host[s] = (np.asarray(X) if dense_shards[s]
-                       else (np.asarray(X.indices), np.asarray(X.values)))
+            host_mat[s] = (np.asarray(X) if dense_shards[s]
+                           else (np.asarray(X.indices), np.asarray(X.values)))
         while c0 < n_c:
             take = min(n_c - c0, n_local - filled)
             sl = slice(c0, c0 + take)
             dst = slice(filled, filled + take)
-            buf["y"][dst] = host["y"][sl]
-            buf["weights"][dst] = host["weights"][sl]
-            buf["offsets"][dst] = host["offsets"][sl]
+            scal, mats = buf
+            for k in SCALARS:
+                scal[k][dst] = host_scal[k][sl]
             for s in config.shards:
                 if dense_shards[s]:
-                    buf[s][dst] = host[s][sl].astype(f_dtype)
+                    mats[s][dst] = host_mat[s][sl].astype(f_dtype)
                 else:
-                    ind, val = buf[s]
-                    h_ind, h_val = host[s]
+                    ind, val = mats[s]
+                    h_ind, h_val = host_mat[s]
                     k_c = h_ind.shape[1]
                     ind[dst, :k_c] = h_ind[sl]
                     val[dst, :k_c] = h_val[sl].astype(f_dtype)
@@ -491,19 +486,19 @@ def stream_to_device(
                 ship(buf)
                 buf = alloc_local() if row < n_real else None
                 filled = 0
-    if buf is not None and (filled or not shard_parts["y"]):
+    if buf is not None and (filled or not scal_parts["y"]):
         ship(buf)
 
     if mesh is not None:
         # pad the tail: remaining devices get all-zero (weight-0) shards
-        while len(shard_parts["y"]) < n_dev:
+        while len(scal_parts["y"]) < n_dev:
             ship(alloc_local())
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         axes = tuple(mesh.axis_names)
 
-        def assemble(parts, width=None):
+        def assemble(parts):
             if isinstance(parts[0], tuple):
                 return tuple(assemble([p[i] for p in parts])
                              for i in range(len(parts[0])))
@@ -511,15 +506,15 @@ def stream_to_device(
             spec = P(axes) if parts[0].ndim == 1 else P(axes, None)
             return jax.make_array_from_single_device_arrays(
                 shape, NamedSharding(mesh, spec), parts)
-
-        leaves = {k: assemble(v) for k, v in shard_parts.items()}
     else:
-        leaves = {k: (tuple(v[0]) if isinstance(v[0], tuple) else v[0])
-                  for k, v in shard_parts.items()}
+        def assemble(parts):
+            return (tuple(parts[0]) if isinstance(parts[0], tuple)
+                    else parts[0])
 
+    scalars = {k: assemble(v) for k, v in scal_parts.items()}
     shards = {}
     for s in config.shards:
-        v = leaves[s]
+        v = assemble(mat_parts[s])
         if dense_shards[s]:
             shards[s] = v
         else:
@@ -532,6 +527,6 @@ def stream_to_device(
         pad = np.full(n_pad - n_real, "", dtype=object)
         ids[e] = np.asarray([str(v) for v in np.concatenate([col, pad])])
 
-    data = GameData(leaves["y"], leaves["weights"], leaves["offsets"],
+    data = GameData(scalars["y"], scalars["weights"], scalars["offsets"],
                     shards, ids)
     return data, n_real

@@ -78,6 +78,12 @@ def _bind(lib) -> None:
     lib.ph_decoded_entity.argtypes = [vp, ctypes.c_int32, u8p, u64p]
     lib.ph_decoded_free.argtypes = [vp]
 
+    lib.ph_snappy_length.restype = ctypes.c_int64
+    lib.ph_snappy_length.argtypes = [u8p, ctypes.c_uint64]
+    lib.ph_snappy_uncompress.restype = ctypes.c_int32
+    lib.ph_snappy_uncompress.argtypes = [u8p, ctypes.c_uint64, u8p,
+                                         ctypes.c_uint64]
+
 
 def get_lib():
     """The loaded library, compiling it on first use; None if unavailable."""
@@ -105,6 +111,25 @@ def available() -> bool:
 
 def _as_u8p(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def snappy_uncompress(data: bytes) -> bytes:
+    """Raw snappy block decompression through the C++ runtime (the ingest
+    hot path; data.snappy is the pure-Python twin/fallback)."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("photon_tpu.native unavailable")
+    src = np.frombuffer(data, np.uint8)
+    n = int(lib.ph_snappy_length(_as_u8p(src), ctypes.c_uint64(len(data))))
+    if n < 0:
+        raise ValueError("snappy: malformed length varint")
+    dst = np.empty(n, np.uint8)
+    rc = int(lib.ph_snappy_uncompress(
+        _as_u8p(src), ctypes.c_uint64(len(data)), _as_u8p(dst),
+        ctypes.c_uint64(n)))
+    if rc != 0:
+        raise ValueError(f"snappy: malformed block (code {rc})")
+    return dst.tobytes()
 
 
 def pack_keys(keys) -> tuple[np.ndarray, np.ndarray]:

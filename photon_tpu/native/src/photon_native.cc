@@ -519,4 +519,84 @@ void ph_decoded_entity(void* h, int32_t e, uint8_t* arena,
 
 void ph_decoded_free(void* h) { delete static_cast<Decoded*>(h); }
 
+// ---------------------------------------------------------------- snappy
+// Raw Snappy block decompression (Avro "snappy" codec payloads; the pure-
+// Python twin is photon_tpu/data/snappy.py — tests pin byte parity).
+// Returns 0 on success; negative on malformed input.
+
+// Uncompressed length from the preamble varint; -1 if malformed.
+int64_t ph_snappy_length(const uint8_t* src, uint64_t src_len) {
+  uint64_t out = 0;
+  int shift = 0;
+  for (uint64_t p = 0; p < src_len && shift <= 35; ++p) {
+    out |= static_cast<uint64_t>(src[p] & 0x7F) << shift;
+    if (!(src[p] & 0x80)) return static_cast<int64_t>(out);
+    shift += 7;
+  }
+  return -1;
+}
+
+int32_t ph_snappy_uncompress(const uint8_t* src, uint64_t src_len,
+                             uint8_t* dst, uint64_t dst_len) {
+  uint64_t pos = 0, n = 0;
+  {  // preamble varint
+    int shift = 0;
+    for (;; ++pos) {
+      if (pos >= src_len || shift > 35) return -1;
+      n |= static_cast<uint64_t>(src[pos] & 0x7F) << shift;
+      if (!(src[pos] & 0x80)) { ++pos; break; }
+      shift += 7;
+    }
+  }
+  if (n != dst_len) return -2;
+  uint64_t w = 0;
+  while (pos < src_len) {
+    uint8_t tag = src[pos++];
+    uint32_t t = tag & 3;
+    if (t == 0) {  // literal
+      uint64_t len = tag >> 2;
+      if (len >= 60) {
+        uint32_t extra = static_cast<uint32_t>(len) - 59;
+        if (pos + extra > src_len) return -3;
+        len = 0;
+        for (uint32_t i = 0; i < extra; ++i)
+          len |= static_cast<uint64_t>(src[pos + i]) << (8 * i);
+        pos += extra;
+      }
+      ++len;
+      if (pos + len > src_len || w + len > n) return -3;
+      memcpy(dst + w, src + pos, len);
+      pos += len;
+      w += len;
+      continue;
+    }
+    uint64_t len, off;
+    if (t == 1) {
+      if (pos >= src_len) return -4;
+      len = ((tag >> 2) & 0x7) + 4;
+      off = (static_cast<uint64_t>(tag >> 5) << 8) | src[pos++];
+    } else if (t == 2) {
+      if (pos + 2 > src_len) return -4;
+      len = (tag >> 2) + 1;
+      off = src[pos] | (static_cast<uint64_t>(src[pos + 1]) << 8);
+      pos += 2;
+    } else {
+      if (pos + 4 > src_len) return -4;
+      len = (tag >> 2) + 1;
+      off = src[pos] | (static_cast<uint64_t>(src[pos + 1]) << 8) |
+            (static_cast<uint64_t>(src[pos + 2]) << 16) |
+            (static_cast<uint64_t>(src[pos + 3]) << 24);
+      pos += 4;
+    }
+    if (off == 0 || off > w || w + len > n) return -4;
+    if (off >= len) {
+      memcpy(dst + w, dst + (w - off), len);
+    } else {  // overlapping: the pattern repeats forward
+      for (uint64_t i = 0; i < len; ++i) dst[w + i] = dst[w - off + i];
+    }
+    w += len;
+  }
+  return w == n ? 0 : -5;
+}
+
 }  // extern "C"
