@@ -118,8 +118,7 @@ def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
             np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
 
 
-def frozen_stores(config: GameDataConfig, index_maps: dict,
-                  shard_names) -> list:
+def frozen_stores(index_maps: dict, shard_names) -> list:
     """One native store per shard, preloaded from its FROZEN index map
     (intercept excluded — it is appended as a COO column, not looked up)."""
     stores = []
@@ -160,7 +159,7 @@ def read_game_data_native(
         stores = [native.NativeIndexStore(capacity_hint=1024)
                   for _ in shard_names]
     else:
-        stores = frozen_stores(config, index_maps, shard_names)
+        stores = frozen_stores(index_maps, shard_names)
     plan = build_decode_plan(plan0, config, shard_names)
 
     ys, offs, wts = [], [], []

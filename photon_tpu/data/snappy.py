@@ -138,8 +138,7 @@ def compress(data: bytes) -> bytes:
     out = bytearray()
     n = len(data)
     pos = 0
-    while pos < n:
-        out += b""  # fragment boundary (no state carries over)
+    while pos < n:  # per-fragment: table/base reset, no state carries over
         frag_end = min(pos + _BLOCK, n)
         base = pos
         table: dict = {}

@@ -278,7 +278,7 @@ def _native_chunks(path, stream: ChunkStream):
     from photon_tpu.data.native_ingest import build_decode_plan, frozen_stores
 
     shard_names = list(config.shards)
-    stores = frozen_stores(config, stream.index_maps, shard_names)
+    stores = frozen_stores(stream.index_maps, shard_names)
     plan = build_decode_plan(plan0, config, shard_names)
 
     def generator():
