@@ -40,6 +40,10 @@ class CoordinateDescentResult:
 # handful of device dispatches (train, score, offsets, objective) — eager
 # per-primitive dispatch here dominated warm sweeps over remote-tunnel
 # links. The offsets sum is game.scoring._sum_scores (one shared jit cache).
+# On the COMMON path (no prior/projection/normalization, single device) the
+# whole update — offsets, solve, score, objective — fuses into ONE program
+# per coordinate (see _fused_fixed_update / RandomEffectCoordinate.
+# fused_update_program), ≤1 dispatch per update.
 from photon_tpu.game.scoring import _sum_scores  # noqa: E402
 
 
@@ -47,6 +51,44 @@ from photon_tpu.game.scoring import _sum_scores  # noqa: E402
 def _objective_at(task, y, weights, offsets, score):
     loss, _, _ = loss_fns(task)
     return jnp.sum(weights * loss(offsets + score, y))
+
+
+@partial(jax.jit, static_argnames=("config", "task", "variance"))
+def _fused_fixed_update(batch, base, scores, w0, obj, l1, y, weights,
+                        config, task, variance):
+    """One program per fixed-effect update: offsets sum + solve + margins +
+    objective (the grid path's _fixed_grid_update, lane-less). The
+    objective uses the CALLER's y/weights (coordinate_descent's arguments,
+    like _objective_at on the unfused path), which may differ from the
+    dataset's."""
+    from photon_tpu.data.matrix import matvec
+    from photon_tpu.game.scoring import _sum_scores
+    from photon_tpu.models.training import solve
+    from photon_tpu.models.variance import compute_variances
+
+    loss, _, _ = loss_fns(task)
+    offs = _sum_scores(base, scores)
+    b = batch._replace(offsets=offs)
+    res = solve(obj, b, w0, config, l1_weight=l1)
+    var = compute_variances(obj, res.w, b, variance)
+    margin = matvec(batch.X, res.w)
+    objective = jnp.sum(weights * loss(offs + margin, y))
+    return res, var, margin, objective
+
+
+def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
+    from photon_tpu.data.matrix import ShardedHybridRows
+    from photon_tpu.optim.config import OptimizerType
+
+    return (prior is None and coord.mesh is None
+            and not isinstance(coord.dataset.X, ShardedHybridRows)
+            and (coord.normalization is None
+                 or coord.normalization.is_identity)
+            # OWL-QN keeps the train_glm route: its single-device dense
+            # solves use the pallas fused value+grad kernel (one X pass per
+            # evaluation), which this fused program does not wire up
+            and coord.config.effective_optimizer()
+            is not OptimizerType.OWLQN)
 
 
 def coordinate_descent(
@@ -102,16 +144,83 @@ def coordinate_descent(
     objective_history: list = []
     coordinate_stats: dict = {name: [] for name in update_sequence}
 
+    from photon_tpu.game.dataset import GLMBatch
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.models.training import (
+        _l1_lam,
+        _static_config,
+        make_objective,
+    )
+
+    deferred_re: list = []  # (stats-list index slot fillers for fused REs)
     for _ in range(n_sweeps):
         for name in update_sequence:
             if name in locked:
                 continue
             coord = coordinates[name]
-            offsets_full = _sum_scores(
-                base, tuple(s for o, s in scores.items() if o != name))
-            model, stats = coord.train(offsets_full,
-                                       warm_start=models.get(name),
-                                       prior=priors.get(name))
+            warm = models.get(name)
+            prior = priors.get(name)
+            others = tuple(s for o, s in scores.items() if o != name)
+
+            if (isinstance(coord, FixedEffectCoordinate)
+                    and _fixed_fusable(coord, prior)):
+                ds = coord.dataset
+                w0 = jnp.zeros((ds.dim,), jnp.float32)
+                if warm is not None and \
+                        warm.model.weights.shape[0] == ds.dim:
+                    w0 = jnp.asarray(warm.model.weights)
+                batch = GLMBatch(ds.X, ds.y, ds.weights, base)
+                obj = make_objective(task, coord.config, ds.dim)
+                res, var, margin, objective = _fused_fixed_update(
+                    batch, base, others, w0, obj, _l1_lam(coord.config),
+                    y, weights, _static_config(coord.config), task,
+                    coord.variance)
+                models[name] = FixedEffectModel(
+                    GeneralizedLinearModel(Coefficients(res.w, var), task),
+                    ds.shard_name)
+                scores[name] = margin
+                coordinate_stats[name].append(res)
+                objective_history.append(objective)
+                continue
+
+            fused = (coord.fused_update_program()
+                     if isinstance(coord, RandomEffectCoordinate)
+                     and prior is None else None)
+            if fused is not None:
+                fn, blocks_args, obj, lam = fused
+                ds = coord.dataset
+                E, d = ds.n_entities, ds.dim
+                coeffs0 = (jnp.asarray(warm.coefficients)
+                           if warm is not None
+                           and warm.coefficients.shape == (E, d)
+                           else jnp.zeros((E, d), jnp.float32))
+                coeffs, variances, margin, objective, st = fn(
+                    coeffs0, base, others, obj, lam, blocks_args, ds.X,
+                    jnp.asarray(ds.entity_dense), y, weights)
+                models[name] = RandomEffectModel(
+                    entity_name=ds.entity_name,
+                    feature_shard=ds.shard_name,
+                    task=task,
+                    coefficients=coeffs,
+                    entity_keys=ds.entity_keys,
+                    key_to_index=ds.key_to_index,
+                    variances=variances,
+                )
+                scores[name] = margin
+                # device scalars; finalized into RETrainStats below
+                slot = len(coordinate_stats[name])
+                coordinate_stats[name].append(None)
+                deferred_re.append((name, slot, E, st))
+                objective_history.append(objective)
+                continue
+
+            offsets_full = _sum_scores(base, others)
+            model, stats = coord.train(offsets_full, warm_start=warm,
+                                       prior=prior)
             models[name] = model
             scores[name] = coord.score(model)
             coordinate_stats[name].append(stats)
@@ -122,7 +231,14 @@ def coordinate_descent(
 
     # one concurrent device_get for every deferred scalar (a float() per
     # entry would pay one tunnel round-trip each)
-    objective_history = [float(v) for v in jax.device_get(objective_history)]
+    objective_history, re_stats = jax.device_get(
+        (objective_history, [st for *_, st in deferred_re]))
+    objective_history = [float(v) for v in objective_history]
+    from photon_tpu.game.random_effect import RETrainStats
+
+    for (name, slot, E, _), (c, f, it) in zip(deferred_re, re_stats):
+        coordinate_stats[name][slot] = RETrainStats(E, int(c), int(f),
+                                                    int(it))
     ordered = {name: models[name] for name in update_sequence}
     for name in coordinates:  # score-only coordinates outside the sequence
         if name in models and name not in ordered:
