@@ -85,20 +85,58 @@ class GaussianProcess:
     noise: float
     kernel_name: str = "matern52"
 
+    def _query(self, Xq) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(standardized-space posterior mean, whitened cross-solve v) at
+        query points — the shared core of predict and sample_joint."""
+        kern = KERNELS[self.kernel_name]
+        Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
+                  self.amplitude, self.inv_lengthscales)
+        v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
+        return Kq @ self.alpha, v
+
     def predict(self, Xq) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Posterior mean and stddev at query points (n_q, d)."""
         cpu = _host_cpu()
         with jax.default_device(cpu) if cpu is not None else nullcontext():
-            kern = KERNELS[self.kernel_name]
-            Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
-                      self.amplitude, self.inv_lengthscales)
-            mean = Kq @ self.alpha
-            v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
+            mean, v = self._query(Xq)
             var = jnp.maximum(
                 self.amplitude + self.noise - jnp.sum(v * v, axis=0), JITTER
             )
             return (mean * self.y_std + self.y_mean,
                     jnp.sqrt(var) * self.y_std)
+
+    def sample_joint(self, Xq, n_samples: int, seed: int = 0) -> np.ndarray:
+        """(n_samples, n_q) JOINT posterior draws at the query points —
+        the fantasies behind true q-EI (acquisition.qei_*): correlations
+        between query points are carried exactly (full posterior
+        covariance, one Cholesky), where the constant-liar heuristic
+        pretends each pick resolved to a point value.
+
+        Draws are PREDICTIVE (the fitted observation noise is on the
+        diagonal), matching predict()'s variance — so single-point MC q-EI
+        converges to the closed-form EI (pinned by tests)."""
+        cpu = _host_cpu()
+        with jax.default_device(cpu) if cpu is not None else nullcontext():
+            Xq = jnp.asarray(np.asarray(Xq, np.float32))
+            kern = KERNELS[self.kernel_name]
+            mean, v = self._query(Xq)
+            C = (kern(Xq, Xq, self.amplitude, self.inv_lengthscales)
+                 - v.T @ v)
+            C = C + (self.noise + JITTER) * jnp.eye(Xq.shape[0])
+            Lc = jnp.linalg.cholesky(C)
+            z = np.random.default_rng(seed).standard_normal(
+                (n_samples, Xq.shape[0])).astype(np.float32)
+            Z = np.asarray(mean)[None, :] + z @ np.asarray(Lc).T
+            if not np.isfinite(Z).all():
+                # f32 round-off can push the pool covariance past the
+                # jitter into non-PSD; cholesky then yields silent NaNs.
+                # Degrade to INDEPENDENT predictive draws (exact marginals,
+                # no cross-candidate correlation) rather than hand
+                # downstream argmaxes an all-NaN array.
+                mean_p, std_p = self.predict(Xq)
+                return (np.asarray(mean_p)[None, :]
+                        + z * np.asarray(std_p)[None, :])
+            return Z * self.y_std + self.y_mean
 
 
 def _nll_builder(X, y, kernel_name):

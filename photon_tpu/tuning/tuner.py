@@ -45,6 +45,7 @@ def tune(
     initial_observations: Optional[Sequence[tuple]] = None,
     batch_size: int = 1,
     evaluate_batch: Optional[Callable[[np.ndarray], Sequence[float]]] = None,
+    batch_method: str = "qei",
 ) -> TuningResult:
     """Minimize `evaluate` over `space` (reference: HyperparameterTuner.tune).
 
@@ -53,14 +54,18 @@ def tune(
     initial_observations: optional [(x_original, y)] to warm-start the GP
     (the reference seeds from prior runs' observations).
 
-    batch_size > 1 proposes that many candidates per GP round via the
-    constant-liar heuristic (each pick is fantasized at the incumbent best
-    before the next pick) and hands them to `evaluate_batch` TOGETHER —
-    the hook for evaluators that amortize a whole batch into one device
-    program (models.training.train_glm_grid; see `tune_glm_reg`). The
-    reference evaluates strictly one candidate per round. When
-    `evaluate_batch` is None, candidates are evaluated by looping
-    `evaluate`.
+    batch_size > 1 proposes that many candidates per GP round and hands
+    them to `evaluate_batch` TOGETHER — the hook for evaluators that
+    amortize a whole batch into one device program
+    (models.training.train_glm_grid; see `tune_glm_reg`). The reference
+    evaluates strictly one candidate per round. When `evaluate_batch` is
+    None, candidates are evaluated by looping `evaluate`.
+
+    batch_method: "qei" (default) selects each round's batch by TRUE joint
+    q-EI — greedy maximization of the Monte-Carlo batch improvement over
+    shared joint posterior fantasies (acquisition.qei_greedy); "liar" is
+    the constant-liar heuristic (each pick fantasized at the incumbent
+    best, GP refitted between picks) kept for comparison.
     """
     if n_iters < 1:
         raise ValueError("n_iters must be >= 1")
@@ -90,6 +95,10 @@ def tune(
         for i in range(0, len(pool), batch_size):
             run_batch(list(pool[i:i + batch_size]))
     elif method == "gp":
+        if batch_method not in ("qei", "liar"):
+            raise ValueError(f"unknown batch_method {batch_method!r}")
+        from photon_tpu.tuning.acquisition import qei_greedy
+
         n_seed = min(max(n_seed, 2), n_iters)
         run_batch(list(candidates(space, n_seed, "sobol", seed=seed)))
         done, it = n_seed, 0
@@ -97,19 +106,31 @@ def tune(
             q = min(batch_size, n_iters - done)
             pool = candidates(space, n_candidates, "sobol",
                               seed=seed + 1000 + it)
-            Xf, Yf = list(xs_unit), list(ys)
-            lie = float(np.min(ys))
-            picks: list = []
-            for _ in range(q):
-                gp = fit_gp(np.asarray(Xf, np.float32), np.asarray(Yf),
-                            kernel)
-                ei = np.asarray(expected_improvement(
-                    gp, pool.astype(np.float32), lie))
-                idx = int(np.argmax(ei))
-                picks.append(pool[idx])
-                Xf.append(pool[idx])
-                Yf.append(lie)  # constant liar: fantasize at the incumbent
-                pool = np.delete(pool, idx, axis=0)
+            best = float(np.min(ys))
+            if q > 1 and batch_method == "liar":
+                Xf, Yf = list(xs_unit), list(ys)
+                picks: list = []
+                for _ in range(q):
+                    gp = fit_gp(np.asarray(Xf, np.float32),
+                                np.asarray(Yf), kernel)
+                    ei = np.asarray(expected_improvement(
+                        gp, pool.astype(np.float32), best))
+                    idx = int(np.argmax(ei))
+                    picks.append(pool[idx])
+                    Xf.append(pool[idx])
+                    Yf.append(best)  # the lie: fantasize at the incumbent
+                    pool = np.delete(pool, idx, axis=0)
+            else:
+                gp = fit_gp(np.asarray(xs_unit, np.float32),
+                            np.asarray(ys), kernel)
+                if q == 1:
+                    ei = np.asarray(expected_improvement(
+                        gp, pool.astype(np.float32), best))
+                    picks = [pool[int(np.argmax(ei))]]
+                else:  # true joint q-EI over shared fantasies
+                    idx = qei_greedy(gp, pool.astype(np.float32), best, q,
+                                     seed=seed + 2000 + it)
+                    picks = [pool[i] for i in idx]
             run_batch(picks)
             done += q
             it += 1
