@@ -103,7 +103,8 @@ def tune(
         run_batch(list(candidates(space, n_seed, "sobol", seed=seed)))
         done, it = n_seed, 0
         while done < n_iters:
-            q = min(batch_size, n_iters - done)
+            # a round can never pick more points than the pool holds
+            q = min(batch_size, n_iters - done, n_candidates)
             pool = candidates(space, n_candidates, "sobol",
                               seed=seed + 1000 + it)
             best = float(np.min(ys))
@@ -132,7 +133,7 @@ def tune(
                                      seed=seed + 2000 + it)
                     picks = [pool[i] for i in idx]
             run_batch(picks)
-            done += q
+            done += len(picks)
             it += 1
     else:
         raise ValueError(f"unknown tuning method {method!r}")
