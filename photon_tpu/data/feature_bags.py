@@ -107,13 +107,19 @@ def build_design_matrix(
 
 def coo_to_matrix(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
                   n: int, d: int, dense_threshold: int,
-                  k: Optional[int] = None) -> Matrix:
+                  k: Optional[int] = None, host: bool = False) -> Matrix:
     """COO triples → dense (n, d) or padded-COO SparseRows (duplicates
-    summed). Shared by the Python and native ingestion paths."""
+    summed). Shared by the Python and native ingestion paths.
+
+    `host=True` keeps the result in host numpy (numpy-backed SparseRows) —
+    the streaming chunk assemblers use it so a chunk never round-trips
+    through the device (stream_to_device copies chunks into per-device
+    host buffers; a device-resident chunk would transfer twice over the
+    tunnel and be read straight back)."""
     if d <= dense_threshold:
         X = np.zeros((n, d), np.float32)
         np.add.at(X, (rows, cols), vals)
-        return jnp.asarray(X)
+        return X if host else jnp.asarray(X)
 
     import scipy.sparse as sp
 
@@ -121,7 +127,7 @@ def coo_to_matrix(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     csr.sum_duplicates()
     from photon_tpu.data.matrix import from_scipy_csr
 
-    return from_scipy_csr(csr, k=k)
+    return from_scipy_csr(csr, k=k, host=host)
 
 
 def build_shard(

@@ -92,6 +92,7 @@ def records_to_game_data(
     config: GameDataConfig,
     index_maps: Optional[dict] = None,
     sparse_k: Optional[int] = None,
+    host: bool = False,
 ) -> tuple[GameData, dict]:
     """Decoded Avro records → (GameData, per-shard IndexMaps).
 
@@ -195,7 +196,7 @@ def records_to_game_data(
         shards[shard_name] = coo_to_matrix(rows, cols, vv, n,
                                            imap.n_features,
                                            shard_cfg.dense_threshold,
-                                           k=sparse_k)
+                                           k=sparse_k, host=host)
 
     return GameData(y, weights, offsets, shards, ids), index_maps
 

@@ -264,7 +264,7 @@ def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
     )
 
 
-def from_scipy_csr(csr, k: int | None = None) -> SparseRows:
+def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
     """Pad a scipy CSR matrix to fixed nnz-per-row (fully vectorized —
     no per-row Python loop, so billion-row ingestion is numpy-bound).
 
@@ -300,6 +300,8 @@ def from_scipy_csr(csr, k: int | None = None) -> SparseRows:
     values = np.zeros((n, k), np.float32)
     indices[row[keep], pos[keep]] = col[keep]
     values[row[keep], pos[keep]] = dat[keep]
+    if host:  # numpy-backed (streaming chunks: no device round-trip)
+        return SparseRows(indices, values, d)
     return SparseRows(jnp.asarray(indices), jnp.asarray(values), d)
 
 

@@ -239,7 +239,7 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
 
     def flush():
         data, _ = records_to_game_data(buf, stream.config, stream.index_maps,
-                                       stream.sparse_k)
+                                       stream.sparse_k, host=True)
         # the record buffer and the assembled chunk coexist briefly
         stream._note(2 * _chunk_nbytes(data))
         buf.clear()
@@ -311,7 +311,7 @@ def _native_chunks(path, stream: ChunkStream):
                 shards[s] = coo_to_matrix(rows, cols, vals, n,
                                           imap.n_features,
                                           cfg.dense_threshold,
-                                          k=stream.sparse_k)
+                                          k=stream.sparse_k, host=True)
             ids = {}
             for e_i, e in enumerate(config.entity_fields):
                 col = np.concatenate(ents[e_i])
@@ -370,8 +370,14 @@ def stream_to_device(
     sparse_k: Optional[int] = None,
     use_native: Optional[bool] = None,
     feature_dtype=None,
+    chunk_hook=None,
+    n_rows: Optional[int] = None,
 ) -> tuple[GameData, int]:
     """Stream a dataset STRAIGHT into its device placement.
+
+    `n_rows`: the dataset's total row count, when the caller already ran
+    `scan_row_counts` (the training driver's auto-streaming check does) —
+    skips a second pass over every container-block header.
 
     With a mesh: rows are contiguously sharded over all mesh axes; per
     device a preallocated host buffer of exactly one shard fills from the
@@ -384,6 +390,11 @@ def stream_to_device(
     arrive — the storage-dtype path of data.dataset.cast_features without a
     full-size intermediate.
 
+    `chunk_hook(chunk)` runs on every GameData chunk BEFORE it fills device
+    buffers — the bounded-memory seam for per-chunk validation and
+    mergeable statistics (the drivers validate and summarize here instead
+    of reading the assembled dataset back off device).
+
     Returns (GameData with device-resident y/weights/offsets/shards, n_real)
     — entity ids stay host-side numpy (they factorize on host). n_real is
     the unpadded row count.
@@ -393,7 +404,7 @@ def stream_to_device(
     from photon_tpu.data.matrix import SparseRows
 
     index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
-    n_real = sum(scan_row_counts(path))
+    n_real = sum(scan_row_counts(path)) if n_rows is None else int(n_rows)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     from photon_tpu.parallel.mesh import pad_to_multiple
 
@@ -448,13 +459,15 @@ def stream_to_device(
                                       sparse_k=sparse_k,
                                       use_native=use_native)
     for chunk in chunks:
+        if chunk_hook is not None:
+            chunk_hook(chunk)
         c0 = 0
         n_c = chunk.n
         for e in config.entity_fields:
             entity_cols[e].append(np.asarray(chunk.entity_ids[e]))
-        # ONE host materialization per chunk — inside the fill loop a chunk
-        # straddling many device buffers would re-fetch the whole matrix
-        # once per straddled shard (coo_to_matrix returns device arrays)
+        # Chunks are host numpy end to end (the assemblers build with
+        # coo_to_matrix(host=True)), so these np.asarray calls are no-ops —
+        # kept as a type normalization for any GameData-shaped source.
         host_scal = {"y": np.asarray(chunk.y),
                      "weights": np.asarray(chunk.weights),
                      "offsets": np.asarray(chunk.offsets)}
@@ -522,10 +535,14 @@ def stream_to_device(
 
     ids = {}
     for e in config.entity_fields:
-        col = (np.concatenate(entity_cols[e]) if entity_cols[e]
-               else np.zeros(0, object))
-        pad = np.full(n_pad - n_real, "", dtype=object)
-        ids[e] = np.asarray([str(v) for v in np.concatenate([col, pad])])
+        # chunk producers already emit str ndarrays; concatenate promotes
+        # to the widest str dtype, no per-row Python loop (this runs over
+        # the FULL row count — the one place a Python walk would cost
+        # minutes in the 1B-row regime)
+        cols = entity_cols[e] or [np.zeros(0, dtype="U1")]
+        if n_pad > n_real:
+            cols = cols + [np.full(n_pad - n_real, "", dtype="U1")]
+        ids[e] = np.concatenate([np.asarray(c, dtype=np.str_) for c in cols])
 
     data = GameData(scalars["y"], scalars["weights"], scalars["offsets"],
                     shards, ids)

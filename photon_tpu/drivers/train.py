@@ -116,6 +116,21 @@ class TrainingParams:
     variance_type: str = "none"
     down_sampling_rate: Optional[float] = None  # binary tasks: negatives only
     sparse_k: Optional[int] = None
+    # Streaming ingestion (reference: AvroDataReader reads partitioned data
+    # through Spark and never materializes the dataset on one host).
+    # Tri-state: None auto-enables when the container-block headers count
+    # more than `streaming_threshold_rows` rows; True forces it; False keeps
+    # the one-shot reader. Streaming needs frozen index maps (built in one
+    # bounded pass, or prebuilt via index_map_dir), validates + summarizes
+    # chunk by chunk, lands data straight into its device placement, and
+    # expresses down-sampling as weight-0 rows (identical weighted
+    # objective; the row count is unchanged).
+    streaming: Optional[bool] = None
+    streaming_threshold_rows: int = 2_000_000
+    streaming_chunk_rows: int = 65536
+    # Storage dtype for streamed feature values (e.g. "bfloat16" halves the
+    # HBM footprint of big shards; compute stays f32). None keeps float32.
+    streaming_feature_dtype: Optional[str] = None
     # Directory of prebuilt frozen index maps (the indexing driver's
     # output; reference: consuming FeatureIndexingJob's PalDB maps).
     # Features absent from the maps — e.g. pruned by min_count — are
@@ -209,11 +224,19 @@ class TrainingOutput:
     n_resumed: int = 0
 
 
+def _binary_task(task: TaskType) -> bool:
+    """Tasks that get the negatives-only down-sampler (reference:
+    BinaryClassificationDownSampler vs DefaultDownSampler dispatch) — ONE
+    site, shared by the row-dropping and weight-form paths so the
+    streaming tri-state can never flip the sampler family."""
+    return task in (TaskType.LOGISTIC_REGRESSION,
+                    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+
+
 def _apply_down_sampling(data: GameData, task: TaskType, rate: float,
                          seed: int) -> GameData:
     """Reference: the driver's DownSampler applied to training data."""
-    if task in (TaskType.LOGISTIC_REGRESSION,
-                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+    if _binary_task(task):
         idx, w = binary_down_sample(data.y, rate, data.weights, seed)
     else:
         idx, w = default_down_sample(data.n, rate, data.weights, seed)
@@ -250,6 +273,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
     log = photon_logger("photon_tpu.train", params.output_dir)
     timers = PhaseTimers()
     task = TaskType[params.task]
+    mode = DataValidationType(params.data_validation)
 
     with timers("read"):
         data_cfg = GameDataConfig(
@@ -261,29 +285,46 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
             prebuilt_maps = load_index_map_dir(params.index_map_dir,
                                                params.feature_shards)
-        data, index_maps = read_game_data(
-            params.train_path, data_cfg, index_maps=prebuilt_maps,
-            sparse_k=params.sparse_k)
-        validation = None
-        if params.validation_path:
-            validation, _ = read_game_data(
-                params.validation_path, data_cfg, index_maps=index_maps,
+        n_train_rows = None
+        streaming = params.streaming
+        if streaming is None:
+            # resolved into a LOCAL, not written back: the caller's config
+            # object stays a reusable tri-state (a stored False would stick
+            # to the next, bigger job it gets reused for)
+            from photon_tpu.data.streaming import scan_row_counts
+
+            n_train_rows = sum(scan_row_counts(params.train_path))
+            streaming = n_train_rows > params.streaming_threshold_rows
+        stream_stats: dict = {}
+        if streaming:
+            data, validation, index_maps, stream_stats, n_real = \
+                _read_streaming(params, data_cfg, task, mode, prebuilt_maps,
+                                mesh, n_train_rows)
+            log.info("streamed %d training rows (%d with padding), "
+                     "%d shards", n_real, data.n, len(data.shards))
+        else:
+            data, index_maps = read_game_data(
+                params.train_path, data_cfg, index_maps=prebuilt_maps,
                 sparse_k=params.sparse_k)
-    log.info("read %d training rows, %d shards", data.n, len(data.shards))
+            validation = None
+            if params.validation_path:
+                validation, _ = read_game_data(
+                    params.validation_path, data_cfg, index_maps=index_maps,
+                    sparse_k=params.sparse_k)
+            log.info("read %d training rows, %d shards", data.n,
+                     len(data.shards))
 
     with timers("validate"):
-        mode = DataValidationType(params.data_validation)
-        validate_game_data(data, task, mode)
-        if validation is not None:
-            validate_game_data(validation, task, mode)
+        # streaming already validated every chunk inside the read pass
+        if not streaming:
+            validate_game_data(data, task, mode)
+            if validation is not None:
+                validate_game_data(validation, task, mode)
 
-    if params.down_sampling_rate is not None:
-        with timers("down_sample"):
-            n0 = data.n
-            data = _apply_down_sampling(
-                data, task, params.down_sampling_rate, params.seed)
-            log.info("down-sampled %d -> %d rows", n0, data.n)
-
+    # Summaries and normalization are computed BEFORE down-sampling in
+    # BOTH read modes: statistics describe the dataset, down-sampling is
+    # a training trick — and the trained model must not change when the
+    # auto-streaming tri-state flips as the data grows.
     summaries = {}
     if params.summarization_output_dir is not None:
         from photon_tpu.data.statistics import FeatureSummary
@@ -294,11 +335,17 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         os.makedirs(summary_dir, exist_ok=True)
         with timers("summarize"):
             for shard_name in params.feature_shards:
-                s = FeatureSummary.compute(data.shards[shard_name])
+                # streaming merged chunk summaries during the read pass
+                s = (stream_stats[shard_name]
+                     if shard_name in stream_stats
+                     else FeatureSummary.compute(data.shards[shard_name]))
                 s.save(os.path.join(summary_dir, f"{shard_name}.json"))
                 summaries[shard_name] = s
         log.info("wrote feature summaries for %d shards to %s",
                  len(summaries), summary_dir)
+    elif stream_stats:
+        # normalization-only stats (no summary files requested)
+        summaries = dict(stream_stats)
 
     norm_type = NormalizationType(params.normalization)
     normalization = {}
@@ -321,6 +368,34 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                 normalization[name] = NormalizationContext.build(
                     data.shards[spec.feature_shard], norm_type,
                     intercept_index=icpt)
+
+    if params.down_sampling_rate is not None:
+        with timers("down_sample"):
+            if streaming:
+                # device-resident data: dropped rows become weight-0 rows
+                # (identical weighted objective; rows are not re-indexed,
+                # and RandomEffectDataset never lets a weight-0 row into a
+                # capped active set or train a zero-weight entity)
+                from photon_tpu.data.sampling import down_sample_weights
+
+                import jax
+
+                binary = _binary_task(task)
+                new_w = down_sample_weights(
+                    np.asarray(data.y), params.down_sampling_rate,
+                    np.asarray(data.weights), params.seed, binary=binary)
+                n_kept = int((new_w > 0).sum())
+                new_w = jax.device_put(new_w, data.weights.sharding) \
+                    if hasattr(data.weights, "sharding") else new_w
+                data = GameData(data.y, new_w, data.offsets, data.shards,
+                                data.entity_ids)
+                log.info("down-sampled to %d weight-carrying rows of %d",
+                         n_kept, data.n)
+            else:
+                n0 = data.n
+                data = _apply_down_sampling(
+                    data, task, params.down_sampling_rate, params.seed)
+                log.info("down-sampled %d -> %d rows", n0, data.n)
 
     initial_models = None
     if params.initial_model_dir:
@@ -362,7 +437,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         elif params.resume:
             results, n_resumed = _fit_grid_resumable(
                 estimator, params, data, validation, initial_models,
-                index_maps, log)
+                index_maps, log, streaming)
         else:
             results = estimator.fit(
                 data, validation=validation,
@@ -401,7 +476,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         if params.output_mode.upper() == "ALL":
             models_dir = os.path.join(params.output_dir, "models")
             os.makedirs(models_dir, exist_ok=True)
-            gsig = _global_signature(params)
+            gsig = _global_signature(params, streaming)
             manifest = []
             sigs = _point_signatures(gsig, [r.configs for r in results])
             # Skip rewriting only points the CURRENT resume run persisted or
@@ -446,7 +521,64 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                           n_resumed=n_resumed)
 
 
-def _global_signature(params: TrainingParams) -> str:
+def _read_streaming(params: TrainingParams, data_cfg: GameDataConfig,
+                    task: TaskType, mode: DataValidationType,
+                    prebuilt_maps, mesh, n_train_rows=None):
+    """Bounded-host-memory read (reference: AvroDataReader + the training
+    driver never materialize the dataset on one host): frozen index maps
+    from one block-stream pass, then chunks land straight into their device
+    placement, with per-chunk validation and mergeable summary statistics
+    folded into the same pass — nothing dataset-sized ever lives on host.
+
+    Statistics are collected on the PRE-padding chunks, so means/variances
+    are exact over the real rows even when the mesh pads the row count."""
+    import jax.numpy as jnp
+
+    from photon_tpu.data.statistics import FeatureSummary
+    from photon_tpu.data.streaming import (
+        build_index_maps_streaming,
+        stream_to_device,
+    )
+
+    index_maps = build_index_maps_streaming(
+        params.train_path, data_cfg, prebuilt_maps)
+
+    need_stats = set()
+    if params.summarization_output_dir is not None:
+        need_stats |= set(params.feature_shards)
+    if NormalizationType(params.normalization) is not NormalizationType.NONE:
+        need_stats |= {s.feature_shard for s in params.coordinates.values()}
+
+    stats: dict = {}
+
+    def make_hook(collect_stats: bool):
+        def hook(chunk):
+            validate_game_data(chunk, task, mode)
+            if collect_stats:
+                for s in need_stats:
+                    # host pass: chunk heights vary with block boundaries,
+                    # so the jitted kernels would retrace per chunk shape
+                    cs = FeatureSummary.compute_host(chunk.shards[s])
+                    stats[s] = cs if s not in stats else stats[s].merge(cs)
+        return hook
+
+    f_dtype = (None if params.streaming_feature_dtype is None
+               else getattr(jnp, params.streaming_feature_dtype))
+    data, n_real = stream_to_device(
+        params.train_path, data_cfg, index_maps, mesh=mesh,
+        chunk_rows=params.streaming_chunk_rows, sparse_k=params.sparse_k,
+        feature_dtype=f_dtype, chunk_hook=make_hook(bool(need_stats)),
+        n_rows=n_train_rows)
+    validation = None
+    if params.validation_path:
+        validation, _ = stream_to_device(
+            params.validation_path, data_cfg, index_maps, mesh=mesh,
+            chunk_rows=params.streaming_chunk_rows, sparse_k=params.sparse_k,
+            feature_dtype=f_dtype, chunk_hook=make_hook(False))
+    return data, validation, index_maps, stats, n_real
+
+
+def _global_signature(params: TrainingParams, streaming: bool) -> str:
     """Every training-wide knob that changes what a grid point's model
     means: data, sweeps, normalization, sampling, warm-start mode, …
     Baked into each point's signature so resume can never hand back a
@@ -471,6 +603,11 @@ def _global_signature(params: TrainingParams) -> str:
         tuple(sorted(
             (k, tuple(v.bags), v.has_intercept, v.dense_threshold)
             for k, v in params.feature_shards.items())),
+        # streaming knobs that change the trained model: the storage dtype
+        # casts features, and down-sampling switches to its weight-0 form.
+        # `streaming` is the RESOLVED mode (the same train_path resolves
+        # the same way every run, so resume stays stable).
+        bool(streaming), params.streaming_feature_dtype,
     ))
 
 
@@ -537,7 +674,8 @@ def _write_manifest(path: str, rows: list) -> None:
 
 
 def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
-                        data, validation, initial_models, index_maps, log):
+                        data, validation, initial_models, index_maps, log,
+                        streaming: bool = False):
     """Fit the grid one point at a time, CHECKPOINTING each point the
     moment it finishes, and loading points a previous (possibly died) run
     already completed. Warm starts chain through loaded models exactly as
@@ -566,7 +704,7 @@ def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
         {n: s.coordinate_config() for n, s in params.coordinates.items()}
     ]
     base = {n: s.coordinate_config() for n, s in params.coordinates.items()}
-    gsig = _global_signature(params)
+    gsig = _global_signature(params, streaming)
     sigs = _point_signatures(gsig, [{**base, **ov} for ov in grid])
     if (not any(s in completed for s in sigs)
             and estimator.would_vectorize(grid, initial_models, data=data)):

@@ -259,19 +259,51 @@ class RandomEffectDataset:
         entity_dense = entity_dense.astype(np.int32)
         n = data.n
         E = keys.shape[0]
+        w_np = np.asarray(data.weights, np.float32)
 
-        # Group rows by entity: stable sort keeps original row order per entity.
+        # Entities with NO weight-carrying rows (mesh padding's ""-id tail,
+        # streamed down-sampling that zeroed a whole entity) are dropped
+        # from training: the row-dropping form would never have seen them,
+        # and an all-weight-0 entity trains to the regularized zero anyway.
+        # Their rows keep dense id E, the unseen-entity convention — every
+        # scorer gathers the appended zero row for them.
+        carrying = np.bincount(
+            entity_dense, weights=(w_np != 0.0).astype(np.float64),
+            minlength=E) > 0
+        if carrying.any() and not carrying.all():
+            E_live = int(carrying.sum())
+            remap = np.full(E, E_live, np.int32)
+            remap[carrying] = np.arange(E_live, dtype=np.int32)
+            keys = keys[carrying]
+            entity_dense = remap[entity_dense]
+            E = E_live
+
+        # Group rows by entity: stable sort keeps original row order per
+        # entity; dropped-entity rows (id E) sort last and are never inside
+        # any entity's [start, start+count) range.
         order = np.argsort(entity_dense, kind="stable")
-        counts = np.bincount(entity_dense, minlength=E)
+        counts = np.bincount(entity_dense, minlength=E + 1)[:E]
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
 
         if active_cap is not None:
             # Down-sample each oversized entity's active rows uniformly
             # (reference: random-effect data config numActiveDataPointsUpperBound).
             rng = np.random.default_rng(seed)
-            perm = np.concatenate(
-                [starts[e] + rng.permutation(counts[e]) for e in range(E)]
-            ) if (counts > active_cap).any() else np.arange(n)
+            if (counts > active_cap).any():
+                parts = []
+                for e in range(E):
+                    seg = starts[e] + rng.permutation(counts[e])
+                    # Weight-0 rows (streamed down-sampling) must never
+                    # displace weight-carrying rows from the capped active
+                    # set — stable-sort so carrying rows come first,
+                    # uniformly sampled among themselves.
+                    zero = w_np[order[seg]] == 0.0
+                    if zero.any():
+                        seg = seg[np.argsort(zero, kind="stable")]
+                    parts.append(seg)
+                perm = np.concatenate(parts)
+            else:
+                perm = np.arange(n)
             order = order[perm]
             active_counts = np.minimum(counts, active_cap)
         else:
