@@ -359,23 +359,11 @@ def write_avro(
     sync: Optional[bytes] = None,
     block_records: int = 4096,
 ) -> None:
-    """Write one container file (fixture/test/model output path)."""
-    if codec not in ("null", "deflate", "snappy"):
-        raise ValueError(f"unsupported codec {codec!r}")
+    """Write one container file (fixture/test/model output path).
+    Container framing and codecs live in AvroBlockWriter (one place);
+    this adds only the per-record datum encoding."""
     parsed = parse_schema(schema)
-    schema_json = schema if isinstance(schema, str) else json.dumps(schema)
-    sync = sync or os.urandom(SYNC_SIZE)
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        meta = {"avro.schema": schema_json.encode("utf-8"),
-                "avro.codec": codec.encode("utf-8")}
-        _write_long(f, len(meta))
-        for k, v in meta.items():
-            _write_bytes(f, k.encode("utf-8"))
-            _write_bytes(f, v)
-        _write_long(f, 0)
-        f.write(sync)
-
+    with AvroBlockWriter(path, schema, codec=codec, sync=sync) as w:
         block: list = []
 
         def flush():
@@ -384,20 +372,7 @@ def write_avro(
             buf = io.BytesIO()
             for r in block:
                 write_datum(buf, parsed, r)
-            payload = buf.getvalue()
-            if codec == "deflate":
-                c = zlib.compressobj(6, zlib.DEFLATED, -15)
-                payload = c.compress(payload) + c.flush()
-            elif codec == "snappy":
-                from photon_tpu.data import snappy as _snappy
-
-                crc = zlib.crc32(payload) & 0xFFFFFFFF
-                payload = (_snappy.compress(payload)
-                           + struct.pack(">I", crc))
-            _write_long(f, len(block))
-            _write_long(f, len(payload))
-            f.write(payload)
-            f.write(sync)
+            w.write_block(len(block), buf.getvalue())
             block.clear()
 
         for r in records:
@@ -405,3 +380,60 @@ def write_avro(
             if len(block) >= block_records:
                 flush()
         flush()
+
+
+class AvroBlockWriter:
+    """Container-file writer fed PRE-ENCODED block payloads.
+
+    The streaming scoring driver encodes whole blocks of ScoredItemAvro
+    records vectorized (drivers.score.encode_scored_block) and appends them
+    here chunk by chunk — inputs and outputs both stay bounded, and no
+    per-record Python write_datum loop gates throughput. `write_block`
+    takes the RAW (uncompressed) payload; compression follows the file's
+    codec exactly as write_avro's flush does.
+    """
+
+    def __init__(self, path, schema, codec: str = "deflate",
+                 sync: Optional[bytes] = None):
+        if codec not in ("null", "deflate", "snappy"):
+            raise ValueError(f"unsupported codec {codec!r}")
+        self.codec = codec
+        self.sync = sync or os.urandom(SYNC_SIZE)
+        schema_json = schema if isinstance(schema, str) else json.dumps(schema)
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        meta = {"avro.schema": schema_json.encode("utf-8"),
+                "avro.codec": codec.encode("utf-8")}
+        _write_long(self._f, len(meta))
+        for k, v in meta.items():
+            _write_bytes(self._f, k.encode("utf-8"))
+            _write_bytes(self._f, v)
+        _write_long(self._f, 0)
+        self._f.write(self.sync)
+
+    def write_block(self, count: int, payload: bytes) -> None:
+        if count <= 0:
+            return
+        if self.codec == "deflate":
+            c = zlib.compressobj(6, zlib.DEFLATED, -15)
+            payload = c.compress(payload) + c.flush()
+        elif self.codec == "snappy":
+            from photon_tpu.data import snappy as _snappy
+
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            payload = _snappy.compress(payload) + struct.pack(">I", crc)
+        _write_long(self._f, count)
+        _write_long(self._f, len(payload))
+        self._f.write(payload)
+        self._f.write(self.sync)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -59,13 +59,23 @@ def training_example_schema(
 @dataclasses.dataclass(frozen=True)
 class GameDataConfig:
     """What to extract from records (reference: GameTrainingDriver's
-    input-data-format + feature-shard configurations)."""
+    input-data-format + feature-shard configurations).
+
+    `optional_entity_fields`: entity columns where a null is legal and
+    becomes "" instead of an error — the scoring driver reads the uid
+    column this way (reference: ScoredItemAvro.uid is nullable).
+    `allow_missing_response`: missing/null responses become 0.0 instead of
+    an error (scoring data may be unlabeled); the chunk stream records
+    whether any were missing so callers can gate evaluators.
+    """
 
     shards: dict  # shard name -> FeatureShardConfig
     entity_fields: Sequence[str] = ()
     response_field: str = "response"
     offset_field: str = "offset"
     weight_field: str = "weight"
+    optional_entity_fields: Sequence[str] = ()
+    allow_missing_response: bool = False
 
 
 def _entry_fields(e) -> tuple:
@@ -111,7 +121,12 @@ def records_to_game_data(
 
     n = len(records)
     f = config.response_field
-    y = np.fromiter((r[f] for r in records), np.float32, count=n)
+    if config.allow_missing_response:
+        y = np.fromiter(
+            (0.0 if (v := r.get(f)) is None else v for r in records),
+            np.float32, count=n)
+    else:
+        y = np.fromiter((r[f] for r in records), np.float32, count=n)
     f = config.offset_field
     offsets = np.fromiter(
         (0.0 if (v := r.get(f)) is None else v for r in records),
@@ -121,11 +136,14 @@ def records_to_game_data(
         (1.0 if (v := r.get(f)) is None else v for r in records),
         np.float32, count=n)
     ids: dict = {}
+    optional = set(config.optional_entity_fields)
     for e in config.entity_fields:
         col = [r.get(e) for r in records]
         if any(v is None for v in col):
-            i = col.index(None)
-            raise ValueError(f"record {i} missing entity id {e!r}")
+            if e not in optional:
+                i = col.index(None)
+                raise ValueError(f"record {i} missing entity id {e!r}")
+            col = ["" if v is None else v for v in col]
         ids[e] = np.asarray([str(v) for v in col])
 
     # One flattening pass per bag: per-record entry counts + flat

@@ -56,7 +56,7 @@ def scan_row_counts(path) -> list:
 
 
 def _frozen_maps_or_raise(config: GameDataConfig, index_maps,
-                          sparse_k=None) -> dict:
+                          sparse_k=None, uniform_sparse_k=True) -> dict:
     index_maps = dict(index_maps or {})
     missing = [s for s in config.shards if s not in index_maps]
     if missing:
@@ -71,14 +71,16 @@ def _frozen_maps_or_raise(config: GameDataConfig, index_maps,
             f"streaming ingestion needs FROZEN index maps; {unfrozen} are "
             "mutable — fresh ids assigned mid-stream would shift column "
             "meanings between chunks")
-    for s, cfg in config.shards.items():
-        if index_maps[s].n_features > cfg.dense_threshold and sparse_k is None:
-            raise ValueError(
-                f"shard {s!r} is sparse (d={index_maps[s].n_features} > "
-                f"dense_threshold={cfg.dense_threshold}): streaming needs a "
-                "fixed sparse_k so every chunk's SparseRows share one "
-                "nnz width (per-chunk max widths would make chunks "
-                "non-concatenable)")
+    if uniform_sparse_k:
+        for s, cfg in config.shards.items():
+            if (index_maps[s].n_features > cfg.dense_threshold
+                    and sparse_k is None):
+                raise ValueError(
+                    f"shard {s!r} is sparse (d={index_maps[s].n_features} > "
+                    f"dense_threshold={cfg.dense_threshold}): streaming "
+                    "needs a fixed sparse_k so every chunk's SparseRows "
+                    "share one nnz width (per-chunk max widths would make "
+                    "chunks non-concatenable)")
     return index_maps
 
 
@@ -176,6 +178,12 @@ class ChunkStream:
     chunk_rows: int
     sparse_k: Optional[int]
     peak_arena_bytes: int = 0
+    # With config.allow_missing_response: True once ANY streamed record
+    # lacked a response (evaluator gating), and the per-row presence mask
+    # of the MOST RECENTLY YIELDED chunk (the scoring driver reads it
+    # right after next() to null out labels row by row).
+    saw_missing_response: bool = False
+    last_response_mask: Optional[np.ndarray] = None
 
     def _note(self, live_bytes: int) -> None:
         if live_bytes > self.peak_arena_bytes:
@@ -203,6 +211,7 @@ def iter_game_chunks(
     chunk_rows: int = 65536,
     sparse_k: Optional[int] = None,
     use_native: Optional[bool] = None,
+    uniform_sparse_k: bool = True,
 ) -> tuple[ChunkStream, Iterator[GameData]]:
     """(stream handle, iterator of GameData chunks) over one file or a
     directory of .avro files. Needs frozen index maps for EVERY shard
@@ -212,8 +221,14 @@ def iter_game_chunks(
     Chunks close at container-block boundaries, so sizes are
     ≥ `chunk_rows` (except the last) and concatenation equals the one-shot
     read. `use_native` as in ingest.read_game_data.
+
+    `uniform_sparse_k=False` lifts the fixed-`sparse_k` requirement for
+    sparse shards: each chunk gets its own max-nnz width. Only for
+    consumers that process chunks INDEPENDENTLY (the scoring driver) —
+    ragged widths make chunks non-concatenable.
     """
-    index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
+    index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k,
+                                       uniform_sparse_k)
     stream = ChunkStream(config, index_maps, chunk_rows, sparse_k)
     if use_native is not False:
         # Availability / plannability checked EAGERLY (before the first
@@ -238,6 +253,12 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
     buf: list = []
 
     def flush():
+        if stream.config.allow_missing_response:
+            f = stream.config.response_field
+            mask = np.asarray([r.get(f) is not None for r in buf])
+            stream.last_response_mask = mask
+            if not mask.all():
+                stream.saw_missing_response = True
         data, _ = records_to_game_data(buf, stream.config, stream.index_maps,
                                        stream.sparse_k, host=True)
         # the record buffer and the assembled chunk coexist briefly
@@ -281,8 +302,10 @@ def _native_chunks(path, stream: ChunkStream):
     stores = frozen_stores(stream.index_maps, shard_names)
     plan = build_decode_plan(plan0, config, shard_names)
 
+    optional_ents = set(config.optional_entity_fields)
+
     def generator():
-        ys, offs, wts = [], [], []
+        ys, offs, wts, ysets = [], [], [], []
         coos = [[] for _ in shard_names]
         ents = [[] for _ in config.entity_fields]
         rows_in_chunk = 0
@@ -291,6 +314,9 @@ def _native_chunks(path, stream: ChunkStream):
         def assemble() -> GameData:
             nonlocal rows_in_chunk, live
             n = rows_in_chunk
+            if config.allow_missing_response:
+                stream.last_response_mask = np.concatenate(ysets)
+                ysets.clear()
             y = np.concatenate(ys).astype(np.float32)
             offsets = np.concatenate(offs).astype(np.float32)
             weights = np.concatenate(wts).astype(np.float32)
@@ -316,7 +342,10 @@ def _native_chunks(path, stream: ChunkStream):
             for e_i, e in enumerate(config.entity_fields):
                 col = np.concatenate(ents[e_i])
                 if any(v is None for v in col):
-                    raise ValueError(f"records missing entity id {e!r}")
+                    if e not in optional_ents:
+                        raise ValueError(f"records missing entity id {e!r}")
+                    col = np.asarray(["" if v is None else v for v in col],
+                                     object)
                 ids[e] = np.asarray([str(v) for v in col])
             out = GameData(y, weights, offsets, shards, ids)
             # block pieces + the assembled chunk coexist briefly
@@ -338,7 +367,13 @@ def _native_chunks(path, stream: ChunkStream):
                     raise ValueError(f"{rd.path}: malformed Avro block")
                 y, y_set = dec.scalars(0)
                 if not y_set.all():
-                    raise ValueError(f"{rd.path}: record missing response")
+                    if not config.allow_missing_response:
+                        raise ValueError(
+                            f"{rd.path}: record missing response")
+                    stream.saw_missing_response = True
+                    y = np.where(y_set, y, 0.0)
+                if config.allow_missing_response:
+                    ysets.append(y_set)
                 off, off_set = dec.scalars(1)
                 wt, wt_set = dec.scalars(2)
                 ys.append(y)
