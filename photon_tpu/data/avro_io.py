@@ -385,12 +385,12 @@ def write_avro(
 class AvroBlockWriter:
     """Container-file writer fed PRE-ENCODED block payloads.
 
-    The streaming scoring driver encodes whole blocks of ScoredItemAvro
-    records vectorized (drivers.score.encode_scored_block) and appends them
-    here chunk by chunk — inputs and outputs both stay bounded, and no
-    per-record Python write_datum loop gates throughput. `write_block`
-    takes the RAW (uncompressed) payload; compression follows the file's
-    codec exactly as write_avro's flush does.
+    Consumers encode whole blocks vectorized (see the block-encoding
+    primitives below) and append them chunk by chunk — inputs and outputs
+    both stay bounded, and no per-record Python write_datum loop gates
+    throughput. `write_block` takes the RAW (uncompressed) payload;
+    compression follows the file's codec exactly as write_avro's flush
+    does.
     """
 
     def __init__(self, path, schema, codec: str = "deflate",
@@ -437,3 +437,50 @@ class AvroBlockWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# --------------------------------------------------------------------------
+# vectorized block-encoding primitives (the output analog of the native
+# block decoder: build whole block payloads with numpy byte scatter, no
+# per-record write_datum loop). Schema-specific encoders compose these —
+# see drivers.score.encode_scored_block for the ScoredItemAvro instance.
+# --------------------------------------------------------------------------
+
+
+def varint_bytes(values):
+    """Zigzag varint encoding of NON-NEGATIVE int64s, vectorized: returns
+    (byte matrix (n, w), per-value byte lengths). Bytes past a value's
+    length are zero and must not be emitted."""
+    import numpy as np
+
+    z = values.astype(np.uint64) << np.uint64(1)
+    cols = []
+    lengths = np.ones(values.shape[0], np.int64)
+    rem = z.copy()
+    while True:
+        b = (rem & np.uint64(0x7F)).astype(np.uint8)
+        rem >>= np.uint64(7)
+        more = rem != 0
+        cols.append(np.where(more, b | 0x80, b).astype(np.uint8))
+        if not more.any():
+            break
+        lengths += more  # continuing values get one more byte
+    return np.stack(cols, axis=1), lengths
+
+
+def ragged_arange(lens):
+    """[0..l0), [0..l1), ... concatenated."""
+    import numpy as np
+
+    total = int(lens.sum())
+    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+
+
+def scatter_ragged(buf, starts, mat, lens) -> None:
+    """buf[starts[i] + j] = mat[i, j] for j < lens[i], no Python loop."""
+    import numpy as np
+
+    intra = ragged_arange(lens)
+    rows = np.repeat(np.arange(lens.shape[0], dtype=np.int64), lens)
+    buf[np.repeat(starts, lens) + intra] = mat[rows, intra]

@@ -102,44 +102,9 @@ class ScoringOutput:
 
 
 # --------------------------------------------------------------------------
-# vectorized ScoredItemAvro block encoding
+# vectorized ScoredItemAvro block encoding (generic primitives live in
+# data.avro_io: varint_bytes / scatter_ragged)
 # --------------------------------------------------------------------------
-
-
-def _varint_bytes(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Zigzag varint encoding of NON-NEGATIVE int64s, vectorized: returns
-    (byte matrix (n, w), per-value byte lengths). Bytes past a value's
-    length are zero and must not be emitted."""
-    z = values.astype(np.uint64) << np.uint64(1)
-    cols = []
-    rem = z.copy()
-    while True:
-        b = (rem & np.uint64(0x7F)).astype(np.uint8)
-        rem >>= np.uint64(7)
-        more = rem != 0
-        cols.append(np.where(more, b | 0x80, b).astype(np.uint8))
-        if not more.any():
-            break
-    lengths = np.ones(values.shape[0], np.int64)
-    tmp = z >> np.uint64(7)
-    while (tmp != 0).any():
-        lengths += (tmp != 0)
-        tmp >>= np.uint64(7)
-    return np.stack(cols, axis=1), lengths
-
-
-def _ragged_arange(lens: np.ndarray) -> np.ndarray:
-    """[0..l0), [0..l1), ... concatenated."""
-    total = int(lens.sum())
-    starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
-    return np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
-
-
-def _scatter_ragged(buf, starts, mat, lens) -> None:
-    """buf[starts[i] + j] = mat[i, j] for j < lens[i], no Python loop."""
-    intra = _ragged_arange(lens)
-    rows = np.repeat(np.arange(lens.shape[0], dtype=np.int64), lens)
-    buf[np.repeat(starts, lens) + intra] = mat[rows, intra]
 
 
 def encode_scored_block(uids, scores, labels, label_mask,
@@ -152,7 +117,11 @@ def encode_scored_block(uids, scores, labels, label_mask,
     uids: (n,) str; rows with uid_mask False write the null union branch.
     labels: (n,) float64; rows with label_mask False write null.
     """
+    from photon_tpu.data.avro_io import scatter_ragged, varint_bytes
+
     n = int(scores.shape[0])
+    if n == 0:
+        return b""
     uid_mask = np.asarray(uid_mask, bool)
     label_mask = np.asarray(label_mask, bool)
     enc = np.char.encode(np.asarray(uids, dtype=np.str_), "utf-8")
@@ -161,7 +130,7 @@ def encode_scored_block(uids, scores, labels, label_mask,
         enc.tobytes() if enc.dtype.itemsize else b"\x00" * n,
         np.uint8).reshape(n, W)
     ulen = np.char.str_len(enc).astype(np.int64)
-    vmat, vlen = _varint_bytes(ulen)
+    vmat, vlen = varint_bytes(ulen)
 
     ulen_w = np.where(uid_mask, ulen, 0)
     vlen_w = np.where(uid_mask, vlen, 0)
@@ -171,8 +140,8 @@ def encode_scored_block(uids, scores, labels, label_mask,
     buf = np.zeros(int(rec_len.sum()), np.uint8)
 
     buf[off] = np.where(uid_mask, 2, 0)  # union branch: 1 -> zigzag 2
-    _scatter_ragged(buf, off + 1, vmat, vlen_w)
-    _scatter_ragged(buf, off + 1 + vlen_w, bmat, ulen_w)
+    scatter_ragged(buf, off + 1, vmat, vlen_w)
+    scatter_ragged(buf, off + 1 + vlen_w, bmat, ulen_w)
     sc = np.frombuffer(
         np.ascontiguousarray(scores, "<f8").tobytes(), np.uint8).reshape(n, 8)
     pos = off + 1 + vlen_w + ulen_w
@@ -263,6 +232,16 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     evals = ([parse_evaluator(s) for s in params.evaluators]
              or [default_evaluator(model.task)])
     need_groups = any(ev.needs_groups for ev in evals)
+    # The evaluator entity resolves BEFORE the chunk loop so only that ONE
+    # id column accumulates (per-row strings are the heaviest metric input;
+    # the other entity columns are never read by evaluate_with_entity).
+    from photon_tpu.game.model import RandomEffectModel
+
+    eval_entity = params.evaluator_entity
+    if eval_entity is None:
+        eval_entity = next(
+            (cm.entity_name for cm in model.coordinates.values()
+             if isinstance(cm, RandomEffectModel)), None)
 
     os.makedirs(params.output_dir, exist_ok=True)
     out_path = os.path.join(params.output_dir, "scores.avro")
@@ -277,8 +256,9 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     # moment a missing response makes evaluation impossible — an unlabeled
     # 1B-row run must not hoard per-row strings it will never use.
     margins_acc, scores_acc, y_acc, w_acc = [], [], [], []
-    group_cols: dict = {e: [] for e in params.entity_fields} \
-        if need_groups else {}
+    group_cols: dict = (
+        {eval_entity: []}
+        if need_groups and eval_entity in params.entity_fields else {})
     n_rows = 0
     n_chunks = 0
     with AvroBlockWriter(out_path, SCORED_ITEM_SCHEMA,
@@ -324,23 +304,16 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     has_labels = not stream.saw_missing_response and n_rows > 0
     if has_labels:
         from photon_tpu.evaluation.evaluator import evaluate_with_entity
-        from photon_tpu.game.model import RandomEffectModel
 
         m = np.concatenate(margins_acc)
         y = np.concatenate(y_acc)
         w = np.concatenate(w_acc)
         entity_ids = {e: np.concatenate(v) for e, v in group_cols.items()}
-        entity = params.evaluator_entity
-        if entity is None:
-            # training-driver fallback: the first random-effect entity
-            entity = next(
-                (cm.entity_name for cm in model.coordinates.values()
-                 if isinstance(cm, RandomEffectModel)), None)
         for ev in evals:
             if ev.needs_groups:
                 try:
                     metrics[evaluator_name(ev)] = evaluate_with_entity(
-                        ev, m, y, w, entity_ids, entity)
+                        ev, m, y, w, entity_ids, eval_entity)
                 except ValueError as e:
                     log.warning("skipping %s: %s (set "
                                 "ScoringParams.evaluator_entity)",

@@ -4,9 +4,14 @@ from photon_tpu.evaluation.evaluator import (
     default_evaluator,
     evaluator_suite,
 )
-from photon_tpu.evaluation.grouped import grouped_auc, grouped_precision_at_k
+from photon_tpu.evaluation.grouped import (
+    grouped_auc,
+    grouped_aupr,
+    grouped_precision_at_k,
+)
 from photon_tpu.evaluation.metrics import (
     auc,
+    aupr,
     logistic_loss,
     poisson_loss,
     precision_at_k,
@@ -21,8 +26,10 @@ __all__ = [
     "default_evaluator",
     "evaluator_suite",
     "grouped_auc",
+    "grouped_aupr",
     "grouped_precision_at_k",
     "auc",
+    "aupr",
     "rmse",
     "squared_loss",
     "logistic_loss",

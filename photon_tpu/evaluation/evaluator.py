@@ -21,6 +21,7 @@ from photon_tpu.ops.losses import TaskType
 
 class EvaluatorType(enum.Enum):
     AUC = "AUC"
+    AUPR = "AUPR"
     RMSE = "RMSE"
     SQUARED_LOSS = "SQUARED_LOSS"
     LOGISTIC_LOSS = "LOGISTIC_LOSS"
@@ -28,20 +29,25 @@ class EvaluatorType(enum.Enum):
     SMOOTHED_HINGE_LOSS = "SMOOTHED_HINGE_LOSS"
     PRECISION_AT_K = "PRECISION_AT_K"
     SHARDED_AUC = "SHARDED_AUC"
+    SHARDED_AUPR = "SHARDED_AUPR"
     SHARDED_PRECISION_AT_K = "SHARDED_PRECISION_AT_K"
 
 
 _HIGHER_IS_BETTER = {
     EvaluatorType.AUC,
+    EvaluatorType.AUPR,
+    EvaluatorType.SHARDED_AUPR,
     EvaluatorType.PRECISION_AT_K,
     EvaluatorType.SHARDED_AUC,
     EvaluatorType.SHARDED_PRECISION_AT_K,
 }
 
-_SHARDED = {EvaluatorType.SHARDED_AUC, EvaluatorType.SHARDED_PRECISION_AT_K}
+_SHARDED = {EvaluatorType.SHARDED_AUC, EvaluatorType.SHARDED_AUPR,
+            EvaluatorType.SHARDED_PRECISION_AT_K}
 
 _METRIC_FNS = {
     EvaluatorType.AUC: metrics.auc,
+    EvaluatorType.AUPR: metrics.aupr,
     EvaluatorType.RMSE: metrics.rmse,
     EvaluatorType.SQUARED_LOSS: metrics.squared_loss,
     EvaluatorType.LOGISTIC_LOSS: metrics.logistic_loss,
@@ -84,6 +90,10 @@ class Evaluator:
                 weights = jnp.ones_like(jnp.asarray(scores, jnp.float32))
             if self.kind is EvaluatorType.SHARDED_AUC:
                 _, _, mean = grouped.grouped_auc(
+                    scores, labels, weights, groups, self.num_groups
+                )
+            elif self.kind is EvaluatorType.SHARDED_AUPR:
+                _, _, mean = grouped.grouped_aupr(
                     scores, labels, weights, groups, self.num_groups
                 )
             else:
@@ -168,6 +178,7 @@ def evaluator_suite(task: TaskType) -> list[Evaluator]:
     if task is TaskType.LOGISTIC_REGRESSION:
         return [
             Evaluator(EvaluatorType.AUC),
+            Evaluator(EvaluatorType.AUPR),
             Evaluator(EvaluatorType.LOGISTIC_LOSS),
             Evaluator(EvaluatorType.PRECISION_AT_K),
         ]

@@ -81,6 +81,60 @@ def grouped_auc(scores, labels, weights, groups, num_groups: int):
     return per_group, valid, _mean_over_valid(per_group, valid)
 
 
+@partial(jax.jit, static_argnames=("num_groups",))
+def grouped_aupr(scores, labels, weights, groups, num_groups: int):
+    """(per_group_aupr, valid_mask, mean_over_valid).
+
+    Weighted, tie-aware area under the precision–recall curve in the
+    STEP-WISE (average-precision) form sklearn uses:
+    ``AP = Σ_t (R_t − R_{t−1}) · P_t`` over distinct thresholds descending,
+    where a tied score block enters as one threshold. (Reference:
+    AreaUnderPRCurveEvaluator; the reference's Spark-mllib backing uses
+    the same curve points.) NaN where a group has no positive weight —
+    precision is undefined with zero positives.
+    """
+    scores = jnp.asarray(scores, jnp.float32)
+    labels = jnp.asarray(labels, jnp.float32)
+    weights = jnp.asarray(weights, jnp.float32)
+    groups = jnp.asarray(groups, jnp.int32)
+    n = scores.shape[0]
+
+    # Descending score within group: every prefix of the sorted order is
+    # "predicted positive at this threshold".
+    order = _sort_by_group_then_key(groups, -scores)
+    s, y, w, g = scores[order], labels[order], weights[order], groups[order]
+    wpos = w * y
+    wneg = w * (1.0 - y)
+
+    new_tie = jnp.concatenate(
+        [jnp.ones((1,), bool), (s[1:] != s[:-1]) | (g[1:] != g[:-1])]
+    )
+    tid = jnp.cumsum(new_tie) - 1
+    cpos = jnp.cumsum(wpos)
+    cneg = jnp.cumsum(wneg)
+    # Cumulative weights at each tie block's END (a tied block is one
+    # threshold: all its rows count as retrieved together) minus the
+    # group's cumulative before its first row.
+    pos_tie_end = jax.ops.segment_max(cpos, tid, num_segments=n)
+    neg_tie_end = jax.ops.segment_max(cneg, tid, num_segments=n)
+    pos_before_g = jax.ops.segment_min(cpos - wpos, g,
+                                       num_segments=num_groups)
+    neg_before_g = jax.ops.segment_min(cneg - wneg, g,
+                                       num_segments=num_groups)
+    tp = pos_tie_end[tid] - pos_before_g[g]
+    fp = neg_tie_end[tid] - neg_before_g[g]
+    denom = tp + fp
+    precision = tp / jnp.where(denom > 0.0, denom, 1.0)
+    # Σ ΔR·P = Σ_rows (wpos_i / P_g) · precision(tie of i)
+    ap_num = jax.ops.segment_sum(wpos * precision, g,
+                                 num_segments=num_groups)
+    p_g = jax.ops.segment_sum(wpos, g, num_segments=num_groups)
+    valid = p_g > 0.0
+    per_group = jnp.where(valid, ap_num / jnp.where(valid, p_g, 1.0),
+                          jnp.nan)
+    return per_group, valid, _mean_over_valid(per_group, valid)
+
+
 @partial(jax.jit, static_argnames=("num_groups", "k"))
 def grouped_precision_at_k(scores, labels, weights, groups, num_groups: int, k: int):
     """(per_group_p_at_k, valid_mask, mean_over_valid).

@@ -20,7 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from photon_tpu.evaluation.grouped import grouped_auc
+from photon_tpu.evaluation.grouped import grouped_auc, grouped_aupr
 from photon_tpu.ops.losses import TaskType, loss_fns
 
 # Every metric body is wrapped in jax.jit: each call then costs ONE device
@@ -59,6 +59,25 @@ def auc(scores, labels, weights=None) -> jax.Array:
 @jax.jit
 def _auc_jit(scores, labels, weights):
     per_group, _, _ = grouped_auc(
+        scores, labels, weights, jnp.zeros_like(scores, jnp.int32), 1
+    )
+    return per_group[0]
+
+
+# ----------------------------------------------------------------------- AUPR
+def aupr(scores, labels, weights=None) -> jax.Array:
+    """Weighted, tie-aware area under the precision–recall curve, in the
+    step-wise average-precision form (sklearn's average_precision_score;
+    reference: AreaUnderPRCurveEvaluator). NaN when positive weight is
+    zero. One-group case of evaluation.grouped.grouped_aupr, so the
+    threshold/tie math lives in exactly one place."""
+    scores, labels, weights = _asarrays(scores, labels, weights)
+    return _aupr_jit(scores, labels, weights)
+
+
+@jax.jit
+def _aupr_jit(scores, labels, weights):
+    per_group, _, _ = grouped_aupr(
         scores, labels, weights, jnp.zeros_like(scores, jnp.int32), 1
     )
     return per_group[0]

@@ -151,3 +151,90 @@ def test_grouped_mean_nan_when_no_valid_group():
     _, valid, mean = grouped_auc(s, y, w, g, 2)
     assert not np.asarray(valid).any()
     assert np.isnan(float(mean))
+
+
+# ------------------------------------------------------------------------ AUPR
+def test_aupr_matches_sklearn():
+    from sklearn.metrics import average_precision_score
+
+    from photon_tpu.evaluation import aupr
+
+    n = 500
+    y = (rng.random(n) < 0.3).astype(np.float32)
+    s = rng.normal(size=n).astype(np.float32) + 1.2 * y
+    np.testing.assert_allclose(
+        float(aupr(s, y)), average_precision_score(y, s), atol=1e-6)
+
+
+def test_aupr_weighted_with_ties_matches_sklearn():
+    from sklearn.metrics import average_precision_score
+
+    from photon_tpu.evaluation import aupr
+
+    n = 400
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    s = np.round(rng.normal(size=n) + 0.7 * y, 1).astype(np.float32)
+    w = rng.integers(1, 5, size=n).astype(np.float32)
+    expected = average_precision_score(y, s, sample_weight=w)
+    np.testing.assert_allclose(float(aupr(s, y, w)), expected, atol=1e-6)
+
+
+def test_aupr_padding_and_degenerate_groups():
+    from photon_tpu.evaluation import aupr
+
+    y = np.array([1, 0, 1, 0, 1], np.float32)
+    s = np.array([0.9, 0.1, 0.8, 0.4, 0.2], np.float32)
+    w = np.ones(5, np.float32)
+    base = float(aupr(s, y, w))
+    # weight-0 padding rows change nothing
+    yp = np.concatenate([y, [1, 0]]).astype(np.float32)
+    sp = np.concatenate([s, [5.0, -5.0]]).astype(np.float32)
+    wp = np.concatenate([w, [0.0, 0.0]]).astype(np.float32)
+    np.testing.assert_allclose(float(aupr(sp, yp, wp)), base, atol=1e-6)
+    # no positives -> undefined
+    assert np.isnan(float(aupr(s, np.zeros(5, np.float32))))
+    # all positives -> 1.0
+    np.testing.assert_allclose(
+        float(aupr(s, np.ones(5, np.float32))), 1.0, atol=1e-6)
+
+
+def test_grouped_aupr_matches_per_group_loop():
+    from sklearn.metrics import average_precision_score
+
+    from photon_tpu.evaluation import grouped_aupr
+
+    num_groups = 7
+    s, y, w, g = _random_groups(350, num_groups)
+    per_group, valid, mean = grouped_aupr(s, y, w, g, num_groups)
+    per_group = np.asarray(per_group)
+    expected = []
+    for gi in range(num_groups):
+        m = g == gi
+        if y[m].sum() == 0:
+            assert not valid[gi]
+            continue
+        ref = average_precision_score(y[m], s[m], sample_weight=w[m])
+        np.testing.assert_allclose(per_group[gi], ref, atol=1e-5)
+        expected.append(ref)
+    np.testing.assert_allclose(float(mean), np.mean(expected), atol=1e-5)
+
+
+def test_aupr_evaluator_wiring():
+    from photon_tpu.evaluation.evaluator import evaluator_name, parse_evaluator
+
+    ev = parse_evaluator("AUPR")
+    assert ev.kind is EvaluatorType.AUPR
+    assert ev.higher_is_better and not ev.needs_groups
+    assert evaluator_name(ev) == "AUPR"
+    sv = parse_evaluator("sharded_aupr")
+    assert sv.kind is EvaluatorType.SHARDED_AUPR
+    assert sv.higher_is_better and sv.needs_groups
+
+    num_groups = 6
+    s, y, w, g = _random_groups(240, num_groups)
+    from photon_tpu.evaluation import grouped_aupr
+
+    ev2 = Evaluator(EvaluatorType.SHARDED_AUPR, num_groups=num_groups)
+    _, _, mean = grouped_aupr(s, y, w, g, num_groups)
+    np.testing.assert_allclose(ev2.evaluate(s, y, w, g), float(mean),
+                               atol=1e-6)
