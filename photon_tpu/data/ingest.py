@@ -89,9 +89,25 @@ def _entry_fields(e) -> tuple:
     return e["name"], e.get("term", ""), float(e["value"])
 
 
+def iter_bag_entries(bag):
+    """(name, term, value) triples of one raw bag value — the canonical
+    iteration for BOTH bag shapes: a list of NameTermValue/dict entries
+    (array<NameTermValue>) or a str→number mapping (map-typed bags, where
+    the map key is the feature name and the term is empty — reference:
+    AvroDataReader's makeFeatures handles both field shapes)."""
+    if not bag:
+        return
+    if isinstance(bag, dict):
+        for k, v in bag.items():
+            yield k, "", float(v)
+    else:
+        for e in bag:
+            yield _entry_fields(e)
+
+
 def normalize_bag(bag_entries) -> list:
-    """Raw Avro bag entries → NameTermValue list (see _entry_fields)."""
-    return [NameTermValue(*_entry_fields(e)) for e in bag_entries or ()]
+    """Raw Avro bag entries → NameTermValue list (see iter_bag_entries)."""
+    return [NameTermValue(*t) for t in iter_bag_entries(bag_entries)]
 
 
 _to_ntv = normalize_bag  # internal alias (pre-existing call sites)
@@ -160,8 +176,7 @@ def records_to_game_data(
         for i, rec in enumerate(records):
             es = rec.get(b) or ()
             cnt[i] = len(es)
-            for e in es:
-                name, term, value = _entry_fields(e)
+            for name, term, value in iter_bag_entries(es):
                 ks.append(f"{name}{DELIMITER}{term}" if term else name)
                 vs.append(value)
         counts[b] = cnt

@@ -29,7 +29,83 @@ from photon_tpu.game.dataset import GameData
 
 # ops understood by the C++ decoder (see photon_native.cc)
 _OP_DOUBLE, _OP_OPT_DOUBLE, _OP_OPT_STR_SKIP, _OP_ENTITY, _OP_BAG, \
-    _OP_STR_SKIP, _OP_LONG_SKIP = range(7)
+    _OP_STR_SKIP, _OP_LONG_SKIP, _OP_GENERIC_SKIP, _OP_SCALAR_GEN, \
+    _OP_ENTITY_GEN, _OP_BAG_MAP = range(11)
+
+# skip-program bytecodes (photon_native.cc::skip_value)
+_SK_NULL, _SK_BOOL, _SK_VARINT, _SK_FLOAT, _SK_DOUBLE, _SK_BYTES, \
+    _SK_FIXED, _SK_UNION, _SK_RECORD, _SK_ARRAY, _SK_MAP = range(11)
+
+_SK_PRIMITIVE = {"null": _SK_NULL, "boolean": _SK_BOOL, "int": _SK_VARINT,
+                 "long": _SK_VARINT, "enum": _SK_VARINT, "float": _SK_FLOAT,
+                 "double": _SK_DOUBLE, "bytes": _SK_BYTES,
+                 "string": _SK_BYTES}
+
+# numeric kinds for the generalized scalar op (aux byte 1)
+_NUM_KIND = {"double": 0, "float": 1, "long": 2, "int": 2}
+
+
+class _SkipTable:
+    """Accumulates skip programs, one program id per DISTINCT value shape
+    (memoized, so a record of 20 longs shares one varint program)."""
+
+    # mirror of photon_native.cc skip_value's recursion guard: deeper
+    # schemas must refuse at PLAN time so the reader falls back to Python
+    # instead of hard-failing mid-decode on valid data
+    MAX_DEPTH = 64
+
+    def __init__(self):
+        self.progs: list = []
+        self._memo: dict = {}
+
+    def add(self, schema, depth: int = 0) -> Optional[int]:
+        """Compile `schema` to a skip program id; None if unskippable."""
+        if depth > self.MAX_DEPTH:
+            return None
+        ts = _schema_type(schema)
+        if ts in _SK_PRIMITIVE:
+            prog = [_SK_PRIMITIVE[ts]]
+        elif ts == "fixed":
+            prog = [_SK_FIXED, int(schema["size"])]
+        elif ts == "union":
+            branches = schema if isinstance(schema, list) else schema["type"]
+            pids = [self.add(b, depth + 1) for b in branches]
+            if any(p is None for p in pids):
+                return None
+            prog = [_SK_UNION, len(pids)] + pids
+        elif ts == "record":
+            pids = [self.add(f["type"], depth + 1)
+                    for f in schema["fields"]]
+            if any(p is None for p in pids):
+                return None
+            prog = [_SK_RECORD, len(pids)] + pids
+        elif ts == "array":
+            pid = self.add(schema["items"], depth + 1)
+            if pid is None:
+                return None
+            prog = [_SK_ARRAY, pid]
+        elif ts == "map":
+            pid = self.add(schema["values"], depth + 1)
+            if pid is None:
+                return None
+            prog = [_SK_MAP, pid]
+        else:
+            return None
+        key = tuple(prog)
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        self.progs.append(prog)
+        self._memo[key] = len(self.progs) - 1
+        return self._memo[key]
+
+    def tables(self) -> tuple[list, list]:
+        """(flat program array, per-program start offsets)."""
+        flat, off = [], []
+        for p in self.progs:
+            off.append(len(flat))
+            flat.extend(p)
+        return flat or [0], off or [0]
 
 
 def _is_opt(schema, inner: str) -> bool:
@@ -37,6 +113,22 @@ def _is_opt(schema, inner: str) -> bool:
     return (isinstance(schema, list) and len(schema) == 2
             and _schema_type(schema[0]) == "null"
             and _schema_type(schema[1]) == inner)
+
+
+def _two_branch_mode(schema, kinds) -> Optional[tuple]:
+    """(mode, inner_type_name) for plain-or-2-branch-nullable shapes:
+    mode 0 = plain, 1 = [null, X], 2 = [X, null]; X's type name must be in
+    `kinds`. None when the shape doesn't match."""
+    ts = _schema_type(schema)
+    if ts in kinds:
+        return 0, ts
+    if isinstance(schema, list) and len(schema) == 2:
+        t0, t1 = _schema_type(schema[0]), _schema_type(schema[1])
+        if t0 == "null" and t1 in kinds:
+            return 1, t1
+        if t1 == "null" and t0 in kinds:
+            return 2, t0
+    return None
 
 
 def _ntv_value_kind(items) -> Optional[int]:
@@ -54,52 +146,82 @@ def _ntv_value_kind(items) -> Optional[int]:
 
 
 def compile_plan(schema, config: GameDataConfig):
-    """Schema → (ops, aux, vkinds, bag names) or None if not plannable."""
+    """Schema → (ops, aux, vkinds, bag names, sk_prog, sk_off) or None.
+
+    CONSUMED fields must match a supported shape: scalars are
+    double/float/int/long, plain or 2-branch nullable (either order);
+    entity columns are string, plain or 2-branch nullable; configured
+    feature bags are array<NameTermValue> or map<string, double|float>.
+    Every UNCONSUMED field of any Avro shape — nested records, wide
+    unions, enums, fixed, maps, arrays — compiles to a generic skip
+    program and stays on the native road (the round-3 builder rejected
+    the whole schema over one odd extra field, a ~10-20x ingest cliff)."""
     if _schema_type(schema) != "record":
         return None
     scalar_slots = {config.response_field: 0, config.offset_field: 1,
                     config.weight_field: 2}
     entity_idx = {e: i for i, e in enumerate(config.entity_fields)}
+    required = {b for cfg in config.shards.values() for b in cfg.bags}
+    skips = _SkipTable()
     ops, aux, vkinds, bag_names = [], [], [], []
     for f in schema["fields"]:
         name, t = f["name"], f["type"]
         ts = _schema_type(t)
         if name in scalar_slots:
-            if ts == "double":
+            if ts == "double":  # classic shapes keep the classic ops
                 ops.append(_OP_DOUBLE)
+                aux.append(scalar_slots[name])
             elif _is_opt(t, "double"):
                 ops.append(_OP_OPT_DOUBLE)
+                aux.append(scalar_slots[name])
+            else:
+                m = _two_branch_mode(t, _NUM_KIND)
+                if m is None:
+                    return None
+                mode, inner = m
+                ops.append(_OP_SCALAR_GEN)
+                aux.append(scalar_slots[name] | (_NUM_KIND[inner] << 8)
+                           | (mode << 16))
+        elif name in entity_idx:
+            if _is_opt(t, "string"):
+                ops.append(_OP_ENTITY)
+                aux.append(entity_idx[name])
+            else:
+                m = _two_branch_mode(t, ("string",))
+                if m is None:
+                    return None
+                mode, _ = m
+                ops.append(_OP_ENTITY_GEN)
+                aux.append(entity_idx[name] | (mode << 16))
+        elif name in required:
+            if ts == "array":
+                vk = _ntv_value_kind(
+                    t["items"] if isinstance(t, dict) else None)
+                if vk is None:
+                    return None
+                ops.append(_OP_BAG)
+            elif ts == "map":
+                vk = {"double": 0, "float": 1}.get(
+                    _schema_type(t["values"]))
+                if vk is None:
+                    return None
+                ops.append(_OP_BAG_MAP)
             else:
                 return None
-            aux.append(scalar_slots[name])
-        elif name in entity_idx:
-            if not _is_opt(t, "string"):
-                return None
-            ops.append(_OP_ENTITY)
-            aux.append(entity_idx[name])
-        elif ts == "array":
-            vk = _ntv_value_kind(t["items"] if isinstance(t, dict) else None)
-            if vk is None:
-                return None
-            ops.append(_OP_BAG)
             aux.append(len(bag_names))
             vkinds.append(vk)
             bag_names.append(name)
-        elif ts == "string":
-            ops.append(_OP_STR_SKIP)
-            aux.append(0)
-        elif _is_opt(t, "string"):
-            ops.append(_OP_OPT_STR_SKIP)
-            aux.append(0)
-        elif ts in ("long", "int"):
-            ops.append(_OP_LONG_SKIP)
-            aux.append(0)
         else:
-            return None
-    required = {b for cfg in config.shards.values() for b in cfg.bags}
+            # every unconsumed field skips natively, whatever its shape
+            pid = skips.add(t)
+            if pid is None:
+                return None
+            ops.append(_OP_GENERIC_SKIP)
+            aux.append(pid)
     if not required.issubset(bag_names):
         return None  # a configured bag is missing from the schema
-    return ops, aux, vkinds, bag_names
+    sk_prog, sk_off = skips.tables()
+    return ops, aux, vkinds, bag_names, sk_prog, sk_off
 
 
 def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
@@ -107,7 +229,7 @@ def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
     consumes its shard's bags IN CONFIG ORDER (id-assignment parity with
     build_index_map's `for bag in config.bags` loop). Shared by the
     one-shot reader and data.streaming."""
-    ops, aux, vkinds, bag_names = plan0
+    ops, aux, vkinds, bag_names, sk_prog, sk_off = plan0
     sb_off, sb_idx = [0], []
     for s in shard_names:
         sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
@@ -115,7 +237,8 @@ def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
     return (np.asarray(ops, np.int32), np.asarray(aux, np.int32),
             np.asarray(vkinds or [0], np.int32),
             np.asarray(sb_off, np.int32),
-            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields))
+            np.asarray(sb_idx or [0], np.int32), len(config.entity_fields),
+            np.asarray(sk_prog, np.int32), np.asarray(sk_off, np.int32))
 
 
 def frozen_stores(index_maps: dict, shard_names) -> list:

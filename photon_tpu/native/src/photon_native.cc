@@ -253,6 +253,21 @@ void* ph_store_open(const char* path) {
 //   op 4: array<NameTermValue>     -> feature COO; aux = bag index
 //   op 5: string skip
 //   op 6: long/int skip
+//   op 7: generic skip             -> aux = skip-program id (see below)
+//   op 8: generic numeric scalar   -> aux packs slot | kind<<8 | mode<<16
+//         kind 0=double 1=float 2=varint(int/long); mode 0=plain,
+//         1=[null,X], 2=[X,null]
+//   op 9: generic entity column    -> aux packs entity | mode<<16
+//         mode 0=plain string, 1=[null,string], 2=[string,null]
+//   op 10: map<string, double|float> -> feature COO; aux = bag index
+//         (map key = feature name, no term; ntv_value_kind as op 4)
+//
+// SKIP PROGRAMS make every unconsumed field shape native: a schema is
+// compiled into a table of small i32 programs (sk_prog flat array +
+// sk_off[pid] starts), one per nested value shape:
+//   [0]=null [1]=boolean [2]=varint(int/long/enum) [3]=float [4]=double
+//   [5]=bytes/string [6,n]=fixed(n) [7,n,p1..pn]=union [8,n,p1..pn]=record
+//   [9,p]=array [10,p]=map
 // Anything else must be handled by the Python fallback (the plan builder
 // refuses to emit a plan).
 //
@@ -334,6 +349,80 @@ static inline const uint8_t* read_str(Cursor* c, int64_t* len) {
   return s;
 }
 
+// Recursive skip of one value described by skip program `pid`.
+static void skip_value(Cursor* c, const int32_t* prog, const int32_t* off,
+                       int32_t pid, int depth) {
+  if (depth > 64 || pid < 0) {  // malicious nesting / bad plan
+    c->ok = false;
+    return;
+  }
+  const int32_t* q = prog + off[pid];
+  switch (q[0]) {
+    case 0:  // null
+      return;
+    case 1:  // boolean
+      if (c->p >= c->end) c->ok = false;
+      else ++c->p;
+      return;
+    case 2:  // int/long/enum varint
+      read_long(c);
+      return;
+    case 3:
+      read_float(c);
+      return;
+    case 4:
+      read_double(c);
+      return;
+    case 5: {  // bytes/string
+      int64_t len;
+      read_str(c, &len);
+      return;
+    }
+    case 6: {  // fixed(n)
+      int64_t n = q[1];
+      if (n > c->end - c->p) c->ok = false;
+      else c->p += n;
+      return;
+    }
+    case 7: {  // union: branch varint then that branch's program
+      int64_t b = read_long(c);
+      if (!c->ok) return;
+      if (b < 0 || b >= q[1]) {
+        c->ok = false;
+        return;
+      }
+      skip_value(c, prog, off, q[2 + b], depth + 1);
+      return;
+    }
+    case 8: {  // record: fields in order
+      for (int32_t i = 0; i < q[1] && c->ok; ++i)
+        skip_value(c, prog, off, q[2 + i], depth + 1);
+      return;
+    }
+    case 9:    // array of q[1]
+    case 10: {  // map of q[1] (string keys)
+      for (;;) {
+        int64_t bn = read_long(c);
+        if (!c->ok || bn == 0) return;
+        if (bn < 0) {
+          read_long(c);  // block byte size
+          bn = -bn;
+        }
+        for (int64_t k = 0; k < bn && c->ok; ++k) {
+          if (q[0] == 10) {
+            int64_t len;
+            read_str(c, &len);
+            if (!c->ok) return;
+          }
+          skip_value(c, prog, off, q[1], depth + 1);
+        }
+      }
+    }
+    default:
+      c->ok = false;
+  }
+}
+
 // One buffered NameTermValue within the current record.
 struct BagEntry {
   uint64_t key_off;
@@ -355,7 +444,8 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
                       const int32_t* store_bag_off,
                       const int32_t* store_bag_idx,
                       void** stores, int32_t n_stores, int32_t n_entities,
-                      int32_t build_mode) {
+                      int32_t build_mode,
+                      const int32_t* sk_prog, const int32_t* sk_off) {
   Decoded* out = new Decoded();
   for (int k = 0; k < 3; ++k) {
     out->scalars[k].assign(count, 0.0);
@@ -452,6 +542,79 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
         }
         case 6: {
           read_long(&c);
+          break;
+        }
+        case 7: {  // generic skip via compiled skip program
+          skip_value(&c, sk_prog, sk_off, a, 0);
+          break;
+        }
+        case 8: {  // generic numeric scalar
+          int32_t slot = a & 0xFF, kind = (a >> 8) & 0xFF;
+          int32_t mode = (a >> 16) & 0xFF;
+          bool present = true;
+          if (mode != 0) {
+            int64_t branch = read_long(&c);
+            if (branch < 0 || branch > 1) {
+              c.ok = false;
+              break;
+            }
+            present = (mode == 1) ? (branch == 1) : (branch == 0);
+          }
+          if (present && c.ok) {
+            double v = kind == 0 ? read_double(&c)
+                       : kind == 1 ? static_cast<double>(read_float(&c))
+                                   : static_cast<double>(read_long(&c));
+            if (c.ok) {
+              out->scalars[slot][rec] = v;
+              out->scalar_set[slot][rec] = 1;
+            }
+          }
+          break;
+        }
+        case 9: {  // generic entity column
+          int32_t ent = a & 0xFFFF, mode = (a >> 16) & 0xFF;
+          bool present = true;
+          if (mode != 0) {
+            int64_t branch = read_long(&c);
+            if (branch < 0 || branch > 1) {
+              c.ok = false;
+              break;
+            }
+            present = (mode == 1) ? (branch == 1) : (branch == 0);
+          }
+          if (present && c.ok) {
+            int64_t len;
+            const uint8_t* s = read_str(&c, &len);
+            if (c.ok) {
+              auto& arena = out->ent_arena[ent];
+              out->ent_offsets[ent][2 * rec] = arena.size();
+              out->ent_offsets[ent][2 * rec + 1] = len;
+              arena.insert(arena.end(), s, s + len);
+            }
+          }
+          break;
+        }
+        case 10: {  // map<string, double|float> feature bag
+          int vkind = ntv_value_kind[a];
+          for (;;) {
+            int64_t bn = read_long(&c);
+            if (!c.ok || bn == 0) break;
+            if (bn < 0) {
+              read_long(&c);  // block byte size
+              bn = -bn;
+            }
+            for (int64_t k = 0; k < bn && c.ok; ++k) {
+              int64_t klen;
+              const uint8_t* kp = read_str(&c, &klen);
+              double value = vkind ? read_float(&c) : read_double(&c);
+              if (!c.ok) break;
+              uint64_t off = key_arena.size();
+              key_arena.insert(key_arena.end(), kp, kp + klen);
+              bag_entries[a].push_back(BagEntry{
+                  off, static_cast<uint32_t>(klen),
+                  static_cast<float>(value)});
+            }
+          }
           break;
         }
         default:

@@ -150,13 +150,16 @@ class TestNativeIngest:
         _assert_same(gd_n, maps, gd_p, maps)
 
     def test_unplannable_schema_falls_back(self, tmp_path, gd_config, rng):
-        # A record field type the plan compiler refuses (map) → native path
-        # returns None and read_game_data(use_native=True) raises.
+        # Unconsumed fields of any shape now skip natively; what remains
+        # unplannable is a CONSUMED field outside the supported shapes —
+        # here a 3-branch union response → native returns None and
+        # read_game_data(use_native=True) raises.
         schema = training_example_schema(feature_bags=("features", "ctx"),
                                          entity_fields=("userId",))
-        schema["fields"].append(
-            {"name": "extra", "type": {"type": "map", "values": "double"}})
-        recs = [dict(r, extra={"k": 1.0}) for r in _fixture_records(rng, 10)]
+        for f in schema["fields"]:
+            if f["name"] == "response":
+                f["type"] = ["null", "double", "string"]
+        recs = _fixture_records(rng, 10)
         path = tmp_path / "odd.avro"
         write_avro(path, recs, schema)
         with pytest.raises(RuntimeError):
@@ -213,3 +216,125 @@ class TestNativeIngest:
         gd_p, maps_p = read_game_data(d, gd_config, use_native=False)
         gd_n, maps_n = read_game_data(d, gd_config, use_native=True)
         _assert_same(gd_n, maps_n, gd_p, maps_p)
+
+
+class TestWidenedPlanner:
+    """Round-4 planner widening: unconsumed fields of ANY shape skip
+    natively (generic skip programs), scalars/entities accept more union
+    shapes, and map-typed feature bags decode natively. Each case pins
+    native == pure-Python exactly."""
+
+    def _parity(self, tmp_path, schema, recs, config):
+        path = tmp_path / "wide.avro"
+        write_avro(path, recs, schema, block_records=64)
+        gd_n, maps_n = read_game_data(path, config, use_native=True)
+        gd_p, maps_p = read_game_data(path, config, use_native=False)
+        _assert_same(gd_n, maps_n, gd_p, maps_p)
+        return gd_n
+
+    def test_exotic_unconsumed_fields_stay_native(self, tmp_path, rng,
+                                                  gd_config):
+        """Nested records, wide unions, enums, fixed, maps, arrays of
+        records — all UNCONSUMED — no longer knock the job off the native
+        road (the round-3 ~10-20x cliff)."""
+        schema = training_example_schema(feature_bags=("features", "ctx"),
+                                         entity_fields=("userId",))
+        schema["fields"] += [
+            {"name": "meta", "type": {
+                "type": "record", "name": "Meta", "fields": [
+                    {"name": "a", "type": "long"},
+                    {"name": "b", "type": ["null", "string", "double"]},
+                    {"name": "inner", "type": {
+                        "type": "record", "name": "Inner", "fields": [
+                            {"name": "xs", "type": {"type": "array",
+                                                    "items": "double"}},
+                        ]}},
+                ]}},
+            {"name": "tags", "type": {"type": "map", "values": "string"}},
+            {"name": "kind", "type": {"type": "enum", "name": "Kind",
+                                      "symbols": ["A", "B", "C"]}},
+            {"name": "blob", "type": {"type": "fixed", "name": "Blob",
+                                      "size": 6}},
+            {"name": "flag", "type": "boolean"},
+        ]
+        recs = [dict(r,
+                     meta={"a": i, "b": ("s" if i % 3 == 0 else
+                                         (None if i % 3 == 1 else 2.5)),
+                           "inner": {"xs": [1.0] * (i % 4)}},
+                     tags={f"t{j}": "v" for j in range(i % 3)},
+                     kind="ABC"[i % 3],
+                     blob=b"\x01\x02\x03\x04\x05\x06",
+                     flag=bool(i % 2))
+                for i, r in enumerate(_fixture_records(rng, 120))]
+        self._parity(tmp_path, schema, recs, gd_config)
+        # and it really is the native path: forcing it must NOT raise
+        path = tmp_path / "wide.avro"
+        read_game_data(path, gd_config, use_native=True)
+
+    def test_map_typed_feature_bag(self, tmp_path, rng):
+        """map<string,double> feature bags decode natively; map key =
+        feature name, empty term (reference: makeFeatures handles both
+        bag field shapes)."""
+        schema = training_example_schema(feature_bags=("features",),
+                                         entity_fields=("userId",))
+        for f in schema["fields"]:
+            if f["name"] == "features":
+                f["type"] = {"type": "map", "values": "double"}
+        rng2 = np.random.default_rng(5)
+        recs = [{
+            "response": float(i % 2), "offset": None, "weight": None,
+            "uid": f"u{i}", "userId": f"user{i % 7}",
+            "features": {f"m{int(j)}": float(rng2.normal())
+                         for j in rng2.choice(25, size=4, replace=False)},
+        } for i in range(150)]
+        config = GameDataConfig(
+            shards={"all": FeatureShardConfig(bags=("features",))},
+            entity_fields=("userId",))
+        gd = self._parity(tmp_path, schema, recs, config)
+        assert gd.y.shape == (150,)
+
+    def test_widened_scalar_and_entity_shapes(self, tmp_path, rng):
+        """float response, [long,null] weight, plain-string entity — all
+        consumed natively now."""
+        schema = training_example_schema(feature_bags=("features",),
+                                         entity_fields=("userId",))
+        for f in schema["fields"]:
+            if f["name"] == "response":
+                f["type"] = "float"
+            elif f["name"] == "weight":
+                f["type"] = ["long", "null"]
+            elif f["name"] == "userId":
+                f["type"] = "string"
+        recs = []
+        for i, r in enumerate(_fixture_records(rng, 100)):
+            r = dict(r, response=float(i % 2), weight=(i % 5) or None)
+            del r["ctx"]
+            recs.append(r)
+        config = GameDataConfig(
+            shards={"all": FeatureShardConfig(bags=("features",))},
+            entity_fields=("userId",))
+        self._parity(tmp_path, schema, recs, config)
+
+
+def test_deeply_nested_skip_refuses_at_plan_time():
+    """Schemas nested past the C++ VM's recursion guard must refuse at
+    PLAN time (Python fallback), never mid-decode on valid data."""
+    from photon_tpu.data.native_ingest import compile_plan
+
+    t = "double"
+    for i in range(70):
+        t = {"type": "record", "name": f"N{i}",
+             "fields": [{"name": "x", "type": t}]}
+    schema = training_example_schema(feature_bags=("features",))
+    schema["fields"].append({"name": "deep", "type": t})
+    cfg = GameDataConfig(
+        shards={"all": FeatureShardConfig(bags=("features",))})
+    assert compile_plan(schema, cfg) is None
+    # one level inside the guard still plans
+    t2 = "double"
+    for i in range(30):
+        t2 = {"type": "record", "name": f"M{i}",
+              "fields": [{"name": "x", "type": t2}]}
+    schema2 = training_example_schema(feature_bags=("features",))
+    schema2["fields"].append({"name": "deep", "type": t2})
+    assert compile_plan(schema2, cfg) is not None
