@@ -27,9 +27,11 @@ from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap
 from photon_tpu.data.ingest import GameDataConfig
 from photon_tpu.game.dataset import GameData
 
-# ops understood by the C++ decoder (see photon_native.cc)
-_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_OPT_STR_SKIP, _OP_ENTITY, _OP_BAG, \
-    _OP_STR_SKIP, _OP_LONG_SKIP, _OP_GENERIC_SKIP, _OP_SCALAR_GEN, \
+# ops understood by the C++ decoder (see photon_native.cc). Slots 2/5/6
+# are RETIRED single-shape skips superseded by the generic skip (op 7);
+# the numbers stay reserved so op ids are stable across versions.
+_OP_DOUBLE, _OP_OPT_DOUBLE, _OP_RETIRED_2, _OP_ENTITY, _OP_BAG, \
+    _OP_RETIRED_5, _OP_RETIRED_6, _OP_GENERIC_SKIP, _OP_SCALAR_GEN, \
     _OP_ENTITY_GEN, _OP_BAG_MAP = range(11)
 
 # skip-program bytecodes (photon_native.cc::skip_value)

@@ -407,6 +407,7 @@ def stream_to_device(
     feature_dtype=None,
     chunk_hook=None,
     n_rows: Optional[int] = None,
+    _local_mask=None,
 ) -> tuple[GameData, int]:
     """Stream a dataset STRAIGHT into its device placement.
 
@@ -420,6 +421,14 @@ def stream_to_device(
     peak = one shard + one chunk, not the dataset. Rows pad (weight 0) to a
     device multiple, entity ids pad with "". Without a mesh: one
     preallocated buffer and a single transfer.
+
+    MULTI-HOST safe: only shards for THIS process's addressable devices
+    are filled and device_put (rows belonging to other processes stream
+    past without materializing), and the global array assembles from the
+    local shards via `make_array_from_single_device_arrays` — every
+    process must run the same stream_to_device call, as with any jax
+    multi-controller collective. Entity-id columns stay host-side and
+    GLOBAL on every process (they factorize on host for entity bucketing).
 
     `feature_dtype` (e.g. jnp.bfloat16) casts feature VALUES as chunks
     arrive — the storage-dtype path of data.dataset.cast_features without a
@@ -447,6 +456,17 @@ def stream_to_device(
     n_local = n_pad // n_dev
     devices = (list(mesh.devices.reshape(-1)) if mesh is not None
                else [None])
+    proc = jax.process_index()
+    # _local_mask is the single-process test seam for the multi-host slot
+    # arithmetic (a CPU test cannot make real devices non-addressable)
+    local_mask = ([d is None or d.process_index == proc for d in devices]
+                  if _local_mask is None else list(_local_mask))
+    if not any(local_mask):
+        raise ValueError(
+            f"stream_to_device: no device in the mesh is addressable from "
+            f"process {proc} — every process of a multi-host program must "
+            "own at least one mesh device (run the same call on each "
+            "process)")
 
     # Per-shard layout decided ONCE from the frozen maps (chunk-independent).
     dense_shards = {s: index_maps[s].n_features <= cfg.dense_threshold
@@ -472,20 +492,31 @@ def stream_to_device(
     mat_parts: dict = {s: [] for s in config.shards}
     entity_cols: dict = {e: [] for e in config.entity_fields}
 
-    def ship(buf):
-        """device_put one completed local shard onto its device."""
-        scal, mats = buf
-        dev = devices[len(scal_parts["y"])] if mesh is not None else None
-        for k in SCALARS:
-            scal_parts[k].append(jax.device_put(scal[k], dev))
-        for s, v in mats.items():
-            if isinstance(v, tuple):
-                mat_parts[s].append(tuple(jax.device_put(a, dev)
-                                          for a in v))
-            else:
-                mat_parts[s].append(jax.device_put(v, dev))
+    dev_i = 0  # global device-slot cursor (advances on every slot)
 
-    buf = alloc_local()
+    def ship(buf):
+        """device_put one completed shard onto its device; a None buf is a
+        slot another process owns — just advance past it."""
+        nonlocal dev_i
+        if buf is not None:
+            scal, mats = buf
+            dev = devices[dev_i] if mesh is not None else None
+            for k in SCALARS:
+                scal_parts[k].append(jax.device_put(scal[k], dev))
+            for s, v in mats.items():
+                if isinstance(v, tuple):
+                    mat_parts[s].append(tuple(jax.device_put(a, dev)
+                                              for a in v))
+                else:
+                    mat_parts[s].append(jax.device_put(v, dev))
+        dev_i += 1
+
+    def alloc_slot():
+        """Fill buffer for device slot `dev_i`; None when that slot belongs
+        to another process (its rows stream past without materializing)."""
+        return alloc_local() if local_mask[min(dev_i, n_dev - 1)] else None
+
+    buf = alloc_slot()
     filled = 0  # rows filled in the current local buffer
     row = 0     # global row cursor
 
@@ -513,34 +544,36 @@ def stream_to_device(
                            else (np.asarray(X.indices), np.asarray(X.values)))
         while c0 < n_c:
             take = min(n_c - c0, n_local - filled)
-            sl = slice(c0, c0 + take)
-            dst = slice(filled, filled + take)
-            scal, mats = buf
-            for k in SCALARS:
-                scal[k][dst] = host_scal[k][sl]
-            for s in config.shards:
-                if dense_shards[s]:
-                    mats[s][dst] = host_mat[s][sl].astype(f_dtype)
-                else:
-                    ind, val = mats[s]
-                    h_ind, h_val = host_mat[s]
-                    k_c = h_ind.shape[1]
-                    ind[dst, :k_c] = h_ind[sl]
-                    val[dst, :k_c] = h_val[sl].astype(f_dtype)
+            if buf is not None:  # a None buf = another process's slot
+                sl = slice(c0, c0 + take)
+                dst = slice(filled, filled + take)
+                scal, mats = buf
+                for k in SCALARS:
+                    scal[k][dst] = host_scal[k][sl]
+                for s in config.shards:
+                    if dense_shards[s]:
+                        mats[s][dst] = host_mat[s][sl].astype(f_dtype)
+                    else:
+                        ind, val = mats[s]
+                        h_ind, h_val = host_mat[s]
+                        k_c = h_ind.shape[1]
+                        ind[dst, :k_c] = h_ind[sl]
+                        val[dst, :k_c] = h_val[sl].astype(f_dtype)
             filled += take
             c0 += take
             row += take
             if filled == n_local and mesh is not None:
                 ship(buf)
-                buf = alloc_local() if row < n_real else None
+                buf = alloc_slot() if row < n_real else None
                 filled = 0
-    if buf is not None and (filled or not scal_parts["y"]):
-        ship(buf)
 
     if mesh is not None:
-        # pad the tail: remaining devices get all-zero (weight-0) shards
-        while len(scal_parts["y"]) < n_dev:
-            ship(alloc_local())
+        if filled:  # partial tail shard (None when the slot isn't ours)
+            ship(buf)
+        # remaining devices get all-zero (weight-0) shards; slots owned by
+        # other processes just advance
+        while dev_i < n_dev:
+            ship(alloc_slot())
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -555,6 +588,9 @@ def stream_to_device(
             return jax.make_array_from_single_device_arrays(
                 shape, NamedSharding(mesh, spec), parts)
     else:
+        if filled or not scal_parts["y"]:
+            ship(buf)
+
         def assemble(parts):
             return (tuple(parts[0]) if isinstance(parts[0], tuple)
                     else parts[0])

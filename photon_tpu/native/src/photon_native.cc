@@ -248,11 +248,11 @@ void* ph_store_open(const char* path) {
 // field order:
 //   op 0: double scalar            -> scalar column aux (0=y, 1=offset, 2=weight)
 //   op 1: union[null, double]      -> scalar column aux (null leaves default)
-//   op 2: union[null, string] skip -> (uid etc.)
+//   op 2: RETIRED (was opt-string skip; op 7 covers it)
 //   op 3: union[null, string]      -> entity column aux
 //   op 4: array<NameTermValue>     -> feature COO; aux = bag index
-//   op 5: string skip
-//   op 6: long/int skip
+//   op 5: RETIRED (was string skip; op 7 covers it)
+//   op 6: RETIRED (was long/int skip; op 7 covers it)
 //   op 7: generic skip             -> aux = skip-program id (see below)
 //   op 8: generic numeric scalar   -> aux packs slot | kind<<8 | mode<<16
 //         kind 0=double 1=float 2=varint(int/long); mode 0=plain,
@@ -476,24 +476,24 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
           out->scalar_set[a][rec] = 1;
           break;
         }
-        case 1: {
+        case 1: {  // [null, double]: branch outside {0,1} = corruption
           int64_t branch = read_long(&c);
-          if (branch == 1) {  // plan builder normalizes null to branch 0
+          if (branch < 0 || branch > 1) {
+            c.ok = false;
+            break;
+          }
+          if (branch == 1) {
             out->scalars[a][rec] = read_double(&c);
             out->scalar_set[a][rec] = 1;
           }
           break;
         }
-        case 2: {
-          int64_t branch = read_long(&c);
-          if (branch == 1) {
-            int64_t len;
-            read_str(&c, &len);
-          }
-          break;
-        }
         case 3: {
           int64_t branch = read_long(&c);
+          if (branch < 0 || branch > 1) {
+            c.ok = false;
+            break;
+          }
           if (branch == 1) {
             int64_t len;
             const uint8_t* s = read_str(&c, &len);
@@ -535,15 +535,9 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
           }
           break;
         }
-        case 5: {
-          int64_t len;
-          read_str(&c, &len);
-          break;
-        }
-        case 6: {
-          read_long(&c);
-          break;
-        }
+        // ops 2/5/6 (opt-string/string/long skips) are RETIRED: the plan
+        // builder emits generic skip programs (op 7) for every unconsumed
+        // field; their numbers stay reserved so op ids remain stable.
         case 7: {  // generic skip via compiled skip program
           skip_value(&c, sk_prog, sk_off, a, 0);
           break;

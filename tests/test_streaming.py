@@ -236,3 +236,71 @@ class TestStreamToDevice:
             batch, TaskType.LOGISTIC_REGRESSION,
             OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0))
         assert np.isfinite(np.asarray(model.coefficients.means)).all()
+
+
+class TestMultiHostShardMath:
+    """Multi-host-safe stream_to_device (VERDICT r3 item 6): only the
+    process's addressable slots fill + device_put; the global assembly
+    gets exactly the local shards. Simulated single-process through the
+    documented `_local_mask` seam (CPU tests cannot make real devices
+    non-addressable)."""
+
+    def test_only_local_slots_materialize(self, tmp_path, mesh8,
+                                          monkeypatch):
+        import jax
+
+        root = _write_files(tmp_path, n_files=2, rows_per_file=400)
+        config = _config()
+        one_shot, maps = read_game_data(str(root), config)
+        n_real = one_shot.n  # 800 -> n_local = 100 on 8 devices
+        mask = [True, False, True, False, True, False, True, False]
+
+        captured = {}
+
+        def fake_assemble(shape, sharding, parts):
+            captured.setdefault("calls", []).append((shape, len(parts)))
+            return np.concatenate([np.asarray(p) for p in parts])
+
+        monkeypatch.setattr(jax, "make_array_from_single_device_arrays",
+                            fake_assemble)
+        data, got_real = stream_to_device(
+            str(root), config, maps, mesh=mesh8, chunk_rows=250,
+            _local_mask=mask)
+        assert got_real == n_real
+        n_local = 100
+        # every assembled column got exactly the 4 LOCAL shards
+        assert all(n_parts == 4 for _, n_parts in captured["calls"])
+        # and they hold exactly this process's slots' rows: 0-99, 200-299,
+        # 400-499, 600-699 of the global padded layout
+        want_rows = np.concatenate(
+            [np.arange(s * n_local, (s + 1) * n_local)
+             for s in range(8) if mask[s]])
+        np.testing.assert_array_equal(np.asarray(data.y),
+                                      one_shot.y[want_rows])
+        np.testing.assert_array_equal(
+            np.asarray(data.shards["dense"]),
+            np.asarray(one_shot.shards["dense"])[want_rows])
+        # entity ids stay GLOBAL on every process
+        assert data.entity_ids["member"].shape[0] == 800
+
+    def test_no_addressable_device_gate(self, tmp_path, mesh8):
+        root = _write_files(tmp_path, n_files=1, rows_per_file=50)
+        config = _config()
+        maps = build_index_maps_streaming(str(root), config)
+        with pytest.raises(ValueError, match="addressable"):
+            stream_to_device(str(root), config, maps, mesh=mesh8,
+                             _local_mask=[False] * 8)
+
+    def test_full_mask_matches_default(self, tmp_path, mesh8):
+        """All-local mask (the single-process case) is the existing
+        behavior bit for bit."""
+        root = _write_files(tmp_path, n_files=1, rows_per_file=160)
+        config = _config()
+        one_shot, maps = read_game_data(str(root), config)
+        a, _ = stream_to_device(str(root), config, maps, mesh=mesh8,
+                                chunk_rows=100)
+        b, _ = stream_to_device(str(root), config, maps, mesh=mesh8,
+                                chunk_rows=100, _local_mask=[True] * 8)
+        np.testing.assert_array_equal(np.asarray(a.y), np.asarray(b.y))
+        np.testing.assert_array_equal(np.asarray(a.shards["dense"]),
+                                      np.asarray(b.shards["dense"]))
