@@ -48,7 +48,12 @@ from photon_tpu.optim.regularization import l2
 BASELINE_CLUSTER_ROWS_ITERS_PER_SEC = 1.0e6
 
 # --- sparse leg (headline): the north-star shape --------------------------
-S_ROWS = 1 << 19        # 524288
+# 1M rows (round 4, was 524k): benches/roofline.py measured
+# t_iter ≈ 19.4 ms of d-linear solver-state work + 59.3 ns/row of X-pass
+# work, so more rows amortize the d-term directly — 1.03e7 → 1.29e7
+# rows·iters/s at 1M (1.46e7 at 2M, but its ~5 min data load isn't worth
+# +13% on a bench the driver reruns every round).
+S_ROWS = 1 << 20        # 1048576
 S_FEATURES = 10_000_000
 S_NNZ = 32              # per row, + intercept
 S_ZIPF = 1.4            # power-law exponent of column frequencies
@@ -64,10 +69,10 @@ D_GRID = list(np.geomspace(1e-4, 1e-2, 16))  # 16 reg weights, one program
 REPS = 5  # keep the best: tunnel throughput drifts ±30% between runs
 
 
-def sparse_problem(seed: int = 0):
+def sparse_problem(seed: int = 0, rows: int = S_ROWS):
     """Power-law 10M-feature logistic rows with a planted hot-end signal."""
     rng = np.random.default_rng(seed)
-    n, k, d = S_ROWS, S_NNZ, S_FEATURES
+    n, k, d = rows, S_NNZ, S_FEATURES
     col = (rng.zipf(S_ZIPF, size=(n, k)).astype(np.int64) - 1) % (d - 1)
     val = rng.normal(size=(n, k)).astype(np.float32)
     ind = np.concatenate([col, np.full((n, 1), d - 1)], axis=1).astype(
@@ -116,6 +121,7 @@ def _best_of(fn) -> tuple:
 
 
 def run_sparse(batch) -> float:
+    rows = int(batch.y.shape[0])  # derived: a stale rows= can't skew the JSON
     cfg = OptimizerConfig(max_iters=S_ITERS, tolerance=0.0, reg=l2(),
                           reg_weight=1e-3, history=5)
 
@@ -128,7 +134,7 @@ def run_sparse(batch) -> float:
         return jax.device_get((jnp.sum(res.w), res.iterations))
 
     best, (_, iters) = _best_of(once)
-    return S_ROWS * int(iters) / best
+    return rows * int(iters) / best
 
 
 def run_dense(batch) -> float:

@@ -1,0 +1,81 @@
+"""Roofline accounting + row scaling for the headline sparse leg
+(VERDICT r3 item 5): how much of each iteration is data-proportional X
+work vs d-linear solver-state bookkeeping, what HBM bandwidth the chip
+actually achieves, and how throughput grows as rows amortize the d-term.
+
+Per margin-cached L-BFGS iteration the traffic model is:
+  X passes: 2 x (hot dense block n x 1024 bf16 + COO tail ~n*33*(4+2)B)
+  state:    two-loop recursion reads 2m (d,) f32 vectors + ~6 more (d,)
+            touches (w/g/s/y updates, dot products), d = 10M, m = 5
+so t_iter ≈ t_state + n * b_row / BW. Measuring rows·iters/s at several
+row counts fits both terms directly.
+
+Run: python benches/roofline.py [--rows 524288 1048576 2097152]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, nargs="+",
+                   default=[1 << 19, 1 << 20, 1 << 21])
+    args = p.parse_args()
+
+    import jax
+
+    import bench
+
+    results = []
+    for n in args.rows:
+        t0 = time.perf_counter()
+        batch = bench.sparse_problem(rows=n)
+        jax.block_until_ready(batch.X.dense)
+        t_load = time.perf_counter() - t0
+        value = bench.run_sparse(batch)
+        iters_per_s = value / n
+        t_iter = 1.0 / iters_per_s
+        # bytes per iteration: 2 X passes + L-BFGS state traffic. Tail
+        # bytes come from the ACTUAL compacted tail (to_hybrid keeps only
+        # cold nnz there — ~5% of the 33/row; counting all of them would
+        # overstate achieved bandwidth ~9%).
+        hot = n * bench.S_DENSE * 2              # bf16 dense block
+        tail = int(batch.X.tail_rows.nbytes + batch.X.tail_cols.nbytes
+                   + batch.X.tail_vals.nbytes)
+        x_bytes = 2 * (hot + tail)
+        state_bytes = (2 * 5 + 6) * bench.S_FEATURES * 4
+        gbs = (x_bytes + state_bytes) / t_iter / 1e9
+        print(f"rows={n:>8d}: {value:.3e} rows*iters/s  "
+              f"({t_iter * 1e3:.1f} ms/iter, load {t_load:.0f}s, "
+              f"~{gbs:.0f} GB/s vs 819 peak)")
+        results.append((n, t_iter))
+        del batch
+
+    if len(results) >= 2:
+        # least-squares fit t_iter = t_state + n * t_row
+        ns = np.array([r[0] for r in results], np.float64)
+        ts = np.array([r[1] for r in results], np.float64)
+        A = np.stack([np.ones_like(ns), ns], axis=1)
+        (t_state, t_row), *_ = np.linalg.lstsq(A, ts, rcond=None)
+        print(f"fit: t_iter ≈ {t_state * 1e3:.1f} ms (d-linear state) + "
+              f"rows × {t_row * 1e9:.2f} ns/row")
+        # per-row X bytes from the last measured problem's real tail share
+        bw_rows = (bench.S_DENSE * 2 + tail / ns[-1]) * 2 / t_row
+        print(f"  X-pass effective bandwidth: {bw_rows / 1e9:.0f} GB/s; "
+              f"state share at 524k rows: "
+              f"{t_state / (t_state + (1 << 19) * t_row) * 100:.0f}%, "
+              f"at 2M rows: "
+              f"{t_state / (t_state + (1 << 21) * t_row) * 100:.0f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,16 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
     if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows)):
-        X = jnp.asarray(X, jnp.float32)
+        import jax
+
+        # host numpy transfers as f32; an already-device FLOATING array
+        # keeps its storage dtype (a bf16 shard must not silently double
+        # its HBM through an f32 upcast — matvec accumulates f32 either
+        # way). Integer device arrays still normalize to f32: matvec
+        # would otherwise truncate w to the feature dtype.
+        if not (isinstance(X, jax.Array)
+                and jnp.issubdtype(X.dtype, jnp.floating)):
+            X = jnp.asarray(X, jnp.float32)
     return GLMBatch(X, y, jnp.asarray(weights, jnp.float32),
                     jnp.asarray(offsets, jnp.float32))
 

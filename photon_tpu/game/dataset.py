@@ -137,8 +137,18 @@ class FixedEffectDataset:
 
     @staticmethod
     def build(data: GameData, shard_name: str) -> "FixedEffectDataset":
+        import jax
+
         X = data.shards[shard_name]
-        if not isinstance(X, (SparseRows, HybridRows)):
+        if not isinstance(X, (SparseRows, HybridRows)) and not (
+                isinstance(X, jax.Array)
+                and jnp.issubdtype(X.dtype, jnp.floating)):
+            # host numpy (and integer device arrays) transfer/normalize as
+            # f32; an already-device FLOATING array keeps its STORAGE
+            # dtype — a bf16 shard placed by stream_to_device / device_put
+            # must not round-trip through an f32 upcast (matvec handles
+            # bf16 operands with f32 accumulation), while an int shard
+            # must not truncate w via matvec's w.astype(X.dtype)
             X = jnp.asarray(X, jnp.float32)
         return FixedEffectDataset(
             shard_name, X, jnp.asarray(data.y), jnp.asarray(data.weights)

@@ -128,3 +128,40 @@ class TestMixedPrecisionTraining:
         bs = cast_features(make_batch(from_scipy_csr(M), y))
         ps = pad_batch(bs, 128)
         assert ps.X.values.dtype == jnp.bfloat16
+
+
+class TestDeviceStorageDtypePreserved:
+    """Round 4: already-device FLOATING shards keep their storage dtype
+    (a bf16 shard must not double its HBM via an f32 upcast); integer
+    device arrays still normalize to f32 (matvec would truncate w to the
+    feature dtype otherwise)."""
+
+    def test_make_batch(self):
+        import jax
+        import jax.numpy as jnp
+
+        from photon_tpu.data.dataset import make_batch
+
+        Xb = jax.device_put(np.ones((8, 3), np.float32).astype(jnp.bfloat16))
+        y = np.zeros(8, np.float32)
+        assert make_batch(Xb, y).X.dtype == jnp.bfloat16
+        Xi = jax.device_put(np.ones((8, 3), np.int32))
+        assert make_batch(Xi, y).X.dtype == jnp.float32
+        assert make_batch(np.ones((8, 3), np.float64), y).X.dtype \
+            == jnp.float32
+
+    def test_fixed_effect_dataset(self):
+        import jax
+        import jax.numpy as jnp
+
+        from photon_tpu.game.dataset import FixedEffectDataset, GameData
+
+        y = np.zeros(8, np.float32)
+        for arr, want in (
+                (jax.device_put(np.ones((8, 3), np.float32
+                                        ).astype(jnp.bfloat16)),
+                 jnp.bfloat16),
+                (jax.device_put(np.ones((8, 3), np.int32)), jnp.float32),
+                (np.ones((8, 3), np.float32), jnp.float32)):
+            data = GameData.build(y, {"s": arr}, {})
+            assert FixedEffectDataset.build(data, "s").X.dtype == want
