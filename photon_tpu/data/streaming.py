@@ -98,10 +98,19 @@ def build_index_maps_streaming(
     todo = {s: cfg for s, cfg in config.shards.items() if s not in index_maps}
     if not todo:
         return index_maps
-    if not index_maps:  # all shards building: the native pass applies
-        nat = _build_maps_native(path, config)
-        if nat is not None:
-            return nat
+    # Native pass over EXACTLY the shards being built: a sub-config keeps
+    # only their bags and consumes nothing else — every other field
+    # (including the real response/entity columns and prebuilt shards'
+    # bags) generic-skips inside the C++ VM. Before round 4 one prebuilt
+    # map dropped this whole first pass to the per-record Python road.
+    sub = dataclasses.replace(config, shards=todo, entity_fields=(),
+                              response_field="\x00unconsumed",
+                              offset_field="\x00unconsumed",
+                              weight_field="\x00unconsumed")
+    nat = _build_maps_native(path, sub)
+    if nat is not None:
+        index_maps.update(nat)
+        return index_maps
     building = {s: IndexMap() for s in todo}
     bag_names = sorted({b for cfg in todo.values() for b in cfg.bags})
     for p in avro_paths(path):

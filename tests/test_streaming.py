@@ -304,3 +304,38 @@ class TestMultiHostShardMath:
         np.testing.assert_array_equal(np.asarray(a.y), np.asarray(b.y))
         np.testing.assert_array_equal(np.asarray(a.shards["dense"]),
                                       np.asarray(b.shards["dense"]))
+
+
+class TestSubsetNativeMapBuild:
+    def test_prebuilt_map_keeps_native_first_pass(self, tmp_path,
+                                                  monkeypatch):
+        """One prebuilt map no longer drops the map-building pass to the
+        per-record Python road: the native pass runs over exactly the
+        shards being built (everything else generic-skips)."""
+        from photon_tpu import native
+        import photon_tpu.data.streaming as streaming_mod
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        root = _write_files(tmp_path, n_files=2, rows_per_file=300)
+        config = _config()
+        full = build_index_maps_streaming(str(root), config)
+
+        calls = []
+        real = streaming_mod._build_maps_native
+
+        def spy(path, cfg):
+            out = real(path, cfg)
+            calls.append((tuple(cfg.shards), out is not None))
+            return out
+
+        monkeypatch.setattr(streaming_mod, "_build_maps_native", spy)
+        prebuilt = {"dense": full["dense"]}
+        maps = build_index_maps_streaming(str(root), config,
+                                          dict(prebuilt))
+        assert calls and calls[0][1], "subset native pass did not engage"
+        assert set(calls[0][0]) == set(config.shards) - {"dense"}
+        # ids identical to the all-python / all-native build
+        for s in config.shards:
+            assert maps[s].keys_in_order() == full[s].keys_in_order()
+        assert maps["dense"] is prebuilt["dense"]
