@@ -12,8 +12,10 @@ the native C++ decoder (pure-Python fallback), each chunk is padded to a
 quantized height (so XLA compiles a handful of shapes, not one per ragged
 chunk), scored in one device program, and appended to the output container
 via a VECTORIZED ScoredItemAvro block encoder — no per-record Python
-decode or encode loop anywhere on the hot path, and host memory stays
-bounded by one chunk + the accumulated score/label scalars.
+decode or encode loop anywhere on the hot path. The loop is a ONE-CHUNK
+software pipeline (chunk i's device program runs async while i+1 decodes
+on host), so host memory stays bounded by ~TWO in-flight chunks + the
+accumulated score/label scalars.
 """
 from __future__ import annotations
 
@@ -263,22 +265,22 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     n_chunks = 0
     with AvroBlockWriter(out_path, SCORED_ITEM_SCHEMA,
                          codec=params.output_codec) as writer:
-        for chunk in chunks:
-            n_c = chunk.n
-            mask = (stream.last_response_mask
-                    if stream.last_response_mask is not None
-                    else np.ones(n_c, bool))
-            padded = _pad_chunk(chunk, _quantize(n_c))
-            margin_dev = score_game(model, padded.to_device())
-            out_dev = model.mean(margin_dev) if params.output_mean \
-                else margin_dev
-            scores_c = np.asarray(out_dev, np.float64)[:n_c]
+        # ONE-CHUNK software pipeline: the device program for chunk i is
+        # dispatched ASYNC, then chunk i+1 decodes on host while it runs —
+        # the blocking readback of i happens only after i+1's decode. Over
+        # a high-latency link this overlaps the two halves of the loop
+        # (host decode+encode vs device compute+transfers) instead of
+        # serializing them. `pending` holds everything host-side for the
+        # in-flight chunk.
 
-            uids = np.asarray(chunk.entity_ids[params.uid_field])
+        def flush(pending) -> None:
+            nonlocal group_cols, n_rows, n_chunks
+            n_c, uids, y_host, w_host, ents_host, mask, margin_dev, \
+                out_dev = pending
+            scores_c = np.asarray(out_dev, np.float64)[:n_c]  # blocks here
             writer.write_block(n_c, encode_scored_block(
-                uids, scores_c, np.asarray(chunk.y, np.float64), mask,
+                uids, scores_c, np.asarray(y_host, np.float64), mask,
                 uids != ""))
-
             scores_acc.append(scores_c)
             if stream.saw_missing_response:
                 margins_acc.clear()
@@ -287,12 +289,46 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
                 group_cols = {}
             else:
                 margins_acc.append(np.asarray(margin_dev)[:n_c])
-                y_acc.append(np.asarray(chunk.y))
-                w_acc.append(np.asarray(chunk.weights))
+                y_acc.append(y_host)
+                w_acc.append(w_host)
                 for e in group_cols:
-                    group_cols[e].append(np.asarray(chunk.entity_ids[e]))
+                    group_cols[e].append(ents_host[e])
             n_rows += n_c
             n_chunks += 1
+
+        pending = None
+        try:
+            for chunk in chunks:
+                n_c = chunk.n
+                mask = (stream.last_response_mask
+                        if stream.last_response_mask is not None
+                        else np.ones(n_c, bool))
+                padded = _pad_chunk(chunk, _quantize(n_c))
+                margin_dev = score_game(model, padded.to_device())
+                out_dev = model.mean(margin_dev) if params.output_mean \
+                    else margin_dev
+                this = (n_c,
+                        np.asarray(chunk.entity_ids[params.uid_field]),
+                        np.asarray(chunk.y), np.asarray(chunk.weights),
+                        {e: np.asarray(chunk.entity_ids[e])
+                         for e in group_cols},
+                        mask, margin_dev, out_dev)
+                if pending is not None:
+                    flush(pending)
+                pending = this
+        except BaseException:
+            # a decode failure on chunk i+1 must not discard the already-
+            # scored in-flight chunk i from the partial output (the file
+            # users debug/resume from) — but its flush must never mask
+            # the original failure either
+            if pending is not None:
+                try:
+                    flush(pending)
+                except Exception:
+                    pass
+            raise
+        if pending is not None:
+            flush(pending)
 
     scores = (np.concatenate(scores_acc) if scores_acc
               else np.zeros(0, np.float64))

@@ -265,3 +265,32 @@ class TestScoringEdgeCases:
         assert out.scores.shape[0] == 200
         assert np.isfinite(out.scores).all()
         assert out.metric is not None
+
+
+class TestMidStreamFailure:
+    def test_partial_output_keeps_scored_chunks(self, trained_model,
+                                                tmp_path):
+        """A malformed block mid-stream raises, but every chunk scored
+        BEFORE the failure — including the pipeline's in-flight one — is
+        in the partial scores.avro (the file users debug/resume from)."""
+        root, model_dir = trained_model
+        d = root / "corrupt_job"
+        _write_scoring_parts(d, n_files=2, rows=150, seed=9)
+        # keep file 2's header valid but trash its block payloads
+        p2 = d / "part-1.avro"
+        raw = bytearray(p2.read_bytes())
+        for i in range(len(raw) // 2, len(raw) - 64, 7):
+            raw[i] ^= 0xFF
+        p2.write_bytes(bytes(raw))
+
+        with pytest.raises(ValueError):
+            _score(root, model_dir, d, tmp_path / "partial",
+                   chunk_rows=64)
+        rows = read_avro(str(tmp_path / "partial" / "scores.avro"))
+        # file 1 yields two complete 64-row chunks before the third chunk
+        # (file 1's 22-row tail + file 2's blocks) hits the corruption.
+        # WITHOUT the unwind flush the in-flight second chunk would be
+        # dropped and only 64 rows would survive.
+        assert len(rows) >= 128
+        assert rows[0]["uid"] == "r0_0"
+        assert rows[127]["uid"] == "r0_127"
