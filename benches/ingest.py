@@ -17,6 +17,14 @@ import time
 
 import numpy as np
 
+if os.environ.get("PHOTON_BENCH_CPU"):
+    # The axon TPU plugin overrides JAX_PLATFORMS env filtering; pin plain
+    # CPU before backend init (as tests/conftest.py does) so the decode
+    # numbers aren't contaminated by tunnel transfers in coo_to_matrix.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main() -> None:
     p = argparse.ArgumentParser()

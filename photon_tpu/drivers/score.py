@@ -314,18 +314,28 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
                          for e in group_cols},
                         mask, margin_dev, out_dev)
                 if pending is not None:
-                    flush(pending)
+                    # cleared BEFORE flushing: if the flush itself dies
+                    # mid-write, the unwind must not re-flush the same
+                    # chunk after a partial write_block (duplicate bytes
+                    # would corrupt the very file the unwind protects)
+                    done, pending = pending, None
+                    flush(done)
                 pending = this
-        except BaseException:
+        except Exception:
             # a decode failure on chunk i+1 must not discard the already-
             # scored in-flight chunk i from the partial output (the file
             # users debug/resume from) — but its flush must never mask
-            # the original failure either
+            # the original failure either. Exception, not BaseException: a
+            # Ctrl-C during a hung tunnel transfer must not trigger one
+            # more blocking readback over the same dead link.
             if pending is not None:
                 try:
                     flush(pending)
-                except Exception:
-                    pass
+                except Exception as e:
+                    log.warning(
+                        "unwind flush of the in-flight chunk failed (%s): "
+                        "the partial scores.avro is missing its final "
+                        "scored chunk", e)
             raise
         if pending is not None:
             flush(pending)
