@@ -36,6 +36,7 @@ import json
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.dataset import cast_features, make_batch
@@ -48,12 +49,13 @@ from photon_tpu.optim.regularization import l2
 BASELINE_CLUSTER_ROWS_ITERS_PER_SEC = 1.0e6
 
 # --- sparse leg (headline): the north-star shape --------------------------
-# 1M rows (round 4, was 524k): benches/roofline.py measured
+# 2M rows (round 4, was 524k): benches/roofline.py measured
 # t_iter ≈ 19.4 ms of d-linear solver-state work + 59.3 ns/row of X-pass
-# work, so more rows amortize the d-term directly — 1.03e7 → 1.29e7
-# rows·iters/s at 1M (1.46e7 at 2M, but its ~5 min data load isn't worth
-# +13% on a bench the driver reruns every round).
-S_ROWS = 1 << 20        # 1048576
+# work, so more rows amortize the d-term directly — 1.03e7 → 1.46e7
+# rows·iters/s from 524k → 2M. The on-device dense-block scatter
+# (to_hybrid device_dense_dtype) made the data load ~23 s at this size
+# (it was minutes when the materialized block crossed the tunnel).
+S_ROWS = 1 << 21        # 2097152
 S_FEATURES = 10_000_000
 S_NNZ = 32              # per row, + intercept
 S_ZIPF = 1.4            # power-law exponent of column frequencies
@@ -84,9 +86,14 @@ def sparse_problem(seed: int = 0, rows: int = S_ROWS):
     w_true[d - 1] = -0.2
     margin = np.einsum("nk,nk->n", va, w_true[ind])
     y = (rng.uniform(size=n) < 1 / (1 + np.exp(-margin))).astype(np.float32)
-    H = to_hybrid(SparseRows(ind, va, d), S_DENSE)  # host-side split
-    # bf16 storage BEFORE the transfer: half the bytes over the link and in
-    # HBM; contractions accumulate f32 (data.matrix preferred_element_type).
+    # The hot dense block builds ON DEVICE from the compact hot COO
+    # (device_dense_dtype): the link carries ~0.8 GB of triples (12 B/hot
+    # nnz) instead of the materialized 4.3 GB bf16 block (~5x fewer
+    # bytes) — data load dropped from minutes to ~23 s over the tunnel.
+    # Tail/scalars still cast bf16 on host first (cast_features), then
+    # one device_put.
+    H = to_hybrid(SparseRows(ind, va, d), S_DENSE,
+                  device_dense_dtype=jnp.bfloat16)
     return jax.device_put(cast_features(make_batch(H, y)))
 
 

@@ -156,13 +156,28 @@ class ShardedHybridRows:
 Matrix = jax.Array | SparseRows | HybridRows | ShardedHybridRows
 
 
-def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
+@partial(jax.jit, static_argnames=("n", "d", "dtype"))
+def _dense_scatter(r, p, v, n, d, dtype):
+    """Hot-COO → (n, d) dense block, f32 scatter-add then storage cast."""
+    return jnp.zeros((n, d), jnp.float32).at[r, p].add(v).astype(dtype)
+
+
+def to_hybrid(X: SparseRows, d_dense: int = 1024,
+              device_dense_dtype=None) -> HybridRows:
     """Split a SparseRows into (hot dense block, cold sparse tail).
 
     Selects the `d_dense` columns with the most nonzeros (host-side pass
     over the padded COO); the remaining nnz are COMPACTED into exact-size
     flat row-sorted COO (tail_rows/tail_cols/tail_vals) — per-row padding
     would cost as much as real nnz on the gather path.
+
+    `device_dense_dtype` (e.g. jnp.bfloat16) builds the dense hot block ON
+    DEVICE by scattering the compact hot COO (f32 accumulation, then cast):
+    the link carries 12 bytes per hot nnz (i32 row + i32 slot + f32 val)
+    instead of the materialized n×d_dense block — ~5× fewer tunnel bytes
+    at the bench's power-law density, and no host materialization. The
+    returned HybridRows then has a device `dense` leaf and host tail
+    leaves (device_put'ing it later is a no-op for the big block).
     """
     ind = np.asarray(X.indices)
     val = np.asarray(X.values)
@@ -178,20 +193,28 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024) -> HybridRows:
     pos = col_to_pos[ind]  # (n, k); -1 = stays sparse
     hot = (pos >= 0) & nnz_mask
     rows = np.repeat(np.arange(n), k).reshape(n, k)
-    # bincount over flat (row, pos) ids: C-speed accumulation — np.add.at
-    # is an order of magnitude slower at the 10M-feature bench scale.
-    # Chunked over row ranges so the float64 bincount scratch stays bounded
-    # (~1 GB) at billion-cell n×d_sel scale.
-    dense = np.empty((n, d_sel), np.float32)
-    row_chunk = max(1, (1 << 27) // max(d_sel, 1))
-    for r0 in range(0, n, row_chunk):
-        r1 = min(n, r0 + row_chunk)
-        h = hot[r0:r1]
-        flat_ids = ((rows[r0:r1][h] - r0) * np.int64(d_sel) + pos[r0:r1][h])
-        dense[r0:r1] = np.bincount(
-            flat_ids, weights=val[r0:r1][h].astype(np.float64),
-            minlength=(r1 - r0) * d_sel,
-        ).astype(np.float32).reshape(r1 - r0, d_sel)
+    if device_dense_dtype is not None:
+        dense = _dense_scatter(
+            jnp.asarray(rows[hot].astype(np.int32)),
+            jnp.asarray(pos[hot].astype(np.int32)),
+            jnp.asarray(val[hot].astype(np.float32)),
+            n, d_sel, device_dense_dtype)
+    else:
+        # bincount over flat (row, pos) ids: C-speed accumulation —
+        # np.add.at is an order of magnitude slower at the 10M-feature
+        # bench scale. Chunked over row ranges so the float64 bincount
+        # scratch stays bounded (~1 GB) at billion-cell n×d_sel scale.
+        dense = np.empty((n, d_sel), np.float32)
+        row_chunk = max(1, (1 << 27) // max(d_sel, 1))
+        for r0 in range(0, n, row_chunk):
+            r1 = min(n, r0 + row_chunk)
+            h = hot[r0:r1]
+            flat_ids = ((rows[r0:r1][h] - r0) * np.int64(d_sel)
+                        + pos[r0:r1][h])
+            dense[r0:r1] = np.bincount(
+                flat_ids, weights=val[r0:r1][h].astype(np.float64),
+                minlength=(r1 - r0) * d_sel,
+            ).astype(np.float32).reshape(r1 - r0, d_sel)
     # Flat row-sorted COO tail: exactly the cold nnz, no per-row padding
     # (row-major traversal keeps rows ascending for the sorted segment_sum
     # in matvec). One zero sentinel entry keeps the arrays non-empty.
