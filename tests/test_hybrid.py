@@ -297,3 +297,19 @@ class TestDeviceDenseBuild:
         np.testing.assert_array_equal(
             np.asarray(dev.dense, np.float32),
             np.asarray(host.X.dense, np.float32))
+
+    def test_chunked_scatter_matches(self, monkeypatch):
+        """The row-chunked device scatter (bounded f32 intermediate) is
+        identical to the one-shot scatter."""
+        import photon_tpu.data.matrix as matrix_mod
+
+        rng = np.random.default_rng(5)
+        n, k, d = 700, 6, 4000
+        ind = rng.integers(0, d, (n, k)).astype(np.int32)
+        val = rng.normal(size=(n, k)).astype(np.float32)
+        X = SparseRows(ind, val, d)
+        one_shot = to_hybrid(X, 48, device_dense_dtype=jnp.float32)
+        monkeypatch.setattr(matrix_mod, "_SCATTER_CHUNK_ELEMS", 48 * 128)
+        chunked = to_hybrid(X, 48, device_dense_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(one_shot.dense),
+                                      np.asarray(chunked.dense))
