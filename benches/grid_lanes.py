@@ -1,7 +1,16 @@
-"""Grid-lane scaling (docs/PERF.md's table): throughput of the vmapped
-reg-weight sweep vs lane count on the headline bench problem.
+"""Grid-lane scaling (docs/PERF.md's tables): aggregate throughput of the
+vmapped reg-weight sweep vs lane count, on either headline leg.
 
-Run: python benches/grid_lanes.py [--lanes 8 16 32]
+The sparse leg is the round-5 flagship question: the single-lane
+10M-feature solve is d-state-bound (~19.4 ms/iter of L-BFGS bookkeeping +
+59.3 ns/row of X work, benches/roofline.py), so lanes that share every X
+pass should multiply rows·iters/s until the (G, d) solver state saturates
+HBM. Timing closes with an O(1)-byte readback (device_results=True):
+fetching the (G, 10M) coefficient block would put G×40 MB of tunnel
+transfer inside the timed region.
+
+Run: python benches/grid_lanes.py --leg sparse --lanes 1 2 4 8
+     python benches/grid_lanes.py --leg dense  --lanes 8 16 32
 """
 from __future__ import annotations
 
@@ -18,11 +27,15 @@ import numpy as np
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--lanes", type=int, nargs="+", default=[8, 16, 32])
-    p.add_argument("--reps", type=int, default=4)
+    p.add_argument("--leg", choices=["sparse", "dense"], default="sparse")
+    p.add_argument("--lanes", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--rows", type=int, default=None,
+                   help="sparse-leg row count (default bench.S_ROWS)")
     args = p.parse_args()
 
     import jax
+    import jax.numpy as jnp
 
     import bench
     from photon_tpu.models.training import train_glm_grid
@@ -30,26 +43,52 @@ def main() -> None:
     from photon_tpu.optim.config import OptimizerConfig
     from photon_tpu.optim.regularization import l2
 
-    batch = jax.device_put(bench.make_problem())
-    jax.block_until_ready(batch.X)
-    cfg = OptimizerConfig(max_iters=bench.MAX_ITERS, tolerance=0.0,
-                          reg=l2(), reg_weight=0.0)
+    if args.leg == "sparse":
+        rows = args.rows or bench.S_ROWS
+        t0 = time.perf_counter()
+        batch = bench.sparse_problem(rows=rows)
+        jax.block_until_ready(batch.X.dense)
+        print(f"sparse problem ({rows} rows x {bench.S_FEATURES} features) "
+              f"loaded in {time.perf_counter() - t0:.0f}s")
+        iters_cfg = bench.S_ITERS
+    else:
+        rows = bench.D_ROWS
+        batch = bench.dense_problem()
+        jax.block_until_ready(batch.X)
+        iters_cfg = bench.D_ITERS
+    cfg = OptimizerConfig(max_iters=iters_cfg, tolerance=0.0, reg=l2(),
+                          reg_weight=0.0, history=5)
+
+    dev = jax.devices()[0]
     for g in args.lanes:
-        weights = list(np.geomspace(1e-4, 1e-2, g))
+        weights = list(np.geomspace(1e-4, 1e-2, g)) if g > 1 else [1e-3]
 
         def run():
-            return train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
-                                  weights)
+            res, _ = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION,
+                                    cfg, weights, device_results=True)
+            # O(1)-byte readback closes the timing (see module docstring)
+            return jax.device_get((jnp.sum(res.w),
+                                   jnp.sum(res.iterations)))
 
-        grid = run()  # compile
-        best = float("inf")
-        for _ in range(args.reps):
+        try:
             t0 = time.perf_counter()
-            grid = run()
-            best = min(best, time.perf_counter() - t0)
-        iters = sum(int(r.iterations) for _, r in grid)
-        print(f"G={g:3d}: {best * 1e3:6.0f} ms  {iters:4d} lane-iters  "
-              f"{bench.N_ROWS * iters / best:.3e} rows*iters/sec")
+            _, iters = run()  # compile + autotune
+            t_compile = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                _, iters = run()
+                best = min(best, time.perf_counter() - t0)
+        except Exception as e:  # OOM at some G is an answer, not a crash
+            print(f"G={g:3d}: FAILED ({type(e).__name__}: {str(e)[:200]})")
+            continue
+        stats = dev.memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        agg = rows * int(iters) / best
+        print(f"G={g:3d}: {best * 1e3:7.0f} ms  {int(iters):4d} lane-iters  "
+              f"{agg:.3e} rows*iters/s aggregate  "
+              f"({agg / g:.3e}/lane, compile {t_compile:.0f}s, "
+              f"peak HBM {peak:.1f} GiB)")
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import numpy as np
 from photon_tpu.data.matrix import (
     HybridRows,
     Matrix,
+    PermutedHybridRows,
     ShardedHybridRows,
     SparseRows,
     shard_hybrid,
@@ -40,7 +41,8 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
         weights = jnp.ones((n,), jnp.float32)
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
-    if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows)):
+    if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
+                          PermutedHybridRows)):
         import jax
 
         # host numpy transfers as f32; an already-device FLOATING array
@@ -75,6 +77,20 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
             X, dense=jnp.concatenate(
                 [X.dense, jnp.zeros((extra, X.dense.shape[1]),
                                     X.dense.dtype)]))
+    elif isinstance(X, PermutedHybridRows):
+        import dataclasses
+
+        # Padding rows have no tail nnz: the dense block grows and the
+        # row-bound prefix extends flat at the total nnz count.
+        X = dataclasses.replace(
+            X,
+            dense=jnp.concatenate(
+                [X.dense, jnp.zeros((extra, X.dense.shape[1]),
+                                    X.dense.dtype)]),
+            row_bounds=jnp.concatenate(
+                [jnp.asarray(X.row_bounds),
+                 jnp.full((extra,), jnp.asarray(X.row_bounds)[-1],
+                          jnp.asarray(X.row_bounds).dtype)]))
     elif isinstance(X, SparseRows):
         X = SparseRows(
             jnp.concatenate([X.indices, jnp.zeros((extra, X.indices.shape[1]), jnp.int32)]),
@@ -118,7 +134,14 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
     (data.matrix matvec/rmatvec use preferred_element_type=float32).
     Labels/weights/offsets and all solver state stay f32."""
     X = batch.X
-    if isinstance(X, (HybridRows, ShardedHybridRows)):
+    if isinstance(X, PermutedHybridRows):
+        import dataclasses
+
+        X = dataclasses.replace(
+            X, dense=X.dense.astype(dtype),
+            tail_vals=X.tail_vals.astype(dtype),
+            bucket_vals=tuple(v.astype(dtype) for v in X.bucket_vals))
+    elif isinstance(X, (HybridRows, ShardedHybridRows)):
         import dataclasses
 
         X = dataclasses.replace(X, dense=X.dense.astype(dtype),

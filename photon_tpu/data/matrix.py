@@ -153,7 +153,82 @@ class ShardedHybridRows:
         return rows, self.tail_cols.reshape(-1), self.tail_vals.reshape(-1)
 
 
-Matrix = jax.Array | SparseRows | HybridRows | ShardedHybridRows
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "tail_pcols", "tail_vals", "row_bounds",
+                 "bucket_rows", "bucket_vals", "perm_cols", "inv_perm"),
+    meta_fields=("n_features", "n_prefix", "last_col_pos"),
+)
+@dataclasses.dataclass(frozen=True)
+class PermutedHybridRows:
+    """Scatter-free hybrid: hot columns dense, cold tail in a PERMUTED
+    feature space whose layout makes both X passes scatter-free.
+
+    Motivation (measured on v5e, docs/PERF.md): TPU gathers cost ~7 ns per
+    index row regardless of row width, but scatter-adds cost ~12 ns per
+    ELEMENT — a (nnz, G) lane-stacked segment_sum is G× a single lane, and
+    even single-lane X passes are scatter-bound. This representation
+    removes every per-nnz scatter from matvec and rmatvec while staying
+    EXACT in R^d:
+
+    - Columns are relabeled at build time: positions [0, d_sel) are the hot
+      (most frequent) columns, [d_sel, P) the distinct tail columns GROUPED
+      BY OCCURRENCE-COUNT BUCKET, and [P, d) the columns untouched by this
+      batch (their X column is identically zero, so they contribute nothing
+      to any X pass — they still exist in coefficient/optimizer state and
+      feel regularization/prior terms exactly).
+    - matvec: the hot block is one (n, d_sel) matmul against the CONTIGUOUS
+      prefix slice w[:d_sel] (no dense_cols gather); the row-major flat
+      tail gathers w per nnz and reduces per row via cumulative-sum
+      differences over `row_bounds` — gathers only. (The cumsum pass adds
+      f32 error ~1e-4·σ·√nnz on tail sums — below the bf16 hot-block
+      storage quantization that dominates the representation's noise.)
+    - rmatvec: the gradient is ASSEMBLED BY CONCATENATION: hot block
+      (denseᵀ r), then each occurrence bucket's (c_b, k_b) row-index
+      matrix gathers r and reduces over k_b giving that bucket's columns
+      IN PREFIX ORDER, then zeros for the untouched suffix. No scatter.
+
+    COORDINATE CONVENTION: matvec/rmatvec (and the whole solver stack)
+    operate on PERMUTED-space vectors. `to_model_space` / `from_model_space`
+    translate (one cheap gather); models/training does this at its public
+    boundary, models/glm scoring translates per call — user-facing
+    coefficient vectors are always in original column order.
+
+    The reference has no analog (JVM sparse vectors are cheap to walk);
+    upstream com.linkedin.photon.ml's 10M-feature regime maps here.
+    """
+
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot-column values
+    tail_pcols: jax.Array | np.ndarray  # (m,) int32 PERMUTED col ids, row-major
+    tail_vals: jax.Array | np.ndarray   # (m,) tail values
+    row_bounds: jax.Array | np.ndarray  # (n + 1,) int32 tail nnz bounds per row
+    bucket_rows: tuple                  # per bucket: (c_b, k_b) int32 row ids
+    bucket_vals: tuple                  # per bucket: (c_b, k_b) values
+    perm_cols: jax.Array | np.ndarray   # (d,) original col id at each position
+    inv_perm: jax.Array | np.ndarray    # (d,) position of each original col
+    n_features: int
+    n_prefix: int                       # P = d_sel + distinct tail columns
+    last_col_pos: int                   # permuted position of original col d-1
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+    @property
+    def d_sel(self) -> int:
+        return self.dense.shape[1]
+
+    def from_model_space(self, v):
+        """Original-space (d,)-vector (or (d, ...) stack) → permuted space."""
+        return jnp.asarray(v)[self.perm_cols]
+
+    def to_model_space(self, w):
+        """Permuted-space (d,)-vector (or (d, ...) stack) → original space."""
+        return jnp.asarray(w)[self.inv_perm]
+
+
+Matrix = (jax.Array | SparseRows | HybridRows | ShardedHybridRows
+          | PermutedHybridRows)
 
 
 _SCATTER_CHUNK_ELEMS = 1 << 29  # ~2 GB f32 intermediate per scatter chunk
@@ -207,23 +282,13 @@ def _dense_scatter_chunked(rows_h, pos_h, vals_h, n, d_sel, dtype):
     return out
 
 
-def to_hybrid(X: SparseRows, d_dense: int = 1024,
-              device_dense_dtype=None) -> HybridRows:
-    """Split a SparseRows into (hot dense block, cold sparse tail).
-
-    Selects the `d_dense` columns with the most nonzeros (host-side pass
-    over the padded COO); the remaining nnz are COMPACTED into exact-size
-    flat row-sorted COO (tail_rows/tail_cols/tail_vals) — per-row padding
-    would cost as much as real nnz on the gather path.
-
-    `device_dense_dtype` (e.g. jnp.bfloat16) builds the dense hot block ON
-    DEVICE by scattering the compact hot COO (f32 accumulation, then cast):
-    the link carries 12 bytes per hot nnz (i32 row + i32 slot + f32 val)
-    instead of the materialized n×d_dense block — ~5× fewer tunnel bytes
-    at the bench's power-law density, and no host materialization. The
-    returned HybridRows then has a device `dense` leaf and host tail
-    leaves (device_put'ing it later is a no-op for the big block).
-    """
+def _hot_cold_split(X: SparseRows, d_dense: int, device_dense_dtype):
+    """Shared front half of both hybrid builders: pick the `d_dense` most
+    frequent columns, build the (n, d_sel) hot block (on device when
+    `device_dense_dtype` is set, else host chunked-bincount), and extract
+    the cold nnz as flat row-major COO. Returns
+    (dense, sel, t_rows, t_cols, t_vals) with t_* exact-size (possibly
+    empty) int64/f32 host arrays."""
     ind = np.asarray(X.indices)
     val = np.asarray(X.values)
     n, k = ind.shape
@@ -258,14 +323,37 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024,
                 flat_ids, weights=val[r0:r1][h].astype(np.float64),
                 minlength=(r1 - r0) * d_sel,
             ).astype(np.float32).reshape(r1 - r0, d_sel)
+    cold = (~hot) & nnz_mask
+    flat = cold.reshape(-1)           # row-major → tail rows ascending
+    t_rows = rows.reshape(-1)[flat]
+    t_cols = ind.reshape(-1)[flat]
+    t_vals = val.reshape(-1)[flat].astype(np.float32)
+    return dense, sel, t_rows, t_cols, t_vals
+
+
+def to_hybrid(X: SparseRows, d_dense: int = 1024,
+              device_dense_dtype=None) -> HybridRows:
+    """Split a SparseRows into (hot dense block, cold sparse tail).
+
+    Selects the `d_dense` columns with the most nonzeros (host-side pass
+    over the padded COO); the remaining nnz are COMPACTED into exact-size
+    flat row-sorted COO (tail_rows/tail_cols/tail_vals) — per-row padding
+    would cost as much as real nnz on the gather path.
+
+    `device_dense_dtype` (e.g. jnp.bfloat16) builds the dense hot block ON
+    DEVICE by scattering the compact hot COO (f32 accumulation, then cast):
+    the link carries 12 bytes per hot nnz (i32 row + i32 slot + f32 val)
+    instead of the materialized n×d_dense block — ~5× fewer tunnel bytes
+    at the bench's power-law density, and no host materialization. The
+    returned HybridRows then has a device `dense` leaf and host tail
+    leaves (device_put'ing it later is a no-op for the big block).
+    """
+    d = X.n_features
+    dense, sel, tail_rows, tail_cols, tail_vals = _hot_cold_split(
+        X, d_dense, device_dense_dtype)
     # Flat row-sorted COO tail: exactly the cold nnz, no per-row padding
     # (row-major traversal keeps rows ascending for the sorted segment_sum
     # in matvec). One zero sentinel entry keeps the arrays non-empty.
-    cold = (~hot) & nnz_mask
-    flat = cold.reshape(-1)
-    tail_rows = rows.reshape(-1)[flat]
-    tail_cols = ind.reshape(-1)[flat]
-    tail_vals = val.reshape(-1)[flat]
     if tail_rows.size == 0:
         tail_rows = np.zeros(1, np.int64)
         tail_cols = np.zeros(1, np.int64)
@@ -282,6 +370,95 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024,
         tail_vals=tail_vals.astype(np.float32),
         n_features=d,
     )
+
+
+def to_permuted_hybrid(X: SparseRows, d_dense: int = 1024,
+                       device_dense_dtype=None) -> PermutedHybridRows:
+    """Build the scatter-free permuted hybrid from padded COO rows.
+
+    One vectorized host pass: pick the `d_dense` most frequent columns as
+    the hot block (relabeled to prefix positions [0, d_sel)), group the
+    distinct tail columns by power-of-two occurrence bucket (relabeled to
+    [d_sel, P) in bucket order — the order rmatvec's concatenation
+    produces), and lay the tail twice: row-major flat (matvec's cumsum
+    reduction) and column-major padded per bucket (rmatvec's gather+reduce;
+    pow-2 padding wastes ≤2× on multi-occurrence columns, none on the
+    count-1 majority). `device_dense_dtype` builds the dense block on
+    device from compact COO triples as `to_hybrid` does.
+    """
+    n = np.asarray(X.indices).shape[0]
+    d = X.n_features
+    d_sel = min(d_dense, d)
+    dense, sel, t_rows, t_cols, t_vals = _hot_cold_split(
+        X, d_dense, device_dense_dtype)
+    m = t_rows.size
+
+    if m == 0:
+        perm_cols = np.concatenate(
+            [sel, np.setdiff1d(np.arange(d), sel)]).astype(np.int32)
+        inv_perm = np.empty(d, np.int64)
+        inv_perm[perm_cols] = np.arange(d)
+        return PermutedHybridRows(
+            dense=dense, tail_pcols=np.zeros(1, np.int32),
+            tail_vals=np.zeros(1, np.float32),
+            row_bounds=np.zeros(n + 1, np.int32),
+            bucket_rows=(), bucket_vals=(),
+            perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+            n_features=d, n_prefix=d_sel,
+            last_col_pos=int(inv_perm[d - 1]))
+
+    row_bounds = np.searchsorted(t_rows, np.arange(n + 1)).astype(np.int32)
+
+    u_cols, inv, u_counts = np.unique(t_cols, return_inverse=True,
+                                      return_counts=True)
+    U = u_cols.size
+    # pow-2 occurrence bucket exponent per distinct column (f64 log2 is
+    # exact at powers of two well past any realistic count)
+    e = np.zeros(U, np.int64)
+    big = u_counts > 1
+    e[big] = np.ceil(np.log2(u_counts[big].astype(np.float64))).astype(
+        np.int64)
+    order = np.lexsort((u_cols, e))   # bucket-major, col-id within bucket
+    rank = np.empty(U, np.int64)
+    rank[order] = np.arange(U)
+
+    pcol = (d_sel + rank[inv]).astype(np.int32)   # (m,) prefix ids, row-major
+
+    perm_prefix = np.concatenate([sel, u_cols[order]])
+    untouched = np.setdiff1d(np.arange(d), perm_prefix)
+    perm_cols = np.concatenate([perm_prefix, untouched]).astype(np.int32)
+    inv_perm = np.empty(d, np.int64)
+    inv_perm[perm_cols] = np.arange(d)
+
+    # column-major padded buckets: tail nnz sorted by prefix id groups each
+    # column's occurrences contiguously, in rank (= output) order
+    nnz_order = np.argsort(pcol, kind="stable")
+    rank_per = pcol[nnz_order].astype(np.int64) - d_sel
+    counts_by_rank = u_counts[order]
+    col_offsets = np.concatenate([[0], np.cumsum(counts_by_rank)])
+    pos_within = np.arange(m) - col_offsets[rank_per]
+    es = e[order]                      # exponent per rank, ascending
+    bucket_rows, bucket_vals = [], []
+    for e_v in np.unique(es):
+        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
+        c_b, k_b = int(r1 - r0), 1 << int(e_v)
+        lo, hi = int(col_offsets[r0]), int(col_offsets[r1])
+        br = np.zeros((c_b, k_b), np.int32)
+        bv = np.zeros((c_b, k_b), np.float32)
+        lr = rank_per[lo:hi] - r0
+        pw = pos_within[lo:hi]
+        br[lr, pw] = t_rows[nnz_order[lo:hi]]
+        bv[lr, pw] = t_vals[nnz_order[lo:hi]]
+        bucket_rows.append(br)
+        bucket_vals.append(bv)
+
+    return PermutedHybridRows(
+        dense=dense, tail_pcols=pcol, tail_vals=t_vals.astype(np.float32),
+        row_bounds=row_bounds,
+        bucket_rows=tuple(bucket_rows), bucket_vals=tuple(bucket_vals),
+        perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+        n_features=d, n_prefix=d_sel + U,
+        last_col_pos=int(inv_perm[d - 1]))
 
 
 def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
@@ -371,6 +548,68 @@ def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
     return SparseRows(jnp.asarray(indices), jnp.asarray(values), d)
 
 
+def _tail_rowsum(contrib, row_bounds):
+    """Per-row sums of row-major flat tail contributions via cumsum
+    differences — the scatter-free segmented reduction ((n,) or (n, G);
+    contrib may be (m,) or (m, G))."""
+    zero = jnp.zeros((1,) + contrib.shape[1:], contrib.dtype)
+    cs = jnp.concatenate([zero, jnp.cumsum(contrib, axis=0)])
+    b = cs[row_bounds]
+    return b[1:] - b[:-1]
+
+
+def _permuted_matvec(X: PermutedHybridRows, w):
+    """w: (d,) PERMUTED. Hot block against the contiguous prefix slice,
+    tail via gather + cumsum row reduction — no scatter anywhere."""
+    hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
+                     preferred_element_type=jnp.float32)
+    contrib = X.tail_vals.astype(jnp.float32) * w[X.tail_pcols]
+    return hot + _tail_rowsum(contrib, X.row_bounds)
+
+
+def _permuted_rmatvec(X: PermutedHybridRows, r, square: bool = False):
+    """Xᵀr (or (X∘X)ᵀr with square=True): assembled by CONCATENATION — the
+    hot block's matmul, each occurrence bucket's gather+reduce (columns
+    emerge in prefix order by construction), zeros for the untouched
+    suffix."""
+    f32 = jnp.float32
+    dense = X.dense * X.dense if square else X.dense
+    parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
+                        preferred_element_type=f32)]
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        v = bv.astype(f32)
+        if square:
+            v = v * v
+        parts.append(jnp.einsum("ck,ck->c", v, r[br]))
+    pad = X.n_features - X.n_prefix
+    if pad:
+        parts.append(jnp.zeros((pad,), f32))
+    return jnp.concatenate(parts)
+
+
+def _permuted_matvec_lanes(X: PermutedHybridRows, W):
+    """W: (d, G) PERMUTED lane-minor — hot is ONE (n, d_sel) × (d_sel, G)
+    MXU matmul, the tail gather moves G contiguous floats per index."""
+    hot = jnp.matmul(X.dense, W[:X.d_sel].astype(X.dense.dtype),
+                     preferred_element_type=jnp.float32)
+    contrib = X.tail_vals.astype(jnp.float32)[:, None] * W[X.tail_pcols]
+    return hot + _tail_rowsum(contrib, X.row_bounds)
+
+
+def _permuted_rmatvec_lanes(X: PermutedHybridRows, R):
+    """R: (n, G) lane-minor cotangents → (d, G) by concatenation."""
+    f32 = jnp.float32
+    G = R.shape[1]
+    parts = [jnp.matmul(X.dense.T, R.astype(X.dense.dtype),
+                        preferred_element_type=f32)]
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        parts.append(jnp.einsum("ck,ckg->cg", bv.astype(f32), R[br]))
+    pad = X.n_features - X.n_prefix
+    if pad:
+        parts.append(jnp.zeros((pad, G), f32))
+    return jnp.concatenate(parts, axis=0)
+
+
 def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     """X @ w -> (n,). The GLM margin hot path.
 
@@ -379,7 +618,13 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     traffic, native MXU input width) while `preferred_element_type=float32`
     keeps the ACCUMULATION in f32 — the TPU matmul recipe. Output is always
     f32; everything downstream (losses, solver state) never sees bf16.
+
+    PermutedHybridRows expects w in ITS permuted space (see the class
+    docstring; models/training and models/glm translate at their
+    boundaries).
     """
+    if isinstance(X, PermutedHybridRows):
+        return _permuted_matvec(X, w)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         tail = jax.ops.segment_sum(
@@ -408,6 +653,8 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
 def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """X^T @ r -> (d,). The gradient aggregation hot path (f32 accumulation,
     bf16-storage aware like matvec)."""
+    if isinstance(X, PermutedHybridRows):
+        return _permuted_rmatvec(X, r)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         out = jax.ops.segment_sum(
@@ -431,8 +678,88 @@ def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     return jnp.matmul(X.T, r.astype(X.dtype), preferred_element_type=jnp.float32)
 
 
+def matvec_lanes(X: Matrix, W: jax.Array) -> jax.Array:
+    """X @ W -> (n, G) for LANE-MINOR stacked coefficients W: (d, G).
+
+    The multi-lane (reg-weight grid) hot path. Lane-minor layout is the
+    TPU-native form: the hot dense block becomes ONE true (n, d_sel) ×
+    (d_sel, G) MXU matmul shared by every lane, and the tail gather
+    W[tail_cols] fetches G *contiguous* floats per index — the same number
+    of random accesses as a single lane. A vmapped single-lane matvec
+    (lane-MAJOR (G, d)) pays both per lane: measured ~3.5× slower at G=4
+    on the 10M-feature headline problem (docs/PERF.md).
+    """
+    if isinstance(X, PermutedHybridRows):
+        return _permuted_matvec_lanes(X, W)
+    if isinstance(X, ShardedHybridRows):
+        rows, cols, vals = X._global_tail()
+        tail = jax.ops.segment_sum(
+            vals.astype(jnp.float32)[:, None] * W[cols], rows,
+            num_segments=X.dense.shape[0], indices_are_sorted=True)
+        return tail + jnp.matmul(
+            X.dense, W[X.dense_cols].astype(X.dense.dtype),
+            preferred_element_type=jnp.float32)
+    if isinstance(X, HybridRows):
+        tail = jax.ops.segment_sum(
+            X.tail_vals.astype(jnp.float32)[:, None] * W[X.tail_cols],
+            X.tail_rows, num_segments=X.dense.shape[0],
+            indices_are_sorted=True)
+        return tail + jnp.matmul(
+            X.dense, W[X.dense_cols].astype(X.dense.dtype),
+            preferred_element_type=jnp.float32)
+    if isinstance(X, SparseRows):
+        # (n, k, G) gather then contraction over k on the VPU; storage bf16
+        # upcasts in registers as in matvec.
+        return jnp.einsum("nk,nkg->ng", X.values.astype(jnp.float32),
+                          W[X.indices])
+    return jnp.matmul(X, W.astype(X.dtype), preferred_element_type=jnp.float32)
+
+
+def rmatvec_lanes(X: Matrix, R: jax.Array) -> jax.Array:
+    """X^T @ R -> (d, G) for lane-minor per-row cotangents R: (n, G).
+
+    The multi-lane gradient aggregation: the tail scatter-add lands G
+    contiguous floats per segment id (one scatter row of width G instead of
+    G scalar scatters), the hot block is one (d_sel, n) × (n, G) matmul.
+    """
+    if isinstance(X, PermutedHybridRows):
+        return _permuted_rmatvec_lanes(X, R)
+    if isinstance(X, ShardedHybridRows):
+        rows, cols, vals = X._global_tail()
+        out = jax.ops.segment_sum(
+            vals.astype(jnp.float32)[:, None] * R[rows], cols,
+            num_segments=X.n_features)
+        hot = jnp.matmul(X.dense.T, R.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
+    if isinstance(X, HybridRows):
+        out = jax.ops.segment_sum(
+            X.tail_vals.astype(jnp.float32)[:, None] * R[X.tail_rows],
+            X.tail_cols, num_segments=X.n_features)
+        hot = jnp.matmul(X.dense.T, R.astype(X.dense.dtype),
+                         preferred_element_type=jnp.float32)
+        return out.at[X.dense_cols].add(hot)
+    if isinstance(X, SparseRows):
+        contrib = (X.values.astype(jnp.float32)[:, :, None]
+                   * R[:, None, :])  # (n, k, G)
+        G = R.shape[1]
+        return jax.ops.segment_sum(
+            contrib.reshape(-1, G), X.indices.reshape(-1),
+            num_segments=X.n_features)
+    return jnp.matmul(X.T, R.astype(X.dtype), preferred_element_type=jnp.float32)
+
+
 def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
-    """(X∘X)^T @ r -> (d,): Hessian diagonal building block."""
+    """(X∘X)^T @ r -> (d,): Hessian diagonal building block.
+
+    Duplicate (row, col) COO entries: SparseRows squares each ENTRY
+    (a² + b²), while the hybrid representations pre-aggregate the cell
+    (a + b)² in their dense block. Feature-bag rows never repeat a feature
+    (reference: one value per feature name+term per example), so the
+    distinction never arises on real data; dedupe the COO if yours can.
+    """
+    if isinstance(X, PermutedHybridRows):
+        return _permuted_rmatvec(X, r, square=True)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         tv = vals.astype(jnp.float32)
@@ -470,6 +797,27 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     at the 10M-feature regime a (d, d) Gram is impossible anyway; use
     hess_diag (VarianceComputationType.SIMPLE) there.
     """
+    if isinstance(X, PermutedHybridRows):
+        if X.n_features > MAX_GRAM_FEATURES:
+            raise ValueError(
+                f"weighted_gram densifies PermutedHybridRows: "
+                f"d={X.n_features} exceeds "
+                f"MAX_GRAM_FEATURES={MAX_GRAM_FEATURES}; use "
+                "hess_diag/SIMPLE variances for large feature spaces"
+            )
+        # Densify in PERMUTED space (the solver's space — consistent with
+        # every other X op on this representation).
+        n, d = X.dense.shape[0], X.n_features
+        rows = jnp.zeros((n, d), jnp.float32)
+        rows = rows.at[:, :X.d_sel].add(X.dense.astype(jnp.float32))
+        off = X.d_sel
+        for br, bv in zip(X.bucket_rows, X.bucket_vals):
+            c_b = br.shape[0]
+            cols_ids = off + jnp.arange(c_b)
+            rows = rows.at[br, cols_ids[:, None]].add(
+                bv.astype(jnp.float32))
+            off += c_b
+        return (rows * r[:, None]).T @ rows
     if isinstance(X, (HybridRows, ShardedHybridRows)):
         if X.n_features > MAX_GRAM_FEATURES:
             raise ValueError(
@@ -515,11 +863,21 @@ def next_pow2(x: int, floor: int = 2) -> int:
 def last_column_is_intercept(X: Matrix) -> bool:
     """True when the design matrix's last column is constant 1 — the
     data.feature_bags intercept-last convention."""
+    def _host_col(dense, j):
+        # Slice BEFORE the host transfer: a device-resident dense block
+        # (to_*_hybrid device_dense_dtype) then moves (n,) floats to answer
+        # this, not the whole multi-GB block.
+        return np.asarray(dense[:, j])
+
+    if isinstance(X, PermutedHybridRows):
+        if X.last_col_pos < X.d_sel:  # an intercept is maximally hot
+            return bool((_host_col(X.dense, X.last_col_pos) == 1.0).all())
+        return False  # last column isn't even hot → not an all-rows 1
     if isinstance(X, (HybridRows, ShardedHybridRows)):
         d = X.n_features
         cols = np.asarray(X.dense_cols)
         if d - 1 in cols:  # intercept is maximally hot: dense block
-            col = np.asarray(X.dense)[:, int(np.where(cols == d - 1)[0][0])]
+            col = _host_col(X.dense, int(np.where(cols == d - 1)[0][0]))
             return bool((col == 1.0).all())
         if isinstance(X, ShardedHybridRows):
             t_rows = np.asarray(X._global_tail()[0])
@@ -541,7 +899,9 @@ def last_column_is_intercept(X: Matrix) -> bool:
 
 
 def nnz_stats(X: Matrix) -> tuple[int, int]:
-    n, _ = X.shape if isinstance(X, SparseRows) else X.shape
+    n = X.shape[0]
     if isinstance(X, SparseRows):
         return n, int(np.prod(X.values.shape))
+    if isinstance(X, PermutedHybridRows):
+        return n, int(np.prod(X.dense.shape)) + int(X.tail_vals.shape[0])
     return n, int(np.prod(X.shape))

@@ -18,7 +18,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from photon_tpu.data.matrix import Matrix, matvec
+from photon_tpu.data.matrix import (Matrix, PermutedHybridRows, matvec,
+                                    matvec_lanes)
 from photon_tpu.ops.losses import TaskType, mean_fn
 
 
@@ -73,19 +74,26 @@ class GeneralizedLinearModel:
 
 
 # Jitted at the entry point: one device dispatch per scoring call instead
-# of one per primitive (matters over remote-tunnel links).
+# of one per primitive (matters over remote-tunnel links). User-facing
+# coefficient vectors are in ORIGINAL column order; a PermutedHybridRows
+# design matrix works in its permuted space, so scoring translates w at
+# the boundary (one gather — see PermutedHybridRows docstring).
 @jax.jit
 def _margin_jit(X, w, offsets):
+    if isinstance(X, PermutedHybridRows):
+        w = X.from_model_space(w)
     return matvec(X, w) + offsets
 
 
 @partial(jax.jit, static_argnames=("task",))
 def _mean_jit(task, X, w, offsets):
-    return mean_fn(task)(matvec(X, w) + offsets)
+    return mean_fn(task)(_margin_jit(X, w, offsets))
 
 
 @jax.jit
 def _score_many(W, X, offsets):
+    if isinstance(X, PermutedHybridRows):
+        return matvec_lanes(X, W[:, X.perm_cols].T).T + offsets
     return jax.vmap(lambda w: matvec(X, w))(W) + offsets
 
 

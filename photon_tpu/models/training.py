@@ -26,12 +26,15 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_tpu.data.dataset import GLMBatch, pad_batch
-from photon_tpu.data.matrix import HybridRows, ShardedHybridRows, SparseRows
+from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
+                                    ShardedHybridRows, SparseRows)
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.ops.objective import Objective
 from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+from photon_tpu.ops.lane_objective import supports_lanes
+from photon_tpu.optim.lane_lbfgs import minimize_lbfgs_margin_lanes
 from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.tron import minimize_tron_margin
@@ -209,8 +212,39 @@ def _train_run_sharded_grid(batch, w0, obj, l2s, l1s, config, variance,
 
 def _matrix_dim(X) -> int:
     return (X.n_features
-            if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows))
+            if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
+                              PermutedHybridRows))
             else X.shape[1])
+
+
+def _permuted_prep(X: PermutedHybridRows, w0, prior_mean, prior_precision,
+                   norm):
+    """Translate original-space side inputs into the permuted feature space
+    a PermutedHybridRows solve runs in (see the class docstring): (d,)
+    vectors gather through perm_cols; the normalization context used by the
+    OBJECTIVE carries permuted factors/shifts (elementwise transforms
+    commute with the permutation, so post-solve conversions run in
+    original space after `to_model_space`)."""
+    import dataclasses as _dc
+
+    w0 = X.from_model_space(w0)
+    if prior_mean is not None:
+        prior_mean = X.from_model_space(prior_mean)
+    if prior_precision is not None:
+        prior_precision = X.from_model_space(prior_precision)
+    norm_obj = norm
+    if norm is not None:
+        # Host-side gather: these (d,) vectors are host numpy and
+        # make_objective re-uploads them anyway — a device from_model_space
+        # would pay gather + (d,) downlink + re-uplink per training call.
+        perm = np.asarray(X.perm_cols)
+        norm_obj = _dc.replace(
+            norm,
+            factors=(None if norm.factors is None
+                     else np.asarray(norm.factors)[perm]),
+            shifts=(None if norm.shifts is None
+                    else np.asarray(norm.shifts)[perm]))
+    return w0, prior_mean, prior_precision, norm_obj
 
 
 def _active_norm(normalization):
@@ -258,6 +292,57 @@ def _mesh_prep(batch: GLMBatch, w0, mesh: Mesh):
     batch = pad_batch(batch, pad_to_multiple(batch.n, mesh.devices.size))
     batch = jax.device_put(batch, data_sharding(mesh))
     return batch, jax.device_put(w0, replicated(mesh))
+
+
+def _lane_result(res) -> OptResult:
+    """Transpose a lane-minor solver result (w (d, G), histories (T+1, G))
+    to the public lane-MAJOR convention shared with the vmap path."""
+    return OptResult(
+        w=res.w.T, value=res.value, grad_norm=res.grad_norm,
+        iterations=res.iterations, converged=res.converged,
+        failed=res.failed, loss_history=res.loss_history.T,
+        grad_norm_history=res.grad_norm_history.T)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _train_run_grid_lanes(batch, w0, obj, l2s, config):
+    """The LANE-MINOR grid solver (optim/lane_lbfgs.py): one lock-step
+    margin-cached L-BFGS whose state carries a minor lane axis, so the hot
+    matvec is a true (n, d_sel) × (d_sel, G) MXU matmul and the tail
+    gather/scatter costs the same index count as a single lane. The vmapped
+    runner below (_train_run_grid) is the general fallback (OWL-QN lanes,
+    variances, priors); for smooth L2 sweeps this path is the fast road
+    (the vmapped one measured ~5× a single lane PER LANE at d=10M)."""
+    W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
+    res = minimize_lbfgs_margin_lanes(
+        obj, l2s, batch, W0, max_iters=config.max_iters,
+        tolerance=config.tolerance, history=config.history)
+    return _lane_result(res), None
+
+
+@partial(jax.jit, static_argnames=("config", "mesh"))
+def _train_run_sharded_grid_lanes(batch, w0, obj, l2s, config, mesh):
+    """Lane-minor grid solver under shard_map for ShardedHybridRows: each
+    device runs the lock-step lane solver on its local (dense rows + tail)
+    piece; the per-lane (value, grad) psums batch into one collective per
+    evaluation across the sweep, as in _train_run_sharded_grid."""
+    axes = tuple(mesh.axis_names)
+    batch_spec = _hybrid_specs(batch.X, axes)
+    obj_spec = jax.tree_util.tree_map(lambda _: P(), obj)
+
+    def body(b, w0, obj, l2s):
+        bl = b._replace(X=b.X.local())
+        W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
+        res = minimize_lbfgs_margin_lanes(
+            obj, l2s, bl, W0, max_iters=config.max_iters,
+            tolerance=config.tolerance, history=config.history)
+        return _lane_result(res)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(batch_spec, P(), obj_spec, P()),
+        out_specs=P(),
+    )(batch, w0, obj, l2s), None
 
 
 @partial(jax.jit, static_argnames=("config", "variance"))
@@ -316,6 +401,7 @@ def train_glm_grid(
     w0: Optional[jax.Array] = None,
     variance: VarianceComputationType = VarianceComputationType.NONE,
     normalization=None,
+    device_results: bool = False,
 ) -> list[tuple[GeneralizedLinearModel, OptResult]]:
     """Train one GLM per regularization weight — as ONE device program.
 
@@ -328,27 +414,70 @@ def train_glm_grid(
     Unlike the sequential path, lanes cannot warm-start from each other
     (they run concurrently); every lane starts from ``w0``. Convergence is
     tracked per lane.
+
+    ``device_results=True`` returns the raw lane-stacked ``(OptResult,
+    variances)`` pytree still resident on device — no host transfer, no
+    per-lane model assembly, normalization NOT unfolded. For large-d
+    sweeps (the 10M-feature regime) the (G, d) coefficient block is
+    G×40 MB; callers selecting one winning lane (or reducing to metrics)
+    should fetch only what they need.
     """
     d = _matrix_dim(batch.X)
     sharded_hybrid = mesh is not None and isinstance(batch.X,
                                                      ShardedHybridRows)
+    permuted = isinstance(batch.X, PermutedHybridRows)
+    if permuted and mesh is not None:
+        raise ValueError(
+            "PermutedHybridRows is a single-device representation (its "
+            "bucketed tail cannot be row-sharded); use ShardedHybridRows "
+            "under a mesh")
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
+    norm_obj, intercept_index = norm, -1
+    if permuted:
+        w0, _, _, norm_obj = _permuted_prep(batch.X, w0, None, None, norm)
+        intercept_index = batch.X.last_col_pos
     weights = [float(wt) for wt in reg_weights]
     l2s, l1s, static_cfg = lane_weight_arrays(config, weights)
     axis_name = None
     if sharded_hybrid:
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
     obj = make_objective(task, config, d, axis_name=axis_name,
-                         normalization=norm)
+                         normalization=norm_obj,
+                         intercept_index=intercept_index)
+    # Smooth L2 sweeps without variances ride the lane-minor solver (one
+    # lock-step program sharing every X pass); OWL-QN lanes, TRON, and
+    # variance requests fall back to the general vmapped runner.
+    use_lanes = (l1s is None
+                 and static_cfg.optimizer is OptimizerType.LBFGS
+                 and variance is VarianceComputationType.NONE
+                 and supports_lanes(obj))
     if sharded_hybrid:
-        res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
-                                           static_cfg, variance, mesh)
+        if use_lanes:
+            res, var = _train_run_sharded_grid_lanes(batch, w0, obj, l2s,
+                                                     static_cfg, mesh)
+        else:
+            res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
+                                               static_cfg, variance, mesh)
     else:
         if mesh is not None:
             batch, w0 = _mesh_prep(batch, w0, mesh)
-        res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,
-                                   variance)
+        if use_lanes:
+            res, var = _train_run_grid_lanes(batch, w0, obj, l2s,
+                                             static_cfg)
+        else:
+            res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,
+                                       variance)
+    if permuted:
+        # Back to original column order (one (G, d) device gather for the
+        # whole sweep) before normalization unfolds / models assemble;
+        # device_results callers get original-order coefficients too.
+        inv = jnp.asarray(batch.X.inv_perm)
+        res = res._replace(w=res.w[:, inv])
+        if var is not None:
+            var = var[:, inv]
+    if device_results:
+        return res, var
     # ONE host transfer for the whole sweep, then pure-numpy lane assembly:
     # per-lane device slicing would pay a dispatch round-trip per lane per
     # field (ruinous over a remote-tunnel link). The returned leaves are
@@ -442,6 +571,12 @@ def train_glm(
     """
     d = _matrix_dim(batch.X)
     norm = _active_norm(normalization)
+    permuted = isinstance(batch.X, PermutedHybridRows)
+    if permuted and mesh is not None:
+        raise ValueError(
+            "PermutedHybridRows is a single-device representation (its "
+            "bucketed tail cannot be row-sharded); use ShardedHybridRows "
+            "under a mesh")
     prior_full_precision = None
     if prior is not None:
         if prior_mean is not None or prior_precision is not None:
@@ -477,6 +612,17 @@ def train_glm(
     # fused=True)).
     use_fused = (mesh is None
                  and config.effective_optimizer() is OptimizerType.OWLQN)
+    norm_obj, intercept_index = norm, -1
+    if permuted:
+        if prior_full_precision is not None:
+            raise ValueError(
+                "full-covariance priors are not supported with "
+                "PermutedHybridRows (a (d, d) precision at permuted-hybrid "
+                "scale is impractical; use a diagonal prior)")
+        w0, prior_mean, prior_precision, norm_obj = _permuted_prep(
+            batch.X, w0, prior_mean, prior_precision, norm)
+        intercept_index = batch.X.last_col_pos
+        use_fused = False
     sharded_hybrid = mesh is not None and isinstance(batch.X,
                                                      ShardedHybridRows)
     axis_name = None
@@ -484,9 +630,9 @@ def train_glm(
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
     obj = make_objective(task, config, d, axis_name=axis_name,
                          prior_mean=prior_mean, prior_precision=prior_precision,
-                         normalization=norm,
+                         normalization=norm_obj,
                          prior_full_precision=prior_full_precision,
-                         fused=use_fused)
+                         fused=use_fused, intercept_index=intercept_index)
 
     if sharded_hybrid:
         res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
@@ -507,6 +653,13 @@ def train_glm(
     if not sharded_hybrid:
         res, var = _train_run(batch, w0, obj, _l1_lam(config),
                               _static_config(config), variance)
+    if permuted:
+        # Back to original column order (one device gather) BEFORE the
+        # normalization unfold — elementwise transforms commute with the
+        # permutation, so the original-space context applies unchanged.
+        res = res._replace(w=batch.X.to_model_space(res.w))
+        if var is not None:
+            var = batch.X.to_model_space(var)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))

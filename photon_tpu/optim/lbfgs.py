@@ -271,11 +271,14 @@ def minimize_lbfgs_margin(
         # keeps the pass free on non-refresh iterations (under vmap it
         # degrades to one always-on pass, but vmapped per-entity solves are
         # short and tiny, so the cost is noise there).
-        z_new = lax.cond(
-            (s.it + 1) % _Z_REFRESH == 0,
-            lambda: obj.margin(w_new, batch),
-            lambda: z_new,
-        )
+        if max_iters >= _Z_REFRESH:  # statically unreachable below that —
+            # skipping the cond matters under vmap, where it degrades to an
+            # always-on extra X pass per iteration for EVERY lane
+            z_new = lax.cond(
+                (s.it + 1) % _Z_REFRESH == 0,
+                lambda: obj.margin(w_new, batch),
+                lambda: z_new,
+            )
         f_new = jnp.where(ok, f_star, s.f)
         g_new = jnp.where(ok, obj.grad_at_margin(w_new, z_new, batch),  # X pass 2
                           s.g)

@@ -1,0 +1,240 @@
+"""PermutedHybridRows: the scatter-free permuted-space hybrid
+(data/matrix.py). Parity contract: every op and every solve must agree
+with the SparseRows representation of the same matrix, with all
+user-facing vectors in ORIGINAL column order.
+
+Mirrors the reference's representation-invariance expectation
+(com.linkedin.photon.ml.data: LabeledPoint math is identical whatever the
+underlying vector type).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import GLMBatch, cast_features, make_batch, pad_batch
+from photon_tpu.data.matrix import (PermutedHybridRows, SparseRows, matvec,
+                                    matvec_lanes, rmatvec, rmatvec_lanes,
+                                    sq_rmatvec, to_permuted_hybrid,
+                                    weighted_gram)
+from photon_tpu.models.training import (evaluate_glm_grid, train_glm,
+                                        train_glm_grid)
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+
+
+def _power_law_sparse(rng, n=500, d=800, k=10, d_dense=32):
+    """Zipf-ish column frequencies so hot/bucket/deep-tail paths all fill.
+
+    Duplicate (row, col) slots get value 0 (the padding convention): real
+    feature-bag rows never repeat a feature, and duplicate cells are where
+    per-entry and per-cell quadratic semantics (sq_rmatvec) diverge."""
+    col = (rng.zipf(1.5, size=(n, k)).astype(np.int64) - 1) % (d - 1)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    order = np.argsort(col, axis=1, kind="stable")
+    sorted_col = np.take_along_axis(col, order, axis=1)
+    dup = sorted_col[:, 1:] == sorted_col[:, :-1]
+    dupmask = np.zeros_like(col, bool)
+    np.put_along_axis(dupmask, order[:, 1:], dup, axis=1)
+    val[dupmask] = 0.0
+    ind = np.concatenate([col, np.full((n, 1), d - 1)], axis=1).astype(
+        np.int32)
+    va = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+    X = SparseRows(jnp.asarray(ind), jnp.asarray(va), d)
+    P = to_permuted_hybrid(X, d_dense)
+    return X, P
+
+
+def test_perm_roundtrip_and_layout(rng):
+    X, P = _power_law_sparse(rng)
+    d = X.n_features
+    perm = np.asarray(P.perm_cols)
+    inv = np.asarray(P.inv_perm)
+    assert sorted(perm.tolist()) == list(range(d))
+    np.testing.assert_array_equal(perm[inv], np.arange(d))
+    v = rng.normal(size=d).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(P.to_model_space(P.from_model_space(v))), v)
+    # intercept (original last column, in every row) must be hot
+    assert P.last_col_pos < P.d_sel
+    assert np.asarray(P.dense)[:, P.last_col_pos].min() == 1.0
+
+
+def test_perm_matvec_rmatvec_parity(rng):
+    X, P = _power_law_sparse(rng)
+    n, d = X.shape
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(matvec(P, P.from_model_space(w))),
+        np.asarray(matvec(X, w)), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(P.to_model_space(rmatvec(P, r))),
+        np.asarray(rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(P.to_model_space(sq_rmatvec(P, r))),
+        np.asarray(sq_rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+
+
+def test_perm_lane_ops_parity(rng):
+    X, P = _power_law_sparse(rng)
+    n, d = X.shape
+    G = 5
+    W = jnp.asarray(rng.normal(size=(d, G)).astype(np.float32))
+    R = jnp.asarray(rng.normal(size=(n, G)).astype(np.float32))
+    Wp = P.from_model_space(W)
+    mv = np.asarray(matvec_lanes(P, Wp))
+    rv = np.asarray(P.to_model_space(rmatvec_lanes(P, R)))
+    for g in range(G):
+        np.testing.assert_allclose(mv[:, g], np.asarray(matvec(X, W[:, g])),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(rv[:, g], np.asarray(rmatvec(X, R[:, g])),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_perm_weighted_gram_parity(rng):
+    X, P = _power_law_sparse(rng, n=200, d=60, k=6, d_dense=8)
+    r = jnp.asarray(rng.uniform(0.1, 1.0, size=200).astype(np.float32))
+    Gp = np.asarray(weighted_gram(P, r))          # permuted space
+    Gs = np.asarray(weighted_gram(X, r))
+    perm = np.asarray(P.perm_cols)
+    np.testing.assert_allclose(Gp, Gs[np.ix_(perm, perm)], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_perm_empty_tail(rng):
+    # every column hot → tail empty; ops must still be exact
+    ind = rng.integers(0, 16, size=(50, 4)).astype(np.int32)
+    val = rng.normal(size=(50, 4)).astype(np.float32)
+    X = SparseRows(jnp.asarray(ind), jnp.asarray(val), 16)
+    P = to_permuted_hybrid(X, 16)
+    assert P.bucket_rows == ()
+    w = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(matvec(P, P.from_model_space(w))),
+        np.asarray(matvec(X, w)), rtol=1e-5, atol=1e-5)
+    r = jnp.asarray(rng.normal(size=50).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(P.to_model_space(rmatvec(P, r))),
+        np.asarray(rmatvec(X, r)), rtol=1e-5, atol=1e-5)
+
+
+def test_perm_train_glm_parity(rng):
+    X, P = _power_law_sparse(rng)
+    wt = rng.normal(size=X.n_features).astype(np.float32) * 0.5
+    z = np.asarray(matvec(X, jnp.asarray(wt)))
+    y = jnp.asarray((rng.random(X.shape[0]) < 1 / (1 + np.exp(-z))).astype(
+        np.float32))
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.1, history=5)
+    m_p, r_p = train_glm(make_batch(P, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    m_s, r_s = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    np.testing.assert_allclose(float(r_p.value), float(r_s.value), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m_p.coefficients.means),
+                               np.asarray(m_s.coefficients.means), atol=5e-3)
+    # model scoring translates to permuted space internally
+    np.testing.assert_allclose(np.asarray(m_p.score(P)),
+                               np.asarray(m_p.score(X)), rtol=2e-4, atol=2e-4)
+
+
+def test_perm_train_glm_regularize_intercept_off(rng):
+    X, P = _power_law_sparse(rng)
+    y = jnp.asarray((rng.random(X.shape[0]) < 0.5).astype(np.float32))
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-6, reg=l2(),
+                          reg_weight=10.0, history=5,
+                          regularize_intercept=False)
+    m_p, r_p = train_glm(make_batch(P, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    m_s, r_s = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION, cfg)
+    np.testing.assert_allclose(float(r_p.value), float(r_s.value), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m_p.coefficients.means),
+                               np.asarray(m_s.coefficients.means), atol=5e-3)
+
+
+def test_perm_train_glm_w0_and_normalization(rng):
+    from photon_tpu.data.normalization import (NormalizationContext,
+                                               NormalizationType)
+
+    X, P = _power_law_sparse(rng, n=400, d=200, k=8, d_dense=16)
+    d = X.n_features
+    y = jnp.asarray((rng.random(400) < 0.5).astype(np.float32))
+    w0 = rng.normal(size=d).astype(np.float32) * 0.1
+    norm = NormalizationContext.build(X, NormalizationType.STANDARDIZATION,
+                                      intercept_index=d - 1)
+    # standardization of rare sparse columns gives huge factors and flat
+    # optimum directions; strong L2 keeps the parity check conditioned
+    # (the objective VALUE is the tight assertion either way)
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-6, reg=l2(),
+                          reg_weight=5.0, history=5)
+    m_p, r_p = train_glm(make_batch(P, y), TaskType.LOGISTIC_REGRESSION,
+                         cfg, w0=w0, normalization=norm)
+    m_s, r_s = train_glm(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                         cfg, w0=w0, normalization=norm)
+    np.testing.assert_allclose(float(r_p.value), float(r_s.value), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m_p.coefficients.means),
+                               np.asarray(m_s.coefficients.means), atol=5e-3)
+
+
+def test_perm_grid_parity_and_eval(rng):
+    X, P = _power_law_sparse(rng)
+    wt = rng.normal(size=X.n_features).astype(np.float32) * 0.5
+    z = np.asarray(matvec(X, jnp.asarray(wt)))
+    y = jnp.asarray((rng.random(X.shape[0]) < 1 / (1 + np.exp(-z))).astype(
+        np.float32))
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.0, history=5)
+    weights = [1e-1, 1.0, 30.0]
+    bp, bs = make_batch(P, y), make_batch(X, y)
+    grid_p = train_glm_grid(bp, TaskType.LOGISTIC_REGRESSION, cfg, weights)
+    grid_s = train_glm_grid(bs, TaskType.LOGISTIC_REGRESSION, cfg, weights)
+    for (m_p, r_p), (m_s, r_s) in zip(grid_p, grid_s):
+        np.testing.assert_allclose(float(r_p.value), float(r_s.value),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(m_p.coefficients.means),
+                                   np.asarray(m_s.coefficients.means),
+                                   atol=2e-2)
+    best_p, scores_p = evaluate_glm_grid(grid_p, bp)
+    best_s, scores_s = evaluate_glm_grid(grid_s, bs)
+    assert best_p == best_s
+    np.testing.assert_allclose(scores_p, scores_s, rtol=1e-3)
+
+
+def test_perm_grid_device_results_original_order(rng):
+    X, P = _power_law_sparse(rng, n=200, d=100, k=6, d_dense=8)
+    y = jnp.asarray((rng.random(200) < 0.5).astype(np.float32))
+    cfg = OptimizerConfig(max_iters=30, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.0, history=5)
+    res_p, _ = train_glm_grid(make_batch(P, y), TaskType.LOGISTIC_REGRESSION,
+                              cfg, [0.5, 2.0], device_results=True)
+    grid_s = train_glm_grid(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                            cfg, [0.5, 2.0])
+    for i, (m_s, _) in enumerate(grid_s):
+        np.testing.assert_allclose(np.asarray(res_p.w)[i],
+                                   np.asarray(m_s.coefficients.means),
+                                   atol=2e-2)
+
+
+def test_perm_pad_and_cast(rng):
+    X, P = _power_law_sparse(rng, n=100, d=300, k=6)
+    y = jnp.asarray(rng.normal(size=100).astype(np.float32))
+    b = pad_batch(make_batch(P, y), 128)
+    assert b.n == 128
+    w = jnp.asarray(rng.normal(size=300).astype(np.float32))
+    z = np.asarray(matvec(b.X, b.X.from_model_space(w)))
+    np.testing.assert_allclose(z[:100], np.asarray(matvec(X, w)), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(z[100:], 0.0, atol=1e-6)
+    bc = cast_features(b)
+    assert bc.X.dense.dtype == jnp.bfloat16
+    assert all(v.dtype == jnp.bfloat16 for v in bc.X.bucket_vals)
+
+
+def test_perm_mesh_rejected(rng, mesh8):
+    X, P = _power_law_sparse(rng, n=64, d=100, k=4)
+    y = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    cfg = OptimizerConfig(max_iters=5, reg=l2(), reg_weight=0.1)
+    with pytest.raises(ValueError, match="single-device"):
+        train_glm(make_batch(P, y), TaskType.LINEAR_REGRESSION, cfg,
+                  mesh=mesh8)
