@@ -49,8 +49,12 @@ def main() -> None:
         # cold nnz there — ~5% of the 33/row; counting all of them would
         # overstate achieved bandwidth ~9%).
         hot = n * bench.S_DENSE * 2              # bf16 dense block
-        tail = int(batch.X.tail_rows.nbytes + batch.X.tail_cols.nbytes
-                   + batch.X.tail_vals.nbytes)
+        X = batch.X
+        # matvec tail: pcols + vals + the cumsum pass; rmatvec: buckets
+        tail = int(X.tail_pcols.nbytes + X.tail_vals.nbytes
+                   + X.row_bounds.nbytes
+                   + sum(br.nbytes + bv.nbytes
+                         for br, bv in zip(X.bucket_rows, X.bucket_vals)))
         x_bytes = 2 * (hot + tail)
         state_bytes = (2 * 5 + 6) * bench.S_FEATURES * 4
         gbs = (x_bytes + state_bytes) / t_iter / 1e9

@@ -872,7 +872,25 @@ def last_column_is_intercept(X: Matrix) -> bool:
     if isinstance(X, PermutedHybridRows):
         if X.last_col_pos < X.d_sel:  # an intercept is maximally hot
             return bool((_host_col(X.dense, X.last_col_pos) == 1.0).all())
-        return False  # last column isn't even hot → not an all-rows 1
+        if X.last_col_pos >= X.n_prefix:
+            return False  # untouched by this batch → has zero entries
+        # Hot-selection tie-break can leave an every-row column in the
+        # tail (many columns hit all n rows, argpartition picks d_sel of
+        # them arbitrarily): scan its occurrence bucket — constant-1 in
+        # every row means n entries, all 1.0, rows a permutation of
+        # range(n).
+        n = X.dense.shape[0]
+        off = X.d_sel
+        for br, bv in zip(X.bucket_rows, X.bucket_vals):
+            c_b = br.shape[0]
+            if X.last_col_pos < off + c_b:
+                r = np.asarray(br[X.last_col_pos - off])
+                v = np.asarray(bv[X.last_col_pos - off])
+                real = v != 0.0
+                return bool(int(real.sum()) == n and (v[real] == 1.0).all()
+                            and (np.sort(r[real]) == np.arange(n)).all())
+            off += c_b
+        return False
     if isinstance(X, (HybridRows, ShardedHybridRows)):
         d = X.n_features
         cols = np.asarray(X.dense_cols)

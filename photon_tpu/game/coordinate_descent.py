@@ -77,11 +77,16 @@ def _fused_fixed_update(batch, base, scores, w0, obj, l1, y, weights,
 
 
 def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
-    from photon_tpu.data.matrix import ShardedHybridRows
+    from photon_tpu.data.matrix import PermutedHybridRows, ShardedHybridRows
     from photon_tpu.optim.config import OptimizerType
 
+    # PermutedHybridRows keeps the train_glm route: that boundary owns the
+    # permuted↔original coefficient-space translation — this fused program
+    # calling solve() directly would store PERMUTED coefficients in the
+    # model and scoring would re-permute them (silently wrong margins).
     return (prior is None and coord.mesh is None
-            and not isinstance(coord.dataset.X, ShardedHybridRows)
+            and not isinstance(coord.dataset.X,
+                               (ShardedHybridRows, PermutedHybridRows))
             and (coord.normalization is None
                  or coord.normalization.is_identity)
             # OWL-QN keeps the train_glm route: its single-device dense

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.dataset import GLMBatch
-from photon_tpu.data.matrix import HybridRows, Matrix, SparseRows
+from photon_tpu.data.matrix import (HybridRows, Matrix,
+                                    PermutedHybridRows, SparseRows)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,10 +79,10 @@ class GameData:
             else jax.device_put
 
         def put_shard(X):
-            if isinstance(X, HybridRows):
+            if isinstance(X, (HybridRows, PermutedHybridRows)):
                 if sharding is not None:
                     raise ValueError(
-                        "HybridRows shards cannot be row-sharded "
+                        f"{type(X).__name__} shards cannot be row-sharded "
                         "(single-device representation)")
                 return jax.device_put(X)  # registered pytree: one put
             if isinstance(X, SparseRows):
@@ -106,9 +107,9 @@ def _shard_dim(X: Matrix) -> int:
 
 def _gather_rows(X: Matrix, idx: np.ndarray):
     """Host-side row gather; returns numpy (dense) or numpy-backed SparseRows."""
-    if isinstance(X, HybridRows):
+    if isinstance(X, (HybridRows, PermutedHybridRows)):
         raise TypeError(
-            "HybridRows shards are not supported for GAME entity bucketing "
+            f"{type(X).__name__} shards are not supported for GAME entity bucketing "
             "(single-device fixed-effect representation); use SparseRows or "
             "dense shards for random-effect coordinates")
     if isinstance(X, SparseRows):
@@ -140,7 +141,8 @@ class FixedEffectDataset:
         import jax
 
         X = data.shards[shard_name]
-        if not isinstance(X, (SparseRows, HybridRows)) and not (
+        if not isinstance(X, (SparseRows, HybridRows,
+                              PermutedHybridRows)) and not (
                 isinstance(X, jax.Array)
                 and jnp.issubdtype(X.dtype, jnp.floating)):
             # host numpy (and integer device arrays) transfer/normalize as

@@ -57,6 +57,12 @@ CoordinateConfig = FixedEffectConfig | RandomEffectConfig
 
 from photon_tpu.data.matrix import last_column_is_intercept as _last_column_is_intercept
 
+# Auto-mode lane-axis gate: reg-weight spread (max/min across lanes) above
+# which lock-step lanes are assumed to lose to the per-lane-adaptive
+# sequential path (docs/PERF.md's masking A/B: spread 1e5 → lane-axis 3.7×
+# WORSE; spread ≤1e2 grids — every headline sweep — win on lanes).
+_GRID_SKEW_MAX = 1e4
+
 
 @dataclasses.dataclass
 class GameFitResult:
@@ -302,7 +308,8 @@ class GameEstimator:
         (fit() would fall back to the sequential path)."""
         vectorize = (self.vectorized_grid is True
                      or (self.vectorized_grid is None
-                         and not self.warm_start))
+                         and not self.warm_start
+                         and self._grid_reg_skew(grid) <= _GRID_SKEW_MAX))
         if not (vectorize and len(grid) >= 2
                 and not self.locked and not self.incremental
                 and not initial_models):
@@ -314,6 +321,31 @@ class GameEstimator:
         if self._game_grid_probe(grid) is None:
             return False
         return data is None or self._grid_data_supported(data)
+
+    def _grid_reg_skew(self, grid) -> float:
+        """Max over coordinates of the grid's reg-weight spread
+        (max/min across lanes). The lane-axis grid runs every chunk to its
+        SLOWEST lane's convergence (masked lanes still execute —
+        docs/PERF.md's masking A/B), so a strongly skewed grid pays
+        ~G × the hardest lane where the sequential path pays the sum of
+        adaptive per-lane costs (measured 3.7× worse lane-axis at spread
+        1e5). Auto mode (`vectorized_grid=None`) falls back to sequential
+        above ``_GRID_SKEW_MAX``; the explicit tri-state always wins. A
+        zero weight among positive ones counts as ≤1e-4 (zero-reg lanes
+        are the least-conditioned, slowest converging — strictly slower
+        than any positive-reg lane)."""
+        skew = 1.0
+        for name in set().union(*[set(g) for g in grid]) if grid else ():
+            ws = [float(g[name].optimizer.reg_weight)
+                  for g in grid if name in g]
+            pos = [w for w in ws if w > 0.0]
+            if not pos:
+                continue
+            lo = min(pos)
+            if len(pos) < len(ws):  # zero-reg lanes present
+                lo = min(lo / 10.0, 1e-4)
+            skew = max(skew, max(pos) / lo)
+        return skew
 
     def _fixed_seq_ok(self, probe) -> bool:
         return (self.update_sequence is None
@@ -351,13 +383,18 @@ class GameEstimator:
 
     def _grid_data_supported(self, data: GameData) -> bool:
         """Matrix layouts the lane-axis grid can run: dense or SparseRows.
-        HybridRows' flat COO tail has no (entity, lane) batched form, and
-        ShardedHybridRows needs the shard_map solver route."""
-        from photon_tpu.data.matrix import HybridRows, ShardedHybridRows
+        HybridRows' flat COO tail has no (entity, lane) batched form,
+        ShardedHybridRows needs the shard_map solver route, and
+        PermutedHybridRows' coefficient-space translation lives at the
+        train_glm/train_glm_grid boundary the game grid bypasses — all
+        three fall back to the sequential path (which routes through
+        train_glm and is correct for every layout)."""
+        from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
+                                            ShardedHybridRows)
 
         for cfg in self.coordinate_configs.values():
             X = data.shards[cfg.feature_shard]
-            if isinstance(X, ShardedHybridRows):
+            if isinstance(X, (ShardedHybridRows, PermutedHybridRows)):
                 return False
             if isinstance(X, HybridRows) and (
                     self.mesh is not None

@@ -151,7 +151,9 @@ def wolfe_line_search_lanes(
         a_star=zero, f_star=f0,
     )
     out = lax.while_loop(cond, body, init)
-    ok = out.done | (out.a_star > 0.0)
+    # Seeded-done lanes stay ok=False (alpha 0, nothing accepted) — the
+    # caller's own done mask is what keeps them frozen.
+    ok = (out.done & ~done_init) | (out.a_star > 0.0)
     return out.a_star, out.f_star, ok
 
 

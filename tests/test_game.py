@@ -798,6 +798,38 @@ class TestVectorizedGameGrid:
             coordinate_configs=self._configs(cfg_f, cfg_r))
         assert not warm.would_vectorize(grid)
 
+    def test_skew_aware_auto_policy(self):
+        """Auto mode (vectorized_grid=None) must fall back to sequential on
+        strongly skewed reg grids — docs/PERF.md's masking A/B measured the
+        lane-axis path 3.7× WORSE at spread 1e5 (lock-step runs every chunk
+        to its slowest lane) — while mild geomspace sweeps keep the lane
+        path and the explicit tri-state always wins."""
+        cfg_f = OptimizerConfig(max_iters=25, reg=reg.l2(), reg_weight=0.1)
+        cfg_r = OptimizerConfig(max_iters=20, reg=reg.l2(), reg_weight=1.0)
+
+        def make(**kw):
+            return GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs=self._configs(cfg_f, cfg_r),
+                warm_start=False, **kw)
+
+        skewed = self._grid(cfg_f, cfg_r,
+                            [(100.0, 1.0), (10.0, 1.0), (1.0, 1.0),
+                             (1e-3, 1.0)])   # the A/B's skewed profile
+        mild = self._grid(cfg_f, cfg_r,
+                          [(w, 1.0) for w in np.geomspace(1e-4, 1e-2, 4)])
+        auto = make()
+        assert auto._grid_reg_skew(skewed) > 1e4
+        assert not auto.would_vectorize(skewed)
+        assert auto.would_vectorize(mild)
+        # explicit tri-state overrides the heuristic in both directions
+        assert make(vectorized_grid=True).would_vectorize(skewed)
+        assert not make(vectorized_grid=False).would_vectorize(mild)
+        # a zero-reg lane among heavy ones counts as unconditioned (slow)
+        mixed_zero = self._grid(cfg_f, cfg_r,
+                                [(0.0, 1.0), (500.0, 1.0), (50.0, 1.0)])
+        assert not auto.would_vectorize(mixed_zero)
+
 
 def test_poisson_game_end_to_end(rng):
     """GAME with a second GLM family: per-entity Poisson rates recovered

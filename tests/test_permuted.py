@@ -231,6 +231,55 @@ def test_perm_pad_and_cast(rng):
     assert all(v.dtype == jnp.bfloat16 for v in bc.X.bucket_vals)
 
 
+def test_perm_intercept_in_tail_detected(rng):
+    """Hot-selection tie-break can leave an every-row intercept column in
+    the tail (other columns with duplicate entries out-count it); the
+    bucket scan must still recognize it — and reject a near-intercept
+    missing one row."""
+    from photon_tpu.data.matrix import last_column_is_intercept
+
+    n, d = 16, 6
+    ind = np.tile(np.array([[0, 0, 1, 1, 2, 5]], np.int32), (n, 1))
+    val = np.ones((n, 6), np.float32)
+    P = to_permuted_hybrid(SparseRows(jnp.asarray(ind), jnp.asarray(val), d),
+                           d_dense=2)
+    assert P.last_col_pos >= P.d_sel  # forced into the tail
+    assert last_column_is_intercept(P)
+    val2 = val.copy()
+    val2[3, 5] = 0.0  # intercept missing from one row
+    P2 = to_permuted_hybrid(
+        SparseRows(jnp.asarray(ind), jnp.asarray(val2), d), d_dense=2)
+    assert not last_column_is_intercept(P2)
+
+
+def test_perm_game_fixed_effect_falls_back_correctly(rng):
+    """A GAME fit whose fixed shard is PermutedHybridRows must route
+    through train_glm (which owns the coefficient-space translation), not
+    the fused update or the lane grid — and match the SparseRows fit."""
+    from photon_tpu.game.coordinate_descent import _fixed_fusable
+    from photon_tpu.game.dataset import GameData
+    from photon_tpu.game.estimator import FixedEffectConfig, GameEstimator
+
+    X, P = _power_law_sparse(rng, n=300, d=150, k=6, d_dense=16)
+    y = (rng.random(300) < 0.5).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-6, reg=l2(),
+                          reg_weight=1.0)
+
+    def fit(shard):
+        data = GameData.build(y, {"f": shard}, {})
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={"fixed": FixedEffectConfig("f", cfg)},
+            warm_start=False)
+        assert not est._grid_data_supported(data) or shard is X
+        return est.fit(data)[0]
+
+    r_p, r_s = fit(P), fit(X)
+    np.testing.assert_allclose(
+        np.asarray(r_p.model["fixed"].model.coefficients.means),
+        np.asarray(r_s.model["fixed"].model.coefficients.means), atol=5e-3)
+
+
 def test_perm_mesh_rejected(rng, mesh8):
     X, P = _power_law_sparse(rng, n=64, d=100, k=4)
     y = jnp.asarray(rng.normal(size=64).astype(np.float32))
