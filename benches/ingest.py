@@ -114,6 +114,50 @@ def main() -> None:
     print(f"exotic C++: {dt:6.2f}s  ({n / dt:,.0f} rec/s — schema the "
           "round-3 planner rejected, still native)")
 
+    # Consumed-exotic leg (VERDICT r4 item 5): the CONSUMED columns
+    # themselves in exotic shapes — union-wrapped bag, long-valued map
+    # bag, 3-branch scalar union, wide entity union — previously one such
+    # column dropped the whole job to the Python record decoder (~10x).
+    ntv = {"type": "record", "name": "NTV3", "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"}]}
+    schema3 = {"type": "record", "name": "ConsumedExotic", "fields": [
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "long", "string"],
+         "default": None},
+        {"name": "memberId",
+         "type": ["null", "string", {"type": "array", "items": "int"}],
+         "default": None},
+        {"name": "features", "type": ["null", {"type": "array",
+                                               "items": ntv}],
+         "default": None},
+        {"name": "ctx", "type": [{"type": "map", "values": "long"},
+                                 "null"]},
+    ]}
+    recs3 = [{"response": r["response"], "offset": None,
+              "weight": None if i % 3 else 2,
+              "memberId": r["memberId"],
+              "features": None if i % 13 == 7 else r["features"],
+              "ctx": None if i % 5 == 2 else {"c1": i % 9, "c2": 3}}
+             for i, r in enumerate(records)]
+    cfg3 = GameDataConfig(
+        shards={"all": FeatureShardConfig(bags=("features", "ctx"))},
+        entity_fields=("memberId",),
+        optional_entity_fields=("memberId",),
+    )
+    path3 = os.path.join(os.path.dirname(path), "bench_consumed.avro")
+    write_avro(path3, recs3, schema3)
+    for name, use_native in (("consumed py", False), ("consumed C++", True)):
+        t0 = time.perf_counter()
+        data, _ = read_game_data(path3, cfg3, use_native=use_native)
+        dt = time.perf_counter() - t0
+        assert data.n == n
+        note = " — every consumed column exotic, still native" \
+            if use_native else ""
+        print(f"{name:12s}: {dt:6.2f}s  ({n / dt:,.0f} rec/s{note})")
+
 
 if __name__ == "__main__":
     main()

@@ -50,12 +50,15 @@ def main() -> None:
         # overstate achieved bandwidth ~9%).
         hot = n * bench.S_DENSE * 2              # bf16 dense block
         X = batch.X
-        # matvec tail: pcols + vals + the cumsum pass; rmatvec: buckets
-        tail = int(X.tail_pcols.nbytes + X.tail_vals.nbytes
-                   + X.row_bounds.nbytes
-                   + sum(br.nbytes + bv.nbytes
-                         for br, bv in zip(X.bucket_rows, X.bucket_vals)))
-        x_bytes = 2 * (hot + tail)
+        # per-ITERATION tail traffic: the matvec pass reads the row-major
+        # arrays, the rmatvec pass reads the buckets — each once, so the
+        # sum is already both passes (only the hot block is read twice)
+        tail_mv = int(X.tail_pcols.nbytes + X.tail_vals.nbytes
+                      + X.row_bounds.nbytes)
+        tail_rmv = int(sum(br.nbytes + bv.nbytes
+                           for br, bv in zip(X.bucket_rows, X.bucket_vals)))
+        tail = tail_mv + tail_rmv
+        x_bytes = 2 * hot + tail
         state_bytes = (2 * 5 + 6) * bench.S_FEATURES * 4
         gbs = (x_bytes + state_bytes) / t_iter / 1e9
         print(f"rows={n:>8d}: {value:.3e} rows*iters/s  "
@@ -73,7 +76,8 @@ def main() -> None:
         print(f"fit: t_iter ≈ {t_state * 1e3:.1f} ms (d-linear state) + "
               f"rows × {t_row * 1e9:.2f} ns/row")
         # per-row X bytes from the last measured problem's real tail share
-        bw_rows = (bench.S_DENSE * 2 + tail / ns[-1]) * 2 / t_row
+        # (hot block twice per iteration, tail arrays once each)
+        bw_rows = (bench.S_DENSE * 2 * 2 + tail / ns[-1]) / t_row
         print(f"  X-pass effective bandwidth: {bw_rows / 1e9:.0f} GB/s; "
               f"state share at 524k rows: "
               f"{t_state / (t_state + (1 << 19) * t_row) * 100:.0f}%, "

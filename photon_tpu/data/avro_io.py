@@ -185,13 +185,42 @@ def read_datum(buf, schema):
 
 
 def _union_branch(schema_list, value):
-    """Pick the union branch for a Python value (writer side)."""
-    for i, s in enumerate(schema_list):
-        t = _schema_type(s)
-        if value is None and t == "null":
-            return i, s
-        if value is not None and t != "null":
-            return i, s
+    """Pick the union branch for a Python value (writer side): exact
+    Python-type match first (a str routes to the string branch of
+    [null, long, string], not the first non-null one), then a lenient
+    numeric match (int into float/double), then the first non-null
+    branch (the historical 2-branch-nullable behavior)."""
+    if value is None:
+        for i, s in enumerate(schema_list):
+            if _schema_type(s) == "null":
+                return i, s
+        raise ValueError(f"no null branch in {schema_list}")
+
+    def exact(t):
+        if t in ("int", "long"):
+            return isinstance(value, int) and not isinstance(value, bool)
+        if t in ("float", "double"):
+            return isinstance(value, float)
+        if t in ("string", "enum"):
+            return isinstance(value, str)
+        if t == "boolean":
+            return isinstance(value, bool)
+        if t in ("bytes", "fixed"):
+            return isinstance(value, (bytes, bytearray))
+        if t == "array":
+            return isinstance(value, (list, tuple))
+        if t in ("map", "record"):
+            return isinstance(value, dict)
+        return False
+
+    for match in (exact,
+                  lambda t: (t in ("float", "double")
+                             and isinstance(value, int)
+                             and not isinstance(value, bool)),
+                  lambda t: t != "null"):
+        for i, s in enumerate(schema_list):
+            if match(_schema_type(s)):
+                return i, s
     raise ValueError(f"no union branch for {value!r} in {schema_list}")
 
 

@@ -136,25 +136,36 @@ def records_to_game_data(
     from photon_tpu.data.index_map import DELIMITER
 
     n = len(records)
+
+    # Scalar/entity fields behind WIDE unions can carry a non-consumable
+    # branch value (e.g. weight: [null, long, string] holding a string).
+    # The defined semantic — shared with the native decoder's branch
+    # tables, pinned by tests/test_native.py — is that such values read as
+    # ABSENT (default applies), exactly like the null branch.
+    def _num(v):
+        return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+            else None
+
     f = config.response_field
     if config.allow_missing_response:
         y = np.fromiter(
-            (0.0 if (v := r.get(f)) is None else v for r in records),
+            (0.0 if (v := _num(r.get(f))) is None else v for r in records),
             np.float32, count=n)
     else:
         y = np.fromiter((r[f] for r in records), np.float32, count=n)
     f = config.offset_field
     offsets = np.fromiter(
-        (0.0 if (v := r.get(f)) is None else v for r in records),
+        (0.0 if (v := _num(r.get(f))) is None else v for r in records),
         np.float32, count=n)
     f = config.weight_field
     weights = np.fromiter(
-        (1.0 if (v := r.get(f)) is None else v for r in records),
+        (1.0 if (v := _num(r.get(f))) is None else v for r in records),
         np.float32, count=n)
     ids: dict = {}
     optional = set(config.optional_entity_fields)
     for e in config.entity_fields:
-        col = [r.get(e) for r in records]
+        col = [v if isinstance(v := r.get(e), str) else None
+               for r in records]
         if any(v is None for v in col):
             if e not in optional:
                 i = col.index(None)

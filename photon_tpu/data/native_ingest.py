@@ -32,7 +32,8 @@ from photon_tpu.game.dataset import GameData
 # the numbers stay reserved so op ids are stable across versions.
 _OP_DOUBLE, _OP_OPT_DOUBLE, _OP_RETIRED_2, _OP_ENTITY, _OP_BAG, \
     _OP_RETIRED_5, _OP_RETIRED_6, _OP_GENERIC_SKIP, _OP_SCALAR_GEN, \
-    _OP_ENTITY_GEN, _OP_BAG_MAP = range(11)
+    _OP_ENTITY_GEN, _OP_BAG_MAP, _OP_SCALAR_UNION, _OP_ENTITY_UNION = \
+    range(13)
 
 # skip-program bytecodes (photon_native.cc::skip_value)
 _SK_NULL, _SK_BOOL, _SK_VARINT, _SK_FLOAT, _SK_DOUBLE, _SK_BYTES, \
@@ -133,8 +134,13 @@ def _two_branch_mode(schema, kinds) -> Optional[tuple]:
     return None
 
 
+# bag value wire kinds (aux array `vkinds`): 0=double, 1=float, 2=varint
+# (long/int — zigzag on the wire either way)
+_BAG_VALUE_KIND = {"double": 0, "float": 1, "long": 2, "int": 2}
+
+
 def _ntv_value_kind(items) -> Optional[int]:
-    """0=double, 1=float when items is a NameTermValue-shaped record."""
+    """Bag value kind when items is a NameTermValue-shaped record."""
     if _schema_type(items) != "record":
         return None
     fields = items.get("fields", [])
@@ -144,20 +150,71 @@ def _ntv_value_kind(items) -> Optional[int]:
     types = [_schema_type(f["type"]) for f in fields]
     if names != ["name", "term", "value"] or types[:2] != ["string", "string"]:
         return None
-    return {"double": 0, "float": 1}.get(types[2])
+    return _BAG_VALUE_KIND.get(types[2])
+
+
+def _bag_mode(schema) -> Optional[tuple]:
+    """(mode, bag_schema_dict) for plain or 2-branch-nullable bag fields:
+    mode 0 = plain array/map, 1 = [null, bag], 2 = [bag, null]."""
+    ts = _schema_type(schema)
+    if ts in ("array", "map"):
+        return 0, schema
+    if isinstance(schema, list) and len(schema) == 2:
+        t0, t1 = _schema_type(schema[0]), _schema_type(schema[1])
+        if t0 == "null" and t1 in ("array", "map"):
+            return 1, schema[1]
+        if t1 == "null" and t0 in ("array", "map"):
+            return 2, schema[0]
+    return None
+
+
+def _union_branch_table(schema, consumed_types, skips: "_SkipTable"
+                        ) -> Optional[tuple]:
+    """(codes, consumed_type_name) for an arbitrary union consuming ONE
+    branch: exactly one branch's type is in `consumed_types`; nulls map to
+    -1 (unset), the consumed branch to -2, every other branch to its
+    generic skip-program id. A POPULATED non-consumed branch reads as
+    ABSENT (the default applies) — the same semantic the pure-Python
+    path's records_to_game_data applies to non-numeric/non-string values,
+    so native and Python stay bit-identical (pinned by tests/
+    test_native.py with populated odd branches). None when zero or
+    several branches qualify."""
+    if not isinstance(schema, list):
+        return None
+    branch_types = [_schema_type(b) for b in schema]
+    if sum(ts in consumed_types for ts in branch_types) != 1:
+        return None  # ambiguous (e.g. [null, double, float]): Python path
+    codes, hit = [], None
+    for b, ts in zip(schema, branch_types):
+        if ts == "null":
+            codes.append(-1)
+        elif ts in consumed_types:
+            hit = ts
+            codes.append(-2)
+        else:
+            pid = skips.add(b)
+            if pid is None:
+                return None
+            codes.append(pid)
+    return codes, hit
 
 
 def compile_plan(schema, config: GameDataConfig):
-    """Schema → (ops, aux, vkinds, bag names, sk_prog, sk_off) or None.
+    """Schema → (ops, aux, vkinds, bag names, sk_prog, sk_off, bt_flat,
+    bt_off) or None.
 
     CONSUMED fields must match a supported shape: scalars are
-    double/float/int/long, plain or 2-branch nullable (either order);
-    entity columns are string, plain or 2-branch nullable; configured
-    feature bags are array<NameTermValue> or map<string, double|float>.
+    double/float/int/long — plain, 2-branch nullable (either order), or
+    behind a WIDER union whose single numeric branch is consumed and
+    whose other branches compile to skip programs (decoded-but-unset);
+    entity columns are string with the same plain/nullable/wide-union
+    shapes; configured feature bags are array<NameTermValue> or
+    map<string, double|float|long|int>, plain or 2-branch nullable.
     Every UNCONSUMED field of any Avro shape — nested records, wide
     unions, enums, fixed, maps, arrays — compiles to a generic skip
     program and stays on the native road (the round-3 builder rejected
-    the whole schema over one odd extra field, a ~10-20x ingest cliff)."""
+    the whole schema over one odd extra field, a ~10-20x ingest cliff;
+    round 5 removed the same cliff for exotic CONSUMED shapes)."""
     if _schema_type(schema) != "record":
         return None
     scalar_slots = {config.response_field: 0, config.offset_field: 1,
@@ -165,6 +222,7 @@ def compile_plan(schema, config: GameDataConfig):
     entity_idx = {e: i for i, e in enumerate(config.entity_fields)}
     required = {b for cfg in config.shards.values() for b in cfg.bags}
     skips = _SkipTable()
+    branch_tables: list = []
     ops, aux, vkinds, bag_names = [], [], [], []
     for f in schema["fields"]:
         name, t = f["name"], f["type"]
@@ -176,41 +234,53 @@ def compile_plan(schema, config: GameDataConfig):
             elif _is_opt(t, "double"):
                 ops.append(_OP_OPT_DOUBLE)
                 aux.append(scalar_slots[name])
-            else:
-                m = _two_branch_mode(t, _NUM_KIND)
-                if m is None:
-                    return None
+            elif (m := _two_branch_mode(t, _NUM_KIND)) is not None:
                 mode, inner = m
                 ops.append(_OP_SCALAR_GEN)
                 aux.append(scalar_slots[name] | (_NUM_KIND[inner] << 8)
                            | (mode << 16))
+            else:
+                bt = _union_branch_table(t, _NUM_KIND, skips)
+                if bt is None:
+                    return None
+                codes, inner = bt
+                branch_tables.append(codes)
+                ops.append(_OP_SCALAR_UNION)
+                aux.append(scalar_slots[name] | (_NUM_KIND[inner] << 8)
+                           | ((len(branch_tables) - 1) << 16))
         elif name in entity_idx:
             if _is_opt(t, "string"):
                 ops.append(_OP_ENTITY)
                 aux.append(entity_idx[name])
-            else:
-                m = _two_branch_mode(t, ("string",))
-                if m is None:
-                    return None
+            elif (m := _two_branch_mode(t, ("string",))) is not None:
                 mode, _ = m
                 ops.append(_OP_ENTITY_GEN)
                 aux.append(entity_idx[name] | (mode << 16))
+            else:
+                bt = _union_branch_table(t, ("string",), skips)
+                if bt is None:
+                    return None
+                branch_tables.append(bt[0])
+                ops.append(_OP_ENTITY_UNION)
+                aux.append(entity_idx[name]
+                           | ((len(branch_tables) - 1) << 16))
         elif name in required:
-            if ts == "array":
+            bm = _bag_mode(t)
+            if bm is None:
+                return None
+            mode, bag_t = bm
+            if _schema_type(bag_t) == "array":
                 vk = _ntv_value_kind(
-                    t["items"] if isinstance(t, dict) else None)
+                    bag_t["items"] if isinstance(bag_t, dict) else None)
                 if vk is None:
                     return None
                 ops.append(_OP_BAG)
-            elif ts == "map":
-                vk = {"double": 0, "float": 1}.get(
-                    _schema_type(t["values"]))
+            else:
+                vk = _BAG_VALUE_KIND.get(_schema_type(bag_t["values"]))
                 if vk is None:
                     return None
                 ops.append(_OP_BAG_MAP)
-            else:
-                return None
-            aux.append(len(bag_names))
+            aux.append(len(bag_names) | (mode << 16))
             vkinds.append(vk)
             bag_names.append(name)
         else:
@@ -223,7 +293,13 @@ def compile_plan(schema, config: GameDataConfig):
     if not required.issubset(bag_names):
         return None  # a configured bag is missing from the schema
     sk_prog, sk_off = skips.tables()
-    return ops, aux, vkinds, bag_names, sk_prog, sk_off
+    bt_flat, bt_off = [], []
+    for codes in branch_tables:
+        bt_off.append(len(bt_flat))
+        bt_flat.append(len(codes))
+        bt_flat.extend(codes)
+    return (ops, aux, vkinds, bag_names, sk_prog, sk_off,
+            bt_flat or [0], bt_off or [0])
 
 
 def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
@@ -231,7 +307,7 @@ def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
     consumes its shard's bags IN CONFIG ORDER (id-assignment parity with
     build_index_map's `for bag in config.bags` loop). Shared by the
     one-shot reader and data.streaming."""
-    ops, aux, vkinds, bag_names, sk_prog, sk_off = plan0
+    ops, aux, vkinds, bag_names, sk_prog, sk_off, bt_flat, bt_off = plan0
     sb_off, sb_idx = [0], []
     for s in shard_names:
         sb_idx.extend(bag_names.index(b) for b in config.shards[s].bags)
@@ -240,7 +316,8 @@ def build_decode_plan(plan0, config: GameDataConfig, shard_names) -> tuple:
             np.asarray(vkinds or [0], np.int32),
             np.asarray(sb_off, np.int32),
             np.asarray(sb_idx or [0], np.int32), len(config.entity_fields),
-            np.asarray(sk_prog, np.int32), np.asarray(sk_off, np.int32))
+            np.asarray(sk_prog, np.int32), np.asarray(sk_off, np.int32),
+            np.asarray(bt_flat, np.int32), np.asarray(bt_off, np.int32))
 
 
 def frozen_stores(index_maps: dict, shard_names) -> list:
@@ -347,10 +424,13 @@ def read_game_data_native(
                                   cfg.dense_threshold, k=sparse_k)
 
     ids = {}
+    optional = set(config.optional_entity_fields)
     for e_i, e in enumerate(config.entity_fields):
         col = (np.concatenate(ents[e_i]) if ents[e_i]
                else np.zeros(0, object))
-        if any(v is None for v in col):  # null union branch, like Python path
-            raise ValueError(f"records missing entity id {e!r}")
+        if any(v is None for v in col):  # null union branch
+            if e not in optional:  # like the Python path's error
+                raise ValueError(f"records missing entity id {e!r}")
+            col = np.asarray(["" if v is None else v for v in col], object)
         ids[e] = np.asarray([str(v) for v in col])
     return GameData(y, weights, offsets, shards, ids), index_maps

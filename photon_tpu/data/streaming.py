@@ -193,6 +193,17 @@ class ChunkStream:
     # right after next() to null out labels row by row).
     saw_missing_response: bool = False
     last_response_mask: Optional[np.ndarray] = None
+    # Per-row presence of each OPTIONAL entity field in the most recently
+    # yielded chunk ({field: (n,) bool}): chunk assembly folds a missing id
+    # to "" for the column arrays, which conflates it with a legitimate
+    # empty-string id — consumers that must tell the two apart (the
+    # scoring driver's nullable ScoredItemAvro.uid) read this instead.
+    last_entity_presence: Optional[dict] = None
+    # uniform_sparse_k=False only: quantize each chunk's own SparseRows
+    # nnz width up to a power of two, so the per-chunk device programs
+    # compile a handful of shapes instead of one per distinct raggedness
+    # (tens of seconds per XLA compile through a remote tunnel).
+    quantize_k: bool = False
 
     def _note(self, live_bytes: int) -> None:
         if live_bytes > self.peak_arena_bytes:
@@ -238,7 +249,9 @@ def iter_game_chunks(
     """
     index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k,
                                        uniform_sparse_k)
-    stream = ChunkStream(config, index_maps, chunk_rows, sparse_k)
+    stream = ChunkStream(config, index_maps, chunk_rows, sparse_k,
+                         quantize_k=(not uniform_sparse_k
+                                     and sparse_k is None))
     if use_native is not False:
         # Availability / plannability checked EAGERLY (before the first
         # next()), so a forced use_native=True fails at the call site.
@@ -250,6 +263,32 @@ def iter_game_chunks(
                 "native streaming requested but unavailable (toolchain "
                 "missing or schema not plannable)")
     return stream, _python_chunks(path, stream)
+
+
+def _quantize_widths(stream: ChunkStream, data: GameData) -> GameData:
+    """Pad each SparseRows shard's nnz width up to the next power of two
+    (stream.quantize_k; padding slots are (index 0, value 0) no-ops)."""
+    from photon_tpu.data.matrix import SparseRows, next_pow2
+
+    if not stream.quantize_k:
+        return data
+    shards = {}
+    changed = False
+    for s, X in data.shards.items():
+        if isinstance(X, SparseRows):
+            k = X.indices.shape[1]
+            kq = next_pow2(max(k, 1))
+            if kq != k:
+                pad = ((0, 0), (0, kq - k))
+                X = SparseRows(np.pad(np.asarray(X.indices), pad),
+                               np.pad(np.asarray(X.values), pad),
+                               X.n_features)
+                changed = True
+        shards[s] = X
+    if not changed:
+        return data
+    return GameData(data.y, data.weights, data.offsets, shards,
+                    data.entity_ids)
 
 
 def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
@@ -268,8 +307,12 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
             stream.last_response_mask = mask
             if not mask.all():
                 stream.saw_missing_response = True
+        stream.last_entity_presence = {
+            e: np.asarray([r.get(e) is not None for r in buf])
+            for e in stream.config.optional_entity_fields}
         data, _ = records_to_game_data(buf, stream.config, stream.index_maps,
                                        stream.sparse_k, host=True)
+        data = _quantize_widths(stream, data)
         # the record buffer and the assembled chunk coexist briefly
         stream._note(2 * _chunk_nbytes(data))
         buf.clear()
@@ -348,15 +391,20 @@ def _native_chunks(path, stream: ChunkStream):
                                           cfg.dense_threshold,
                                           k=stream.sparse_k, host=True)
             ids = {}
+            presence: dict = {}
             for e_i, e in enumerate(config.entity_fields):
                 col = np.concatenate(ents[e_i])
+                if e in optional_ents:
+                    presence[e] = np.asarray([v is not None for v in col])
                 if any(v is None for v in col):
                     if e not in optional_ents:
                         raise ValueError(f"records missing entity id {e!r}")
                     col = np.asarray(["" if v is None else v for v in col],
                                      object)
                 ids[e] = np.asarray([str(v) for v in col])
-            out = GameData(y, weights, offsets, shards, ids)
+            stream.last_entity_presence = presence
+            out = _quantize_widths(
+                stream, GameData(y, weights, offsets, shards, ids))
             # block pieces + the assembled chunk coexist briefly
             stream._note(live + _chunk_nbytes(out))
             ys.clear(); offs.clear(); wts.clear()                  # noqa: E702

@@ -87,6 +87,12 @@ class ScoringParams:
     # True forces the native C++ block decoder (error if unavailable),
     # False forces pure Python, None tries native and falls back.
     use_native: Optional[bool] = None
+    # Persistent XLA compilation cache — same semantics as
+    # TrainingParams.compilation_cache_dir ("" off, path wins, None →
+    # $JAX_COMPILATION_CACHE_DIR else <output_dir>/xla_cache). Scoring
+    # compiles one program per quantized chunk shape; a warm cache makes
+    # a fresh scorer process skip them all.
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         self.feature_shards = {
@@ -209,6 +215,16 @@ def _quantize(n: int) -> int:
 
 def run_scoring(params: ScoringParams) -> ScoringOutput:
     log = photon_logger("photon_tpu.score", params.output_dir)
+
+    from photon_tpu.utils.compile_cache import (enable_compilation_cache,
+                                                resolve_cache_dir)
+
+    cache_dir = resolve_cache_dir(params.compilation_cache_dir,
+                                  params.output_dir)
+    if cache_dir is not None:
+        enable_compilation_cache(cache_dir)
+        log.info("persistent XLA compilation cache at %s", cache_dir)
+
     model, index_maps = load_game_model(params.model_dir)
 
     # Columns must line up with the model: reuse the saved index maps, keyed
@@ -275,12 +291,12 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
 
         def flush(pending) -> None:
             nonlocal group_cols, n_rows, n_chunks
-            n_c, uids, y_host, w_host, ents_host, mask, margin_dev, \
-                out_dev = pending
+            n_c, uids, uid_present, y_host, w_host, ents_host, mask, \
+                margin_dev, out_dev = pending
             scores_c = np.asarray(out_dev, np.float64)[:n_c]  # blocks here
             writer.write_block(n_c, encode_scored_block(
                 uids, scores_c, np.asarray(y_host, np.float64), mask,
-                uids != ""))
+                uid_present))
             scores_acc.append(scores_c)
             if stream.saw_missing_response:
                 margins_acc.clear()
@@ -303,12 +319,21 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
                 mask = (stream.last_response_mask
                         if stream.last_response_mask is not None
                         else np.ones(n_c, bool))
+                # Null-vs-"" uid fidelity: the decoder's presence mask (a
+                # missing uid writes the null union branch; a legitimate
+                # empty-STRING uid stays a string — chunk column arrays
+                # fold both to "", so the mask is the only witness).
+                uid_present = (stream.last_entity_presence or {}).get(
+                    params.uid_field)
+                if uid_present is None:
+                    uid_present = np.ones(n_c, bool)
                 padded = _pad_chunk(chunk, _quantize(n_c))
                 margin_dev = score_game(model, padded.to_device())
                 out_dev = model.mean(margin_dev) if params.output_mean \
                     else margin_dev
                 this = (n_c,
                         np.asarray(chunk.entity_ids[params.uid_field]),
+                        uid_present,
                         np.asarray(chunk.y), np.asarray(chunk.weights),
                         {e: np.asarray(chunk.entity_ids[e])
                          for e in group_cols},

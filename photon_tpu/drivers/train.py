@@ -183,6 +183,13 @@ class TrainingParams:
     # through loaded models. Grid mode only; incompatible with
     # incremental_coordinates (per-point fits would drift the priors).
     resume: bool = False
+    # Persistent XLA compilation cache (utils/compile_cache.py): ""
+    # disables, an explicit path wins (relative → under output_dir), None
+    # defers to $JAX_COMPILATION_CACHE_DIR and otherwise defaults to
+    # <output_dir>/xla_cache — so a re-run of the same job shapes in a
+    # fresh process skips most of its XLA compiles (the reference's JVM
+    # pays startup once per application; measured in docs/PERF.md).
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.output_mode.upper() not in ("BEST", "ALL"):
@@ -274,6 +281,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
     timers = PhaseTimers()
     task = TaskType[params.task]
     mode = DataValidationType(params.data_validation)
+
+    from photon_tpu.utils.compile_cache import (enable_compilation_cache,
+                                                resolve_cache_dir)
+
+    cache_dir = resolve_cache_dir(params.compilation_cache_dir,
+                                  params.output_dir)
+    if cache_dir is not None:
+        enable_compilation_cache(cache_dir)
+        log.info("persistent XLA compilation cache at %s", cache_dir)
 
     with timers("read"):
         data_cfg = GameDataConfig(
@@ -380,6 +396,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
                 import jax
 
+                if (hasattr(data.y, "is_fully_addressable")
+                        and not data.y.is_fully_addressable):
+                    raise ValueError(
+                        "down_sampling_rate with streaming ingestion is "
+                        "single-controller only: the weight rewrite reads "
+                        "the global label array back to this host, which "
+                        "cannot assemble non-addressable multi-process "
+                        "shards — down-sample in the data pipeline (or "
+                        "per process before stream_to_device) instead")
                 binary = _binary_task(task)
                 new_w = down_sample_weights(
                     np.asarray(data.y), params.down_sampling_rate,

@@ -67,7 +67,7 @@ def _bind(lib) -> None:
         u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
         i32p, i32p, ctypes.c_int32, i32p, ctypes.c_int32, i32p, i32p,
         ctypes.POINTER(vp), ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        i32p, i32p]
+        i32p, i32p, i32p, i32p]
     lib.ph_decoded_ok.restype = ctypes.c_int32
     lib.ph_decoded_ok.argtypes = [vp]
     lib.ph_decoded_scalars.argtypes = [vp, ctypes.c_int32, f64p, u8p]
@@ -308,27 +308,30 @@ def decode_block(payload: bytes, count: int, row0: int, plan,
 
     plan: (ops i32[], aux i32[], ntv_value_kind i32[n_bags],
            store_bag_off i32[n_stores+1], store_bag_idx i32[], n_entities,
-           sk_prog i32[], sk_off i32[])
+           sk_prog i32[], sk_off i32[], bt_flat i32[], bt_off i32[])
     — store s consumes bags store_bag_idx[store_bag_off[s]:
     store_bag_off[s+1]] in that order (the shard config's bag order, which
     fixes feature-id assignment order in build mode); sk_prog/sk_off is
-    the skip-program table for generic-skip ops (op 7).
+    the skip-program table for generic-skip ops (op 7); bt_flat/bt_off are
+    the union branch tables for the scalar/entity union ops (11/12).
     stores: list of NativeIndexStore (column spaces, one per shard).
     """
     lib = get_lib()
-    ops, aux, vkind, sb_off, sb_idx, n_entities, sk_prog, sk_off = plan
+    (ops, aux, vkind, sb_off, sb_idx, n_entities, sk_prog, sk_off,
+     bt_flat, bt_off) = plan
     n_bags = len(vkind)
     pay = np.frombuffer(payload, np.uint8)
     store_arr = (ctypes.c_void_p * max(len(stores), 1))(
         *[s._h for s in stores])
     # keep the contiguous arrays alive across the call
     arrs = [np.ascontiguousarray(a, np.int32)
-            for a in (ops, aux, vkind, sb_off, sb_idx, sk_prog, sk_off)]
+            for a in (ops, aux, vkind, sb_off, sb_idx, sk_prog, sk_off,
+                      bt_flat, bt_off)]
     i32 = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
     h = lib.ph_decode_block(
         _as_u8p(pay), ctypes.c_uint64(len(payload)), ctypes.c_uint64(count),
         ctypes.c_uint64(row0), i32(arrs[0]), i32(arrs[1]), len(ops),
         i32(arrs[2]), n_bags, i32(arrs[3]), i32(arrs[4]),
         store_arr, len(stores), n_entities, 1 if build_mode else 0,
-        i32(arrs[5]), i32(arrs[6]))
+        i32(arrs[5]), i32(arrs[6]), i32(arrs[7]), i32(arrs[8]))
     return DecodedBlock(lib, h, count, len(stores), n_entities)

@@ -439,13 +439,19 @@ struct BagEntry {
 void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
                       uint64_t count, uint64_t row0,
                       const int32_t* ops, const int32_t* aux, int32_t n_ops,
-                      const int32_t* ntv_value_kind,  // per bag: 0=double,1=float
+                      const int32_t* ntv_value_kind,  // per bag: 0=double,
+                                                      // 1=float, 2=long/int
                       int32_t n_bags,
                       const int32_t* store_bag_off,
                       const int32_t* store_bag_idx,
                       void** stores, int32_t n_stores, int32_t n_entities,
                       int32_t build_mode,
-                      const int32_t* sk_prog, const int32_t* sk_off) {
+                      const int32_t* sk_prog, const int32_t* sk_off,
+                      // scalar/entity union branch tables (ops 11/12):
+                      // table t = bt_flat[bt_off[t] .. ]: [n_branches,
+                      // code...] with code -2 = the consumed branch,
+                      // -1 = null/unset, >=0 = skip-program id (unset).
+                      const int32_t* bt_flat, const int32_t* bt_off) {
   Decoded* out = new Decoded();
   for (int k = 0; k < 3; ++k) {
     out->scalars[k].assign(count, 0.0);
@@ -507,7 +513,17 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
           break;
         }
         case 4: {  // feature bag: buffer entries; stores flush at record end
-          int vkind = ntv_value_kind[a];
+          int bag = a & 0xFFFF, mode = (a >> 16) & 0xFF;
+          if (mode != 0) {  // union-wrapped bag: [null, array] / [array, null]
+            int64_t branch = read_long(&c);
+            if (branch < 0 || branch > 1) {
+              c.ok = false;
+              break;
+            }
+            bool present = (mode == 1) ? (branch == 1) : (branch == 0);
+            if (!present) break;  // null bag = no entries
+          }
+          int vkind = ntv_value_kind[bag];
           for (;;) {
             int64_t bn = read_long(&c);
             if (!c.ok || bn == 0) break;
@@ -519,7 +535,9 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
               int64_t nlen, tlen;
               const uint8_t* name = read_str(&c, &nlen);
               const uint8_t* term = read_str(&c, &tlen);
-              double value = vkind ? read_float(&c) : read_double(&c);
+              double value = vkind == 1   ? read_float(&c)
+                             : vkind == 2 ? static_cast<double>(read_long(&c))
+                                          : read_double(&c);
               if (!c.ok) break;
               uint64_t off = key_arena.size();
               key_arena.insert(key_arena.end(), name, name + nlen);
@@ -529,7 +547,7 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
                 key_arena.insert(key_arena.end(), term, term + tlen);
                 klen += 1 + static_cast<uint32_t>(tlen);
               }
-              bag_entries[a].push_back(
+              bag_entries[bag].push_back(
                   BagEntry{off, klen, static_cast<float>(value)});
             }
           }
@@ -588,8 +606,18 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
           }
           break;
         }
-        case 10: {  // map<string, double|float> feature bag
-          int vkind = ntv_value_kind[a];
+        case 10: {  // map<string, double|float|long> feature bag
+          int bag = a & 0xFFFF, mode = (a >> 16) & 0xFF;
+          if (mode != 0) {  // union-wrapped map bag
+            int64_t branch = read_long(&c);
+            if (branch < 0 || branch > 1) {
+              c.ok = false;
+              break;
+            }
+            bool present = (mode == 1) ? (branch == 1) : (branch == 0);
+            if (!present) break;
+          }
+          int vkind = ntv_value_kind[bag];
           for (;;) {
             int64_t bn = read_long(&c);
             if (!c.ok || bn == 0) break;
@@ -600,14 +628,61 @@ void* ph_decode_block(const uint8_t* payload, uint64_t payload_len,
             for (int64_t k = 0; k < bn && c.ok; ++k) {
               int64_t klen;
               const uint8_t* kp = read_str(&c, &klen);
-              double value = vkind ? read_float(&c) : read_double(&c);
+              double value = vkind == 1   ? read_float(&c)
+                             : vkind == 2 ? static_cast<double>(read_long(&c))
+                                          : read_double(&c);
               if (!c.ok) break;
               uint64_t off = key_arena.size();
               key_arena.insert(key_arena.end(), kp, kp + klen);
-              bag_entries[a].push_back(BagEntry{
+              bag_entries[bag].push_back(BagEntry{
                   off, static_cast<uint32_t>(klen),
                   static_cast<float>(value)});
             }
+          }
+          break;
+        }
+        case 11: {  // scalar behind an arbitrary union (branch table)
+          int32_t slot = a & 0xFF, kind = (a >> 8) & 0xFF, bt = a >> 16;
+          const int32_t* tab = bt_flat + bt_off[bt];
+          int64_t branch = read_long(&c);
+          if (!c.ok || branch < 0 || branch >= tab[0]) {
+            c.ok = false;
+            break;
+          }
+          int32_t code = tab[1 + branch];
+          if (code == -2) {
+            double v = kind == 0   ? read_double(&c)
+                       : kind == 1 ? static_cast<double>(read_float(&c))
+                                   : static_cast<double>(read_long(&c));
+            if (c.ok) {
+              out->scalars[slot][rec] = v;
+              out->scalar_set[slot][rec] = 1;
+            }
+          } else if (code >= 0) {  // non-consumed branch: skip, stay unset
+            skip_value(&c, sk_prog, sk_off, code, 0);
+          }                        // code -1: null, unset
+          break;
+        }
+        case 12: {  // entity string behind an arbitrary union
+          int32_t ent = a & 0xFFFF, bt = a >> 16;
+          const int32_t* tab = bt_flat + bt_off[bt];
+          int64_t branch = read_long(&c);
+          if (!c.ok || branch < 0 || branch >= tab[0]) {
+            c.ok = false;
+            break;
+          }
+          int32_t code = tab[1 + branch];
+          if (code == -2) {
+            int64_t len;
+            const uint8_t* s = read_str(&c, &len);
+            if (c.ok) {
+              auto& arena = out->ent_arena[ent];
+              out->ent_offsets[ent][2 * rec] = arena.size();
+              out->ent_offsets[ent][2 * rec + 1] = len;
+              arena.insert(arena.end(), s, s + len);
+            }
+          } else if (code >= 0) {
+            skip_value(&c, sk_prog, sk_off, code, 0);
           }
           break;
         }

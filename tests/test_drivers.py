@@ -94,6 +94,37 @@ class TestTrainingDriver:
         assert set(model.names()) == {"fixed", "perUser"}
         assert "read" in out.timings and "train" in out.timings
 
+    def test_compilation_cache_knob(self, job_dirs, tmp_path):
+        """Default: persistent XLA cache lands under output_dir; "" turns
+        it off; an explicit relative path lands under output_dir too."""
+        import jax
+
+        from photon_tpu.utils.compile_cache import resolve_cache_dir
+
+        assert resolve_cache_dir(None, "/o") == "/o/xla_cache"
+        assert resolve_cache_dir("", "/o") is None
+        assert resolve_cache_dir("cc", "/o") == "/o/cc"
+        assert resolve_cache_dir("/abs/cc", "/o") == "/abs/cc"
+
+        root, *_ = job_dirs
+        out_dir = tmp_path / "cache_job"
+        params = TrainingParams(
+            train_path=str(root / "train.avro"),
+            output_dir=str(out_dir),
+            feature_shards=FEATURE_SHARDS,
+            coordinates={"fixed": COORDINATES["fixed"]},
+            entity_fields=["userId"],
+            n_sweeps=1,
+        )
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            run_training(params)
+            assert jax.config.jax_compilation_cache_dir == str(
+                out_dir / "xla_cache")
+            assert (out_dir / "xla_cache").is_dir()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
     def test_scoring_driver_round_trip(self, job_dirs):
         root, _, y_val = job_dirs
         params = TrainingParams(

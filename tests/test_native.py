@@ -150,15 +150,17 @@ class TestNativeIngest:
         _assert_same(gd_n, maps, gd_p, maps)
 
     def test_unplannable_schema_falls_back(self, tmp_path, gd_config, rng):
-        # Unconsumed fields of any shape now skip natively; what remains
-        # unplannable is a CONSUMED field outside the supported shapes —
-        # here a 3-branch union response → native returns None and
+        # Unconsumed fields of any shape skip natively, and consumed
+        # scalars accept wide unions with ONE numeric branch (round 5);
+        # what remains unplannable is an AMBIGUOUS consumed union — two
+        # numeric branches — where picking one would silently drop the
+        # other's values. Native returns None and
         # read_game_data(use_native=True) raises.
         schema = training_example_schema(feature_bags=("features", "ctx"),
                                          entity_fields=("userId",))
         for f in schema["fields"]:
             if f["name"] == "response":
-                f["type"] = ["null", "double", "string"]
+                f["type"] = ["null", "double", "float"]
         recs = _fixture_records(rng, 10)
         path = tmp_path / "odd.avro"
         write_avro(path, recs, schema)
@@ -337,3 +339,111 @@ def test_deeply_nested_skip_refuses_at_plan_time():
     schema2 = training_example_schema(feature_bags=("features",))
     schema2["fields"].append({"name": "deep", "type": t2})
     assert compile_plan(schema2, cfg) is not None
+
+
+class TestExoticConsumedShapes:
+    """Round-5 planner widening: CONSUMED fields in exotic shapes decode
+    natively — union-wrapped bags, 3+-branch scalar/entity unions,
+    long/int bag values — each pinned native == pure-Python (the last
+    ~10x ingest cliff: one odd consumed column used to drop the whole job
+    to the Python record decoder)."""
+
+    def _schema(self):
+        ntv_int = {"type": "record", "name": "NTVInt", "fields": [
+            {"name": "name", "type": "string"},
+            {"name": "term", "type": "string"},
+            {"name": "value", "type": "int"}]}
+        return {"type": "record", "name": "Exotic", "fields": [
+            # 3-branch scalar union: one numeric branch + null + skippable
+            {"name": "response", "type": "double"},
+            {"name": "offset", "type": ["null", "double"], "default": None},
+            {"name": "weight",
+             "type": ["null", "long", "string"], "default": None},
+            # entity behind a wide union (data only uses string/null)
+            {"name": "userId",
+             "type": ["null", "string", {"type": "array", "items": "int"}],
+             "default": None},
+            # [null, array<NTV-with-int-values>]
+            {"name": "features", "type": ["null", {"type": "array",
+                                                   "items": ntv_int}],
+             "default": None},
+            # [map<string, long>, null] — reversed branch order
+            {"name": "ctx",
+             "type": [{"type": "map", "values": "long"}, "null"]},
+        ]}
+
+    def _records(self, rng, n=120):
+        recs = []
+        for i in range(n):
+            feats = (None if i % 7 == 0 else
+                     [{"name": f"f{int(j)}", "term": "t" if j % 2 else "",
+                       "value": int(rng.integers(-5, 6))}
+                      for j in rng.choice(20, size=rng.integers(1, 5),
+                                          replace=False)])
+            ctx = (None if i % 5 == 3 else
+                   {f"c{int(v)}": int(v) * 2 for v in
+                    rng.integers(0, 8, size=2)})
+            # populate the NON-consumed union branches too: a string
+            # weight and an array userId must read as ABSENT on both
+            # decoders (the shared wide-union semantic)
+            weight = ("heavy" if i % 17 == 4
+                      else None if i % 3 else int(2 + i % 4))
+            user = ([1, 2, 3] if i % 19 == 6
+                    else None if i % 11 == 5 else f"user{i % 9}")
+            recs.append({
+                "response": float(i % 2),
+                "offset": None if i % 4 else 0.5,
+                "weight": weight,
+                "userId": user,
+                "features": feats, "ctx": ctx,
+            })
+        return recs
+
+    def test_parity_and_cliff_closed(self, tmp_path, rng):
+        from photon_tpu.data.native_ingest import compile_plan
+
+        config = GameDataConfig(
+            shards={"all": FeatureShardConfig(bags=("features", "ctx"))},
+            entity_fields=("userId",),
+            optional_entity_fields=("userId",),
+        )
+        schema = self._schema()
+        assert compile_plan(schema, config) is not None  # stays native
+        recs = self._records(np.random.default_rng(3))
+        path = tmp_path / "exotic.avro"
+        write_avro(path, recs, schema, block_records=32)
+        gd_n, maps_n = read_game_data(path, config, use_native=True)
+        gd_p, maps_p = read_game_data(path, config, use_native=False)
+        _assert_same(gd_n, maps_n, gd_p, maps_p)
+        # spot-check semantics beyond parity: weight long consumed, null
+        # weight defaults to 1, absent uid folded to ""
+        w = np.asarray(gd_n.weights)
+        assert set(np.unique(w)).issubset({1.0, 2.0, 3.0, 4.0, 5.0})
+        assert (np.asarray(gd_n.entity_ids["userId"]) == "").any()
+
+    def test_streaming_matches_one_shot(self, tmp_path, rng):
+        from photon_tpu.data.streaming import (build_index_maps_streaming,
+                                               iter_game_chunks)
+
+        config = GameDataConfig(
+            shards={"all": FeatureShardConfig(bags=("features", "ctx"))},
+            entity_fields=("userId",),
+            optional_entity_fields=("userId",),
+        )
+        schema = self._schema()
+        recs = self._records(np.random.default_rng(4), n=200)
+        path = tmp_path / "exotic_stream.avro"
+        write_avro(path, recs, schema, block_records=32)
+        one, _ = read_game_data(path, config, use_native=True)
+        maps = build_index_maps_streaming(str(path), config)
+        stream, chunks = iter_game_chunks(str(path), config, maps,
+                                          chunk_rows=64, use_native=True)
+        parts = list(chunks)
+        assert len(parts) >= 2
+        np.testing.assert_array_equal(
+            np.concatenate([p.y for p in parts]), one.y)
+        np.testing.assert_array_equal(
+            np.concatenate([p.weights for p in parts]), one.weights)
+        np.testing.assert_array_equal(
+            np.concatenate([p.entity_ids["userId"] for p in parts]),
+            one.entity_ids["userId"])

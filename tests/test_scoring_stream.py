@@ -66,7 +66,7 @@ class TestEncodeScoredBlock:
 
 
 def _write_scoring_parts(root, n_files=3, rows=150, seed=0, labeled=True,
-                         null_uid_every=0):
+                         null_uid_every=0, empty_uid_every=0):
     rng = np.random.default_rng(seed)
     schema = training_example_schema(feature_bags=("g", "pu"),
                                      entity_fields=("userId",))
@@ -83,6 +83,7 @@ def _write_scoring_parts(root, n_files=3, rows=150, seed=0, labeled=True,
             m = 1.2 * a - 0.5 * c + 0.3 * (u - 3)
             y = float(rng.uniform() < 1 / (1 + np.exp(-m)))
             uid = (None if null_uid_every and i % null_uid_every == 0
+                   else "" if empty_uid_every and i % empty_uid_every == 1
                    else f"r{fi}_{i}")
             rec_y = {"response": y} if labeled else {}
             recs.append({
@@ -168,6 +169,23 @@ class TestStreamedScoringDriver:
         rows = read_avro(str(tmp_path / "nu" / "scores.avro"))
         assert [r["uid"] for r in rows] == [u for u, _ in truth]
         assert out.metric is not None
+
+    def test_empty_string_uid_distinct_from_null(self, trained_model,
+                                                 tmp_path):
+        """ADVICE r4: a legitimately EMPTY-STRING uid must come back as ""
+        (string branch), not be conflated with a truly missing uid (null
+        branch) — the decoder's presence mask, not the folded "" sentinel,
+        decides the output union branch."""
+        root, model_dir = trained_model
+        truth = _write_scoring_parts(root / "euid", n_files=1, rows=60,
+                                     seed=6, null_uid_every=5,
+                                     empty_uid_every=7)
+        assert any(u == "" for u, _ in truth)     # both cases present
+        assert any(u is None for u, _ in truth)
+        out = _score(root, model_dir, root / "euid", tmp_path / "eu")
+        rows = read_avro(str(tmp_path / "eu" / "scores.avro"))
+        assert [r["uid"] for r in rows] == [u for u, _ in truth]
+        assert out.scores.shape[0] == 60
 
     def test_python_and_native_paths_agree(self, trained_model, tmp_path):
         root, model_dir = trained_model

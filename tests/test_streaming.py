@@ -141,6 +141,37 @@ class TestChunkStream:
             assert n_chunks >= 6
             assert stream.peak_arena_bytes <= 2 * biggest + (1 << 16)
 
+    @pytest.mark.parametrize("use_native", [False, None])
+    def test_ragged_chunk_widths_quantize_pow2(self, tmp_path, use_native):
+        """uniform_sparse_k=False (the scoring stream): each chunk's own
+        nnz width quantizes up to a power of two, so the per-chunk device
+        programs compile a handful of shapes instead of one per distinct
+        raggedness (each XLA compile is tens of seconds over a remote
+        link). Padding slots are (0, 0.0) no-ops: totals must still match
+        the one-shot read."""
+        from photon_tpu.data.matrix import next_pow2
+
+        root = _write_files(tmp_path, wide=True)
+        config = _config(wide=True)
+        maps = build_index_maps_streaming(str(root), config)
+        one_shot, _ = read_game_data(str(root), config, use_native=use_native)
+        stream, chunks = iter_game_chunks(str(root), config, maps,
+                                          chunk_rows=300, sparse_k=None,
+                                          use_native=use_native,
+                                          uniform_sparse_k=False)
+        got = 0
+        for chunk in chunks:
+            X = chunk.shards["other"]
+            assert isinstance(X, SparseRows)
+            k = X.indices.shape[1]
+            assert k == next_pow2(k), k  # quantized
+            np.testing.assert_allclose(
+                np.asarray(X.values).sum(axis=1),
+                np.asarray(one_shot.shards["other"].values)[
+                    got:got + chunk.n].sum(axis=1), rtol=1e-5)
+            got += chunk.n
+        assert got == one_shot.n
+
     def test_scan_row_counts(self, tmp_path):
         root = _write_files(tmp_path, n_files=4, rows_per_file=123)
         assert scan_row_counts(str(root)) == [123] * 4
