@@ -33,6 +33,31 @@ NAME_TERM_VALUE_SCHEMA = {
 }
 
 
+def numeric_or_none(v):
+    """The wide-union scalar semantic, shared by both decoders (pinned in
+    tests/test_native.py): a value from a non-numeric union branch — a
+    string, a container, a boolean — reads as ABSENT (the field default
+    applies), exactly like the null branch. The native decoder's branch
+    tables skip such branches; this is the Python twin."""
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def entity_id_or_none(v):
+    """The entity-id semantic shared by both decoders: strings pass,
+    NUMBERS stringify (plain int/long id columns are long-standing Python
+    -path behavior — the native planner refuses to consume such shapes so
+    the Python path always owns them), and container/bool values — only
+    reachable through a wide union's non-string branch — read as ABSENT
+    like the null branch (the native planner likewise only consumes
+    entity unions whose other branches are containers)."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return str(v)
+    return None
+
+
 def training_example_schema(
     feature_bags: Sequence[str] = ("features",),
     entity_fields: Sequence[str] = (),
@@ -136,16 +161,7 @@ def records_to_game_data(
     from photon_tpu.data.index_map import DELIMITER
 
     n = len(records)
-
-    # Scalar/entity fields behind WIDE unions can carry a non-consumable
-    # branch value (e.g. weight: [null, long, string] holding a string).
-    # The defined semantic — shared with the native decoder's branch
-    # tables, pinned by tests/test_native.py — is that such values read as
-    # ABSENT (default applies), exactly like the null branch.
-    def _num(v):
-        return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
-            else None
-
+    _num = numeric_or_none
     f = config.response_field
     if config.allow_missing_response:
         y = np.fromiter(
@@ -164,8 +180,7 @@ def records_to_game_data(
     ids: dict = {}
     optional = set(config.optional_entity_fields)
     for e in config.entity_fields:
-        col = [v if isinstance(v := r.get(e), str) else None
-               for r in records]
+        col = [entity_id_or_none(r.get(e)) for r in records]
         if any(v is None for v in col):
             if e not in optional:
                 i = col.index(None)

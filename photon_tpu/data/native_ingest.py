@@ -168,8 +168,17 @@ def _bag_mode(schema) -> Optional[tuple]:
     return None
 
 
-def _union_branch_table(schema, consumed_types, skips: "_SkipTable"
-                        ) -> Optional[tuple]:
+# Union branch types the ENTITY path may natively skip: values of these
+# shapes fold to absent on the Python path too (ingest.entity_id_or_none).
+# Numeric/enum/bool branches are excluded — Python STRINGIFIES numbers and
+# decodes enums to str, so consuming the string branch natively while such
+# a branch is populated would diverge; those schemas stay on Python.
+_ENTITY_SKIPPABLE = frozenset(
+    {"array", "map", "record", "bytes", "fixed"})
+
+
+def _union_branch_table(schema, consumed_types, skips: "_SkipTable",
+                        skippable_types=None) -> Optional[tuple]:
     """(codes, consumed_type_name) for an arbitrary union consuming ONE
     branch: exactly one branch's type is in `consumed_types`; nulls map to
     -1 (unset), the consumed branch to -2, every other branch to its
@@ -192,6 +201,8 @@ def _union_branch_table(schema, consumed_types, skips: "_SkipTable"
             hit = ts
             codes.append(-2)
         else:
+            if skippable_types is not None and ts not in skippable_types:
+                return None  # populated values would diverge from Python
             pid = skips.add(b)
             if pid is None:
                 return None
@@ -257,7 +268,8 @@ def compile_plan(schema, config: GameDataConfig):
                 ops.append(_OP_ENTITY_GEN)
                 aux.append(entity_idx[name] | (mode << 16))
             else:
-                bt = _union_branch_table(t, ("string",), skips)
+                bt = _union_branch_table(t, ("string",), skips,
+                                         skippable_types=_ENTITY_SKIPPABLE)
                 if bt is None:
                     return None
                 branch_tables.append(bt[0])

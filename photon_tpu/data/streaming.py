@@ -301,14 +301,22 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
     buf: list = []
 
     def flush():
+        from photon_tpu.data.ingest import entity_id_or_none, numeric_or_none
+
         if stream.config.allow_missing_response:
             f = stream.config.response_field
-            mask = np.asarray([r.get(f) is not None for r in buf])
+            # numeric_or_none, not a bare None check: a populated
+            # NON-numeric union branch reads as absent on both decoders —
+            # the mask must agree or such rows would enter the metric
+            # accumulators as labeled y=0 examples on this path only
+            mask = np.asarray(
+                [numeric_or_none(r.get(f)) is not None for r in buf])
             stream.last_response_mask = mask
             if not mask.all():
                 stream.saw_missing_response = True
         stream.last_entity_presence = {
-            e: np.asarray([r.get(e) is not None for r in buf])
+            e: np.asarray([entity_id_or_none(r.get(e)) is not None
+                           for r in buf])
             for e in stream.config.optional_entity_fields}
         data, _ = records_to_game_data(buf, stream.config, stream.index_maps,
                                        stream.sparse_k, host=True)

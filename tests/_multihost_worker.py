@@ -1,0 +1,64 @@
+"""Two-process multi-controller worker (tests/test_streaming.py::
+TestRealTwoProcess): each OS process owns 4 virtual CPU devices of a
+shared 8-device mesh, streams the same Avro files through
+stream_to_device, and trains the same psum GLM program — the REAL
+process-boundary run behind the `_local_mask` shard-math tests.
+
+Not collected by pytest (underscore name); invoked as
+    python tests/_multihost_worker.py <pid> <port> <data_root> <out.npy>
+Prints INIT_FAILED when jax.distributed cannot form the cluster (the
+parent test skips: some sandboxes block even localhost gRPC).
+"""
+import os
+import sys
+
+pid, port, root, out = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                        sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+# the axon plugin ignores JAX_PLATFORMS env filtering; pin before init
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                               process_id=pid,
+                               initialization_timeout=60)
+except Exception as e:  # noqa: BLE001 — any init failure → documented skip
+    print(f"INIT_FAILED: {type(e).__name__}: {e}", flush=True)
+    sys.exit(42)
+
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+import numpy as np  # noqa: E402
+
+from photon_tpu.data.dataset import make_batch  # noqa: E402
+from photon_tpu.data.feature_bags import FeatureShardConfig  # noqa: E402
+from photon_tpu.data.ingest import GameDataConfig  # noqa: E402
+from photon_tpu.data.streaming import (build_index_maps_streaming,  # noqa: E402
+                                       stream_to_device)
+from photon_tpu.models.training import train_glm  # noqa: E402
+from photon_tpu.ops.losses import TaskType  # noqa: E402
+from photon_tpu.optim import regularization as reg  # noqa: E402
+from photon_tpu.optim.config import OptimizerConfig  # noqa: E402
+from photon_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+config = GameDataConfig(
+    shards={"dense": FeatureShardConfig(bags=("f",), has_intercept=True)},
+    entity_fields=("member",),
+)
+maps = build_index_maps_streaming(root, config)
+mesh = make_mesh(devices=np.asarray(jax.devices()))
+data, n_real = stream_to_device(root, config, maps, mesh=mesh,
+                                chunk_rows=300)
+batch = make_batch(data.shards["dense"], data.y, weights=data.weights,
+                   offsets=data.offsets)
+model, res = train_glm(
+    batch, TaskType.LOGISTIC_REGRESSION,
+    OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0), mesh=mesh)
+w = np.asarray(model.coefficients.means)
+np.save(out, w)
+print(f"OK process {pid}: n_real={n_real} iters={int(res.iterations)} "
+      f"|w|={float(np.linalg.norm(w)):.6f}", flush=True)

@@ -117,13 +117,17 @@ class TestTrainingDriver:
             n_sweeps=1,
         )
         prev = jax.config.jax_compilation_cache_dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
         try:
             run_training(params)
             assert jax.config.jax_compilation_cache_dir == str(
                 out_dir / "xla_cache")
             assert (out_dir / "xla_cache").is_dir()
-        finally:
+        finally:  # both knobs: the rest of the session must not keep
+            # persisting every compile into a deleted tmpdir
             jax.config.update("jax_compilation_cache_dir", prev)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              prev_min)
 
     def test_scoring_driver_round_trip(self, job_dirs):
         root, _, y_val = job_dirs

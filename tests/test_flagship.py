@@ -1,0 +1,89 @@
+"""Flagship compose, small scale: the 10M-row product-path run
+(benches/flagship_e2e.py) in miniature — block-encoded Avro on disk →
+run_training with auto-tripped streaming → validation AUC — so the full
+composition is pinned in CI before the at-scale bench pays for it.
+"""
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+BENCHES = Path(__file__).resolve().parent.parent / "benches"
+spec = importlib.util.spec_from_file_location("_flagship_data",
+                                              BENCHES / "_flagship_data.py")
+_fd = importlib.util.module_from_spec(spec)
+sys.modules.setdefault("_flagship_data", _fd)
+spec.loader.exec_module(_fd)
+
+from photon_tpu.data.avro_io import read_avro  # noqa: E402
+from photon_tpu.drivers.train import TrainingParams, run_training  # noqa: E402
+
+
+def test_block_encoder_matches_generic_reader(tmp_path):
+    """The fixed-width template encoder must produce byte-valid Avro: the
+    generic per-record reader decodes it back to the planted records."""
+    truth = _fd.planted_truth(50, 30, seed=1)
+    path = tmp_path / "flag.avro"
+    _fd.write_flagship_avro(path, 300, 50, 30, truth, seed=2,
+                            rows_per_block=128)
+    recs = read_avro(str(path))
+    assert len(recs) == 300
+    r = recs[0]
+    assert set(r) == {"response", "userId", "itemId", "fixed", "u_re",
+                      "i_re"}
+    assert r["response"] in (0.0, 1.0)
+    assert r["userId"].startswith("u") and len(r["userId"]) == 7
+    assert r["itemId"].startswith("i") and len(r["itemId"]) == 6
+    assert [e["name"] for e in r["fixed"]] == [f"f{j:02d}"
+                                               for j in range(32)]
+    assert [e["name"] for e in r["u_re"]] == ["r0", "r1", "r2", "r3"]
+    assert all(np.isfinite(e["value"]) for e in r["fixed"])
+    # deterministic: same seed reproduces the same bytes
+    path2 = tmp_path / "flag2.avro"
+    _fd.write_flagship_avro(path2, 300, 50, 30, truth, seed=2,
+                            rows_per_block=128)
+    recs2 = read_avro(str(path2))
+    assert recs2[5]["fixed"][3]["value"] == recs[5]["fixed"][3]["value"]
+
+
+def test_flagship_driver_small_scale(tmp_path):
+    """The composed product path at test size: streaming auto-trips from
+    header row counts, both random effects train, and validation AUC
+    clearly beats the planted noise floor."""
+    users, items = 40, 25
+    truth = _fd.planted_truth(users, items, seed=3)
+    _fd.write_flagship_avro(tmp_path / "train.avro", 2000, users, items,
+                            truth, seed=4, rows_per_block=256)
+    _fd.write_flagship_avro(tmp_path / "val.avro", 800, users, items,
+                            truth, seed=5, rows_per_block=256)
+    seen_streaming = {}
+    from photon_tpu.data import streaming as streaming_mod
+
+    orig = streaming_mod.iter_game_chunks
+
+    def spy(*a, **kw):
+        seen_streaming["hit"] = True
+        return orig(*a, **kw)
+
+    streaming_mod.iter_game_chunks = spy
+    try:
+        out = run_training(TrainingParams(
+            train_path=str(tmp_path / "train.avro"),
+            validation_path=str(tmp_path / "val.avro"),
+            output_dir=str(tmp_path / "out"),
+            feature_shards=_fd.FEATURE_SHARDS,
+            coordinates=_fd.COORDINATES,
+            entity_fields=["userId", "itemId"],
+            n_sweeps=2,
+            streaming=None,                 # tri-state AUTO
+            streaming_threshold_rows=1000,  # 2000 rows > 1000 → trips
+            evaluators=["AUC"],
+        ))
+    finally:
+        streaming_mod.iter_game_chunks = orig
+    assert seen_streaming.get("hit"), "auto threshold did not trip streaming"
+    assert out.best.validation_score is not None
+    assert out.best.validation_score > 0.75, out.best.validation_score
+    assert {"read", "train"} <= set(out.timings)

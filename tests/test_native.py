@@ -447,3 +447,35 @@ class TestExoticConsumedShapes:
         np.testing.assert_array_equal(
             np.concatenate([p.entity_ids["userId"] for p in parts]),
             one.entity_ids["userId"])
+
+    def test_entity_union_numeric_branch_stays_python(self, tmp_path, rng):
+        """An entity union with a NUMERIC branch is not natively
+        consumable: Python stringifies numbers, so skipping that branch
+        natively would diverge — compile_plan must refuse (the schema
+        falls back whole) while plain long entity ids keep working on the
+        Python path."""
+        from photon_tpu.data.native_ingest import compile_plan
+
+        config = GameDataConfig(
+            shards={"all": FeatureShardConfig(bags=("features", "ctx"))},
+            entity_fields=("userId",),
+        )
+        schema = self._schema()
+        for f in schema["fields"]:
+            if f["name"] == "userId":
+                f["type"] = ["null", "string", "long"]
+        assert compile_plan(schema, config) is None
+
+        # plain long id column: Python-path behavior, numbers stringify
+        schema2 = self._schema()
+        for f in schema2["fields"]:
+            if f["name"] == "userId":
+                f["type"] = "long"
+        assert compile_plan(schema2, config) is None
+        recs = self._records(np.random.default_rng(5), n=40)
+        for i, r in enumerate(recs):
+            r["userId"] = i % 7
+        path = tmp_path / "longid.avro"
+        write_avro(path, recs, schema2, block_records=16)
+        gd, _ = read_game_data(path, config, use_native=False)
+        assert set(gd.entity_ids["userId"]) == {str(i) for i in range(7)}

@@ -1,6 +1,9 @@
 """Streaming ingestion: bounded-memory chunks == one-shot read; direct
 per-device placement (SURVEY/VERDICT: the reference never holds the dataset
 on one host — Spark streams partitions; these tests pin our analog)."""
+import os
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -335,6 +338,84 @@ class TestMultiHostShardMath:
         np.testing.assert_array_equal(np.asarray(a.y), np.asarray(b.y))
         np.testing.assert_array_equal(np.asarray(a.shards["dense"]),
                                       np.asarray(b.shards["dense"]))
+
+
+class TestRealTwoProcess:
+    """VERDICT r4 item 3: the multi-host story executed across REAL
+    process boundaries, not just the `_local_mask` arithmetic seam — two
+    OS processes (`jax.distributed.initialize`, 4 virtual CPU devices
+    each) run the same stream_to_device + train_glm psum program over one
+    8-device mesh; the model must match the single-process 8-device run.
+    Skips (with the reason) when the sandbox blocks the localhost gRPC
+    coordinator the distributed runtime needs."""
+
+    def test_two_processes_match_single(self, tmp_path, mesh8):
+        import socket
+        import subprocess
+        import sys as _sys
+
+        from photon_tpu.data.dataset import make_batch
+        from photon_tpu.models.training import train_glm
+        from photon_tpu.ops.losses import TaskType
+        from photon_tpu.optim import regularization as reg
+        from photon_tpu.optim.config import OptimizerConfig
+
+        root = _write_files(tmp_path)  # 1200 rows; 150 per device slot
+
+        # single-process reference on this process's 8-device mesh
+        config = GameDataConfig(
+            shards={"dense": FeatureShardConfig(bags=("f",),
+                                                has_intercept=True)},
+            entity_fields=("member",),
+        )
+        maps = build_index_maps_streaming(str(root), config)
+        data, n_real = stream_to_device(str(root), config, maps, mesh=mesh8,
+                                        chunk_rows=300)
+        batch = make_batch(data.shards["dense"], data.y,
+                           weights=data.weights, offsets=data.offsets)
+        model, _ = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0),
+            mesh=mesh8)
+        w_single = np.asarray(model.coefficients.means)
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        worker = Path(__file__).resolve().parent / "_multihost_worker.py"
+        repo = str(worker.parent.parent)
+        outs = [tmp_path / f"w{i}.npy" for i in (0, 1)]
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+        procs = [subprocess.Popen(
+            [_sys.executable, str(worker), str(i), str(port), str(root),
+             str(outs[i])],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for i in (0, 1)]
+        logs = []
+        for p in procs:
+            try:
+                out_text, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("two-process workers timed out (coordinator "
+                            "handshake or collective hang)")
+            logs.append(out_text)
+        if any(p.returncode == 42 for p in procs):
+            pytest.skip("jax.distributed could not form the 2-process "
+                        f"cluster in this sandbox: {logs}")
+        assert all(p.returncode == 0 for p in procs), logs
+        w0 = np.load(outs[0])
+        w1 = np.load(outs[1])
+        # every process computes the same replicated model...
+        np.testing.assert_array_equal(w0, w1)
+        # ...equal to the single-process run (same mesh shape, same psum
+        # program; cross-process collectives may legally reassociate the
+        # reduction, so exact equality is checked first and a tight f32
+        # tolerance documents any platform where it reassociates)
+        if not np.array_equal(w0, w_single):
+            np.testing.assert_allclose(w0, w_single, rtol=2e-5, atol=2e-5)
 
 
 class TestSubsetNativeMapBuild:
