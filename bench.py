@@ -160,10 +160,18 @@ def run_sparse(batch) -> float:
 
 
 def run_sparse_grid(batch) -> float:
-    """Headline: the 8-lane reg-weight sweep, one lock-step program."""
+    """Headline: the 8-lane reg-weight sweep, one lock-step program.
+
+    S/Y history stored bf16 (lane_history_dtype): the (m, d, G) buffers
+    are the biggest solver-state HBM stream at d=10M × 8 lanes, and every
+    steering inner product stays f32 (cached at push from the unrounded
+    pair) — measured +7% at G=8 / +10% at G=16 with per-lane final losses
+    within the f32 run's own noise floor (docs/PERF.md; quality pinned by
+    tests/test_lane_solver.py::test_lane_grid_bf16_history_quality)."""
     rows = int(batch.y.shape[0])
     cfg = OptimizerConfig(max_iters=S_ITERS, tolerance=0.0, reg=l2(),
-                          reg_weight=0.0, history=5)
+                          reg_weight=0.0, history=5,
+                          lane_history_dtype="bfloat16")
 
     def once():
         import jax.numpy as jnp

@@ -32,6 +32,9 @@ def main() -> None:
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--rows", type=int, default=None,
                    help="sparse-leg row count (default bench.S_ROWS)")
+    p.add_argument("--history-dtype", default=None,
+                   help="lane solver S/Y storage dtype (e.g. bfloat16); "
+                        "prints per-lane final losses for the quality A/B")
     args = p.parse_args()
 
     import jax
@@ -57,7 +60,8 @@ def main() -> None:
         jax.block_until_ready(batch.X)
         iters_cfg = bench.D_ITERS
     cfg = OptimizerConfig(max_iters=iters_cfg, tolerance=0.0, reg=l2(),
-                          reg_weight=0.0, history=5)
+                          reg_weight=0.0, history=5,
+                          lane_history_dtype=args.history_dtype)
 
     dev = jax.devices()[0]
     for g in args.lanes:
@@ -66,18 +70,19 @@ def main() -> None:
         def run():
             res, _ = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION,
                                     cfg, weights, device_results=True)
-            # O(1)-byte readback closes the timing (see module docstring)
-            return jax.device_get((jnp.sum(res.w),
-                                   jnp.sum(res.iterations)))
+            # O(1)-byte readback closes the timing (see module docstring);
+            # the (G,) final losses ride along for the quality A/B.
+            return jax.device_get((jnp.sum(res.w), jnp.sum(res.iterations),
+                                   res.value))
 
         try:
             t0 = time.perf_counter()
-            _, iters = run()  # compile + autotune
+            _, iters, losses = run()  # compile + autotune
             t_compile = time.perf_counter() - t0
             best = float("inf")
             for _ in range(args.reps):
                 t0 = time.perf_counter()
-                _, iters = run()
+                _, iters, losses = run()
                 best = min(best, time.perf_counter() - t0)
         except Exception as e:  # OOM at some G is an answer, not a crash
             print(f"G={g:3d}: FAILED ({type(e).__name__}: {str(e)[:200]})")
@@ -89,6 +94,8 @@ def main() -> None:
               f"{agg:.3e} rows*iters/s aggregate  "
               f"({agg / g:.3e}/lane, compile {t_compile:.0f}s, "
               f"peak HBM {peak:.1f} GiB)")
+        print(f"       final losses: "
+              + " ".join(f"{v:.8e}" for v in np.asarray(losses)))
 
 
 if __name__ == "__main__":

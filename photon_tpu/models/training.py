@@ -316,7 +316,8 @@ def _train_run_grid_lanes(batch, w0, obj, l2s, config):
     W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
     res = minimize_lbfgs_margin_lanes(
         obj, l2s, batch, W0, max_iters=config.max_iters,
-        tolerance=config.tolerance, history=config.history)
+        tolerance=config.tolerance, history=config.history,
+        history_dtype=config.lane_history_dtype)
     return _lane_result(res), None
 
 
@@ -335,7 +336,8 @@ def _train_run_sharded_grid_lanes(batch, w0, obj, l2s, config, mesh):
         W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
         res = minimize_lbfgs_margin_lanes(
             obj, l2s, bl, W0, max_iters=config.max_iters,
-            tolerance=config.tolerance, history=config.history)
+            tolerance=config.tolerance, history=config.history,
+            history_dtype=config.lane_history_dtype)
         return _lane_result(res)
 
     return shard_map(

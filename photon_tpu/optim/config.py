@@ -29,6 +29,16 @@ class OptimizerConfig:
     reg: RegularizationContext = NONE
     reg_weight: float = 0.0
     regularize_intercept: bool = True  # reference regularizes the intercept feature
+    # Lane-minor grid solver only: storage dtype for the (m, d, G) L-BFGS
+    # (s, y) history, e.g. "bfloat16" (None = solver dtype, f32). The
+    # history is the biggest solver-state HBM stream at large d×G, so
+    # halving it buys real throughput (+7-10% on the 10M-feature 8/16-lane
+    # bench, docs/PERF.md); inner products (rho, gamma, curvature tests)
+    # stay f32 — computed from the UNROUNDED pair at push time and
+    # cached — so only the two-loop direction sees the rounding, and the
+    # Wolfe search vets it as usual (quality pinned by
+    # tests/test_lane_solver.py::test_lane_grid_bf16_history_quality).
+    lane_history_dtype: str | None = None
 
     def effective_optimizer(self) -> OptimizerType:
         """The reference forces OWLQN whenever an L1 term is present."""

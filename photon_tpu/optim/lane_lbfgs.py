@@ -157,17 +157,29 @@ def wolfe_line_search_lanes(
     return out.a_star, out.f_star, ok
 
 
-def two_loop_lanes(g, S, Y, rho, valid, idx):
+def two_loop_lanes(g, S, Y, rho, valid, idx, sy, yy):
     """H·g per lane over the rotating history. g: (d, G); S/Y: (m, d, G);
-    rho/valid: (m, G); idx: () next write slot. Invalid (slot, lane) pairs
-    are masked out, so a lane's effective history is its valid slots in
-    recency order — same recursion as optim.lbfgs.two_loop per lane."""
+    rho/valid/sy/yy: (m, G); idx: () next write slot. Invalid (slot, lane)
+    pairs are masked out, so a lane's effective history is its valid slots
+    in recency order — same recursion as optim.lbfgs.two_loop per lane.
+
+    ``sy``/``yy`` are the sᵀy / yᵀy inner products CACHED at push time,
+    computed f32 from the UNROUNDED pair (with f32 storage that is bitwise
+    what a recompute from the stored slots gives; with a narrower
+    ``history_dtype`` it is deliberately MORE accurate than one — the f32
+    steering guarantee the bf16 quality test pins). Deriving gamma from
+    the cache also keeps per-iteration history traffic to the two reads
+    the recursion itself needs — recomputing cost a third full (m, d, G)
+    pass over S and Y, ~1/3 of the history HBM traffic that bounds lane
+    scaling past G=8 (docs/PERF.md lane table)."""
     m = S.shape[0]
 
     def bwd(i, carry):
         q, alphas = carry
         slot = jnp.mod(idx - 1 - i, m)
         v = valid[slot]
+        # bf16-storage histories upcast in registers here (bf16 × f32
+        # promotes to f32); the reduction is f32 either way.
         alpha = jnp.where(v, rho[slot] * jnp.sum(S[slot] * q, axis=0), 0.0)
         q = q - alpha[None, :] * Y[slot]
         return q, alphas.at[slot].set(alpha)
@@ -182,9 +194,7 @@ def two_loop_lanes(g, S, Y, rho, valid, idx):
         gamma, found = carry
         slot = jnp.mod(idx - 1 - i, m)
         v = valid[slot] & ~found
-        yy = jnp.sum(Y[slot] * Y[slot], axis=0)
-        sy = jnp.sum(S[slot] * Y[slot], axis=0)
-        gamma = jnp.where(v, sy / jnp.maximum(yy, 1e-20), gamma)
+        gamma = jnp.where(v, sy[slot] / jnp.maximum(yy[slot], 1e-20), gamma)
         return gamma, found | valid[slot]
 
     gamma, _ = lax.fori_loop(
@@ -201,21 +211,26 @@ def two_loop_lanes(g, S, Y, rho, valid, idx):
     return lax.fori_loop(0, m, fwd, r)
 
 
-def _push_lanes(S, Y, rho, valid, idx, s, y, accept):
+def _push_lanes(S, Y, rho, valid, idx, s, y, accept, SY, YY):
     """Write (s, y) into the rotating slot for lanes where ``accept`` holds
     AND the curvature condition passes; other lanes' slot goes invalid. The
     slot index rotates globally (one dynamic-update-slice per array instead
-    of per-lane scatters)."""
+    of per-lane scatters). ``SY``/``YY`` (m, G) cache the accepted pairs'
+    sᵀy / yᵀy so the two-loop never re-reads S, Y to recompute gamma."""
     m = S.shape[0]
     sy = jnp.sum(s * y, axis=0)
     yy = jnp.sum(y * y, axis=0)
     acc = accept & (sy > 1e-10 * jnp.maximum(yy, 1e-20))
-    S = S.at[idx].set(jnp.where(acc[None, :], s, S[idx]))
-    Y = Y.at[idx].set(jnp.where(acc[None, :], y, Y[idx]))
+    # Storage may be narrower than the solve (history_dtype): cast at the
+    # write; every steering inner product above is already f32.
+    S = S.at[idx].set(jnp.where(acc[None, :], s.astype(S.dtype), S[idx]))
+    Y = Y.at[idx].set(jnp.where(acc[None, :], y.astype(Y.dtype), Y[idx]))
     rho = rho.at[idx].set(
         jnp.where(acc, 1.0 / jnp.maximum(sy, 1e-20), rho[idx]))
+    SY = SY.at[idx].set(jnp.where(acc, sy, SY[idx]))
+    YY = YY.at[idx].set(jnp.where(acc, yy, YY[idx]))
     valid = valid.at[idx].set(acc)
-    return S, Y, rho, valid, jnp.mod(idx + 1, m)
+    return S, Y, rho, valid, jnp.mod(idx + 1, m), SY, YY
 
 
 class _LaneState(NamedTuple):
@@ -226,6 +241,8 @@ class _LaneState(NamedTuple):
     S: jax.Array       # (m, d, G)
     Y: jax.Array       # (m, d, G)
     rho: jax.Array     # (m, G)
+    sy: jax.Array      # (m, G) cached sᵀy per accepted pair
+    yy: jax.Array      # (m, G) cached yᵀy per accepted pair
     valid: jax.Array   # (m, G)
     idx: jax.Array     # () rotating write slot
     it: jax.Array      # () global iteration counter
@@ -246,6 +263,7 @@ def minimize_lbfgs_margin_lanes(
     tolerance: float = 1e-7,
     history: int = 10,
     max_ls_evals: int = 12,
+    history_dtype=None,
 ) -> OptResult:
     """Margin-cached L-BFGS over G lanes, lock-step, lane-minor.
 
@@ -253,11 +271,19 @@ def minimize_lbfgs_margin_lanes(
     value/grad_norm/iterations/converged/failed (G,), histories
     (max_iters + 1, G). models.training transposes to the public
     lane-major convention at the jit boundary.
+
+    ``history_dtype`` (e.g. ``jnp.bfloat16``): storage dtype for the
+    (m, d, G) S/Y buffers — the dominant solver-state HBM traffic at
+    large d×G. Inner products that steer the algorithm (rho, gamma,
+    curvature acceptance) are computed f32 from the unrounded pair at
+    push time and cached, so rounding touches only the two-loop
+    direction, which the Wolfe search then vets as usual.
     """
     W0 = jnp.asarray(W0, jnp.float32)
     d, G = W0.shape
     m = history
     dtype = W0.dtype
+    hdtype = jnp.dtype(history_dtype) if history_dtype is not None else dtype
 
     z0 = lo.margin_lanes(obj, W0, batch)
     f0, g0 = lo.value_and_grad_at_margin_lanes(obj, l2s, W0, z0, batch)
@@ -271,7 +297,8 @@ def minimize_lbfgs_margin_lanes(
 
     def body(s: _LaneState):
         active = ~s.done
-        D = -two_loop_lanes(s.g, s.S, s.Y, s.rho, s.valid, s.idx)
+        D = -two_loop_lanes(s.g, s.S, s.Y, s.rho, s.valid, s.idx,
+                            s.sy, s.yy)
         dphi0 = jnp.sum(D * s.g, axis=0)
         bad_dir = dphi0 >= 0.0
         D = jnp.where(bad_dir[None, :], -s.g, D)
@@ -306,8 +333,9 @@ def minimize_lbfgs_margin_lanes(
             step[None, :],
             lo.grad_at_margin_lanes(obj, l2s, W_new, z_new, batch), s.g)
 
-        S, Y, rho, valid, idx = _push_lanes(
-            s.S, s.Y, s.rho, s.valid, s.idx, W_new - s.W, g_new - s.g, step)
+        S, Y, rho, valid, idx, sy, yy = _push_lanes(
+            s.S, s.Y, s.rho, s.valid, s.idx, W_new - s.W, g_new - s.g, step,
+            s.sy, s.yy)
 
         gnorm = jnp.sqrt(jnp.sum(g_new * g_new, axis=0))
         converged = _convergence(ok, s.f, f_new, gnorm, g0norm, dphi0,
@@ -316,7 +344,7 @@ def minimize_lbfgs_margin_lanes(
         its = jnp.where(active, s.its + 1, s.its)
         return _LaneState(
             W=W_new, z=z_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho,
-            valid=valid, idx=idx, it=it, its=its,
+            sy=sy, yy=yy, valid=valid, idx=idx, it=it, its=its,
             done=s.done | (active & (converged | ~ok)),
             converged=jnp.where(active, converged, s.converged),
             failed=s.failed | (active & ~ok & ~converged),
@@ -326,8 +354,9 @@ def minimize_lbfgs_margin_lanes(
 
     init = _LaneState(
         W=W0, z=z0, f=f0, g=g0,
-        S=jnp.zeros((m, d, G), dtype), Y=jnp.zeros((m, d, G), dtype),
-        rho=jnp.zeros((m, G), dtype), valid=jnp.zeros((m, G), bool),
+        S=jnp.zeros((m, d, G), hdtype), Y=jnp.zeros((m, d, G), hdtype),
+        rho=jnp.zeros((m, G), dtype), sy=jnp.zeros((m, G), dtype),
+        yy=jnp.zeros((m, G), dtype), valid=jnp.zeros((m, G), bool),
         idx=jnp.zeros((), jnp.int32), it=jnp.zeros((), jnp.int32),
         its=jnp.zeros((G,), jnp.int32),
         done=g0norm <= 1e-14, converged=g0norm <= 1e-14,

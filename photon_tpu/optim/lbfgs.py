@@ -27,6 +27,8 @@ class _State(NamedTuple):
     S: jax.Array  # (m, d) s-history
     Y: jax.Array  # (m, d) y-history
     rho: jax.Array  # (m,)
+    sy: jax.Array  # () newest pair's s^T y (cached for gamma)
+    yy: jax.Array  # () newest pair's y^T y
     idx: jax.Array  # next slot to write
     count: jax.Array  # valid pairs
     it: jax.Array
@@ -37,9 +39,14 @@ class _State(NamedTuple):
     ghist: jax.Array
 
 
-def two_loop(g, S, Y, rho, idx, count):
+def two_loop(g, S, Y, rho, idx, count, sy, yy):
     """H·g approximation via the two-loop recursion over a circular buffer.
-    Invalid slots are masked, so shapes never change."""
+    Invalid slots are masked, so shapes never change.
+
+    ``sy``/``yy`` are the NEWEST accepted pair's sᵀy / yᵀy, cached by
+    `_push` (bitwise what recomputing from the stored slots gives): at
+    d = 10M the recompute was two extra (d,)-vector reads per iteration on
+    top of the two full history passes the recursion itself needs."""
     m = S.shape[0]
 
     def bwd(i, carry):
@@ -52,9 +59,6 @@ def two_loop(g, S, Y, rho, idx, count):
 
     q, alphas = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), g.dtype)))
 
-    newest = jnp.mod(idx - 1, m)
-    yy = jnp.dot(Y[newest], Y[newest])
-    sy = jnp.dot(S[newest], Y[newest])
     gamma = jnp.where(count > 0, sy / jnp.maximum(yy, 1e-20), 1.0)
     r = gamma * q
 
@@ -68,18 +72,22 @@ def two_loop(g, S, Y, rho, idx, count):
     return lax.fori_loop(0, m, fwd, r)
 
 
-def _push(S, Y, rho, idx, count, s, y):
+def _push(S, Y, rho, idx, count, s, y, sy_c, yy_c):
     """Append an (s, y) pair; skip it if the curvature condition fails
-    (sᵀy too small), as Breeze does."""
+    (sᵀy too small), as Breeze does. ``sy_c``/``yy_c`` carry the newest
+    accepted pair's inner products (a skipped push keeps the previous
+    pair's — the newest slot is unchanged)."""
     m = S.shape[0]
     sy = jnp.dot(s, y)
-    ok = sy > 1e-10 * jnp.maximum(jnp.dot(y, y), 1e-20)
+    yy = jnp.dot(y, y)
+    ok = sy > 1e-10 * jnp.maximum(yy, 1e-20)
     S = jnp.where(ok, S.at[idx].set(s), S)
     Y = jnp.where(ok, Y.at[idx].set(y), Y)
     rho = jnp.where(ok, rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)), rho)
     idx = jnp.where(ok, jnp.mod(idx + 1, m), idx)
     count = jnp.where(ok, jnp.minimum(count + 1, m), count)
-    return S, Y, rho, idx, count
+    return S, Y, rho, idx, count, jnp.where(ok, sy, sy_c), \
+        jnp.where(ok, yy, yy_c)
 
 
 def _convergence(ok, f_old, f_new, gnorm, g0norm, dphi0, tolerance, dtype):
@@ -122,7 +130,8 @@ def minimize_lbfgs(
         return (~s.done) & (s.it < max_iters)
 
     def body(s: _State):
-        direction = -two_loop(s.g, s.S, s.Y, s.rho, s.idx, s.count)
+        direction = -two_loop(s.g, s.S, s.Y, s.rho, s.idx, s.count,
+                              s.sy, s.yy)
         dphi0 = jnp.dot(direction, s.g)
         # Safeguard: fall back to steepest descent if not a descent direction.
         bad_dir = dphi0 >= 0.0
@@ -145,8 +154,9 @@ def minimize_lbfgs(
         f_new = jnp.where(ok, f_new, s.f)
         g_new = jnp.where(ok, g_new, s.g)
 
-        S, Y, rho, idx, count = _push(
-            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g
+        S, Y, rho, idx, count, sy, yy = _push(
+            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g,
+            s.sy, s.yy
         )
 
         gnorm = jnp.linalg.norm(g_new)
@@ -154,8 +164,8 @@ def minimize_lbfgs(
                                  tolerance, dtype)
         it = s.it + 1
         return _State(
-            w=w_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, idx=idx,
-            count=count, it=it, done=converged | ~ok,
+            w=w_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, sy=sy, yy=yy,
+            idx=idx, count=count, it=it, done=converged | ~ok,
             converged=converged, failed=s.failed | (~ok & ~converged),
             hist=s.hist.at[it].set(f_new),
             ghist=s.ghist.at[it].set(gnorm),
@@ -165,6 +175,7 @@ def minimize_lbfgs(
         w=w0, f=f0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
         rho=jnp.zeros((m,), dtype),
+        sy=jnp.zeros((), dtype), yy=jnp.zeros((), dtype),
         idx=jnp.zeros((), jnp.int32), count=jnp.zeros((), jnp.int32),
         it=jnp.zeros((), jnp.int32),
         done=g0norm <= 1e-14,
@@ -194,6 +205,8 @@ class _MarginState(NamedTuple):
     S: jax.Array
     Y: jax.Array
     rho: jax.Array
+    sy: jax.Array
+    yy: jax.Array
     idx: jax.Array
     count: jax.Array
     it: jax.Array
@@ -244,7 +257,8 @@ def minimize_lbfgs_margin(
         return (~s.done) & (s.it < max_iters)
 
     def body(s: _MarginState):
-        direction = -two_loop(s.g, s.S, s.Y, s.rho, s.idx, s.count)
+        direction = -two_loop(s.g, s.S, s.Y, s.rho, s.idx, s.count,
+                              s.sy, s.yy)
         dphi0 = jnp.dot(direction, s.g)
         bad_dir = dphi0 >= 0.0
         direction = jnp.where(bad_dir, -s.g, direction)
@@ -283,8 +297,9 @@ def minimize_lbfgs_margin(
         g_new = jnp.where(ok, obj.grad_at_margin(w_new, z_new, batch),  # X pass 2
                           s.g)
 
-        S, Y, rho, idx, count = _push(
-            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g
+        S, Y, rho, idx, count, sy, yy = _push(
+            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g,
+            s.sy, s.yy
         )
 
         gnorm = jnp.linalg.norm(g_new)
@@ -292,7 +307,8 @@ def minimize_lbfgs_margin(
                                  tolerance, dtype)
         it = s.it + 1
         return _MarginState(
-            w=w_new, z=z_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, idx=idx,
+            w=w_new, z=z_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho,
+            sy=sy, yy=yy, idx=idx,
             count=count, it=it, done=converged | ~ok,
             converged=converged, failed=s.failed | (~ok & ~converged),
             hist=s.hist.at[it].set(f_new),
@@ -303,6 +319,7 @@ def minimize_lbfgs_margin(
         w=w0, z=z0, f=f0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
         rho=jnp.zeros((m,), dtype),
+        sy=jnp.zeros((), dtype), yy=jnp.zeros((), dtype),
         idx=jnp.zeros((), jnp.int32), count=jnp.zeros((), jnp.int32),
         it=jnp.zeros((), jnp.int32),
         done=g0norm <= 1e-14,

@@ -42,6 +42,8 @@ class _State(NamedTuple):
     S: jax.Array
     Y: jax.Array
     rho: jax.Array
+    sy: jax.Array
+    yy: jax.Array
     idx: jax.Array
     count: jax.Array
     it: jax.Array
@@ -85,7 +87,8 @@ def minimize_owlqn(
 
     def body(s: _State):
         pg = pseudo_gradient(s.w, s.g, l1_weight, mask)
-        direction = -two_loop(pg, s.S, s.Y, s.rho, s.idx, s.count)
+        direction = -two_loop(pg, s.S, s.Y, s.rho, s.idx, s.count,
+                              s.sy, s.yy)
         # Constrain direction to the quasi-Newton orthant: any component that
         # disagrees in sign with -pg is zeroed (Andrew & Gao eq. for p_k).
         direction = jnp.where(direction * pg < 0.0, direction, 0.0)
@@ -138,8 +141,9 @@ def minimize_owlqn(
         g_new = jnp.where(ok, g_new, s.g)
 
         # History uses smooth gradients (Andrew & Gao): y = Δg, s = Δw.
-        S, Y, rho, idx, count = _push(
-            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g
+        S, Y, rho, idx, count, sy, yy = _push(
+            s.S, s.Y, s.rho, s.idx, s.count, w_new - s.w, g_new - s.g,
+            s.sy, s.yy
         )
 
         pg_new = pseudo_gradient(w_new, g_new, l1_weight, mask)
@@ -159,7 +163,8 @@ def minimize_owlqn(
         converged = grad_conv | f_conv | precision_limited
         it = s.it + 1
         return _State(
-            w=w_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho, idx=idx,
+            w=w_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho,
+            sy=sy, yy=yy, idx=idx,
             count=count, it=it, done=converged | ~ok, converged=converged,
             failed=s.failed | (~ok & ~converged),
             hist=s.hist.at[it].set(F_new),
@@ -170,6 +175,7 @@ def minimize_owlqn(
         w=w0, f=f0, F=F0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
         rho=jnp.zeros((m,), dtype),
+        sy=jnp.zeros((), dtype), yy=jnp.zeros((), dtype),
         idx=jnp.zeros((), jnp.int32), count=jnp.zeros((), jnp.int32),
         it=jnp.zeros((), jnp.int32),
         done=pg0norm <= 1e-14, converged=pg0norm <= 1e-14,

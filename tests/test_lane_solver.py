@@ -184,6 +184,32 @@ def test_lane_grid_sharded_hybrid(rng, mesh8):
                                    atol=2e-2)
 
 
+def test_lane_grid_bf16_history_quality(rng):
+    """lane_history_dtype="bfloat16" stores the (m, d, G) S/Y pairs
+    half-width while every steering inner product (rho, gamma, curvature
+    acceptance) stays f32 from the unrounded pair. The rounded two-loop
+    direction is still vetted by the Wolfe search, so achieved objectives
+    must match the f32-history run tightly and coefficients to the
+    optimum's conditioning."""
+    X, y = _sparse_problem(rng)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.0, history=5)
+    weights = [1e-2, 1.0, 10.0]
+    grid32 = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                            weights)
+    grid16 = train_glm_grid(
+        batch, TaskType.LOGISTIC_REGRESSION,
+        dataclasses.replace(cfg, lane_history_dtype="bfloat16"), weights)
+    for (m32, r32), (m16, r16) in zip(grid32, grid16):
+        np.testing.assert_allclose(float(r16.value), float(r32.value),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m16.coefficients.means),
+                                   np.asarray(m32.coefficients.means),
+                                   atol=2e-2)
+        assert bool(r16.converged)
+
+
 def test_matvec_lanes_match_single(rng):
     n, d, k, G = 64, 120, 6, 5
     ind = rng.integers(0, d, size=(n, k)).astype(np.int32)
