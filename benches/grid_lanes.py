@@ -35,6 +35,9 @@ def main() -> None:
     p.add_argument("--history-dtype", default=None,
                    help="lane solver S/Y storage dtype (e.g. bfloat16); "
                         "prints per-lane final losses for the quality A/B")
+    p.add_argument("--reg", choices=["l2", "elastic"], default="l2",
+                   help="elastic = elastic_net(0.5): the sweep rides the "
+                        "lane-minor OWL-QN road (L1 production shape)")
     args = p.parse_args()
 
     import jax
@@ -44,7 +47,7 @@ def main() -> None:
     from photon_tpu.models.training import train_glm_grid
     from photon_tpu.ops.losses import TaskType
     from photon_tpu.optim.config import OptimizerConfig
-    from photon_tpu.optim.regularization import l2
+    from photon_tpu.optim.regularization import elastic_net, l2
 
     if args.leg == "sparse":
         rows = args.rows or bench.S_ROWS
@@ -59,9 +62,11 @@ def main() -> None:
         batch = bench.dense_problem()
         jax.block_until_ready(batch.X)
         iters_cfg = bench.D_ITERS
-    cfg = OptimizerConfig(max_iters=iters_cfg, tolerance=0.0, reg=l2(),
-                          reg_weight=0.0, history=5,
-                          lane_history_dtype=args.history_dtype)
+    cfg = OptimizerConfig(
+        max_iters=iters_cfg, tolerance=0.0,
+        reg=elastic_net(0.5) if args.reg == "elastic" else l2(),
+        reg_weight=0.0, history=5,
+        lane_history_dtype=args.history_dtype)
 
     dev = jax.devices()[0]
     for g in args.lanes:

@@ -35,6 +35,7 @@ from photon_tpu.ops.objective import Objective
 from photon_tpu.optim.config import OptimizerConfig, OptimizerType
 from photon_tpu.ops.lane_objective import supports_lanes
 from photon_tpu.optim.lane_lbfgs import minimize_lbfgs_margin_lanes
+from photon_tpu.optim.lane_owlqn import minimize_owlqn_lanes
 from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.tron import minimize_tron_margin
@@ -304,26 +305,40 @@ def _lane_result(res) -> OptResult:
         grad_norm_history=res.grad_norm_history.T)
 
 
-@partial(jax.jit, static_argnames=("config",))
-def _train_run_grid_lanes(batch, w0, obj, l2s, config):
-    """The LANE-MINOR grid solver (optim/lane_lbfgs.py): one lock-step
-    margin-cached L-BFGS whose state carries a minor lane axis, so the hot
-    matvec is a true (n, d_sel) × (d_sel, G) MXU matmul and the tail
-    gather/scatter costs the same index count as a single lane. The vmapped
-    runner below (_train_run_grid) is the general fallback (OWL-QN lanes,
-    variances, priors); for smooth L2 sweeps this path is the fast road
-    (the vmapped one measured ~5× a single lane PER LANE at d=10M)."""
+def _lane_solve(obj, batch, w0, l2s, l1s, config):
+    """The one place a lane-minor solve is dispatched: smooth L2 sweeps on
+    the margin-cached L-BFGS lanes (optim/lane_lbfgs.py), L1/elastic-net
+    sweeps on the OWL-QN lanes (optim/lane_owlqn.py — the orthant
+    projection breaks margin linearity, so its trials pay one SHARED X
+    pass instead of riding cached margins). ``l1s is None`` is the route
+    switch; jit traces each case separately."""
     W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
-    res = minimize_lbfgs_margin_lanes(
-        obj, l2s, batch, W0, max_iters=config.max_iters,
+    if l1s is None:
+        return minimize_lbfgs_margin_lanes(
+            obj, l2s, batch, W0, max_iters=config.max_iters,
+            tolerance=config.tolerance, history=config.history,
+            history_dtype=config.lane_history_dtype)
+    return minimize_owlqn_lanes(
+        obj, l2s, l1s, batch, W0, max_iters=config.max_iters,
         tolerance=config.tolerance, history=config.history,
-        history_dtype=config.lane_history_dtype)
-    return _lane_result(res), None
+        reg_mask=obj.reg_mask, history_dtype=config.lane_history_dtype)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _train_run_grid_lanes(batch, w0, obj, l2s, l1s, config):
+    """The LANE-MINOR grid runner: one lock-step solver whose state
+    carries a minor lane axis, so the hot matvec is a true
+    (n, d_sel) × (d_sel, G) MXU matmul and the tail gather/scatter costs
+    the same index count as a single lane. The vmapped runner below
+    (_train_run_grid) is the general fallback (TRON lanes, variances,
+    priors); for reg sweeps this path is the fast road (the vmapped one
+    measured ~5× a single lane PER LANE at d=10M)."""
+    return _lane_result(_lane_solve(obj, batch, w0, l2s, l1s, config)), None
 
 
 @partial(jax.jit, static_argnames=("config", "mesh"))
-def _train_run_sharded_grid_lanes(batch, w0, obj, l2s, config, mesh):
-    """Lane-minor grid solver under shard_map for ShardedHybridRows: each
+def _train_run_sharded_grid_lanes(batch, w0, obj, l2s, l1s, config, mesh):
+    """Lane-minor grid runner under shard_map for ShardedHybridRows: each
     device runs the lock-step lane solver on its local (dense rows + tail)
     piece; the per-lane (value, grad) psums batch into one collective per
     evaluation across the sweep, as in _train_run_sharded_grid."""
@@ -331,20 +346,20 @@ def _train_run_sharded_grid_lanes(batch, w0, obj, l2s, config, mesh):
     batch_spec = _hybrid_specs(batch.X, axes)
     obj_spec = jax.tree_util.tree_map(lambda _: P(), obj)
 
-    def body(b, w0, obj, l2s):
+    def body(b, w0, obj, l2s, l1s):
         bl = b._replace(X=b.X.local())
-        W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
-        res = minimize_lbfgs_margin_lanes(
-            obj, l2s, bl, W0, max_iters=config.max_iters,
-            tolerance=config.tolerance, history=config.history,
-            history_dtype=config.lane_history_dtype)
-        return _lane_result(res)
+        return _lane_result(_lane_solve(obj, bl, w0, l2s, l1s, config))
 
+    in_specs = (batch_spec, P(), obj_spec, P(),
+                *(() if l1s is None else (P(),)))
+    args = (batch, w0, obj, l2s) + (() if l1s is None else (l1s,))
+    if l1s is None:
+        fn = lambda b, w0, obj, l2s: body(b, w0, obj, l2s, None)
+    else:
+        fn = body
     return shard_map(
-        body, mesh=mesh,
-        in_specs=(batch_spec, P(), obj_spec, P()),
-        out_specs=P(),
-    )(batch, w0, obj, l2s), None
+        fn, mesh=mesh, in_specs=in_specs, out_specs=P(),
+    )(*args), None
 
 
 @partial(jax.jit, static_argnames=("config", "variance"))
@@ -447,17 +462,21 @@ def train_glm_grid(
     obj = make_objective(task, config, d, axis_name=axis_name,
                          normalization=norm_obj,
                          intercept_index=intercept_index)
-    # Smooth L2 sweeps without variances ride the lane-minor solver (one
-    # lock-step program sharing every X pass); OWL-QN lanes, TRON, and
+    # Reg sweeps without variances ride a lane-minor solver (one lock-step
+    # program sharing every X pass): smooth L2 sweeps on the margin-cached
+    # L-BFGS lanes, L1/elastic-net sweeps on the OWL-QN lanes. TRON and
     # variance requests fall back to the general vmapped runner.
-    use_lanes = (l1s is None
-                 and static_cfg.optimizer is OptimizerType.LBFGS
-                 and variance is VarianceComputationType.NONE
-                 and supports_lanes(obj))
+    use_lanes = (variance is VarianceComputationType.NONE
+                 and supports_lanes(obj)
+                 and static_cfg.optimizer in (OptimizerType.LBFGS,
+                                              OptimizerType.OWLQN)
+                 # lane_weight_arrays pins OWLQN <=> l1s is not None
+                 and (l1s is not None) == (static_cfg.optimizer
+                                           is OptimizerType.OWLQN))
     if sharded_hybrid:
         if use_lanes:
             res, var = _train_run_sharded_grid_lanes(batch, w0, obj, l2s,
-                                                     static_cfg, mesh)
+                                                     l1s, static_cfg, mesh)
         else:
             res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
                                                static_cfg, variance, mesh)
@@ -465,7 +484,7 @@ def train_glm_grid(
         if mesh is not None:
             batch, w0 = _mesh_prep(batch, w0, mesh)
         if use_lanes:
-            res, var = _train_run_grid_lanes(batch, w0, obj, l2s,
+            res, var = _train_run_grid_lanes(batch, w0, obj, l2s, l1s,
                                              static_cfg)
         else:
             res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,

@@ -112,6 +112,21 @@ def phi_at_ray_lanes(obj: Objective, z, dz, a, coeffs, batch: GLMBatch):
     return f + c0 + a * (c1 + 0.5 * a * c2), dphi + c1 + a * c2
 
 
+def value_at_margin_lanes(obj: Objective, l2s, W, z, batch: GLMBatch):
+    """Per-lane SMOOTH objective value (data loss + L2) from cached
+    margins — one (n, G) elementwise pass + one (G,)-vector psum, no X
+    pass and no gradient. The lane OWL-QN's backtracking trials only need
+    values (its Armijo test uses the pseudo-gradient computed once per
+    iteration), so paying value_and_grad's Xᵀ pass per trial would double
+    the line search's X traffic for nothing."""
+    loss, _, _ = loss_fns(obj.task)
+    y = batch.y[:, None]
+    wt = batch.weights[:, None]
+    value = obj._psum_many(jnp.sum(wt * loss(z, y), axis=0))[0]
+    rv, _ = _reg_terms_lanes(obj, l2s, W)
+    return value + rv
+
+
 def grad_at_margin_lanes(obj: Objective, l2s, W, z, batch: GLMBatch):
     """Per-lane gradient from cached margins — ONE lane-stacked Xᵀ pass."""
     _, d1, _ = loss_fns(obj.task)

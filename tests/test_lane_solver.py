@@ -137,24 +137,84 @@ def test_lane_grid_skewed_weights_converge_independently(rng):
     _grid_vs_sequential(batch, TaskType.LOGISTIC_REGRESSION, cfg, weights)
 
 
-def test_lane_grid_owlqn_falls_back_to_vmap_path(rng):
-    """Elastic-net sweeps route through OWL-QN lanes (vmapped path) and
-    still match sequential solves — the lane-minor router must not eat
-    them."""
+def test_lane_grid_owlqn_matches_sequential(rng):
+    """Elastic-net sweeps ride the lane-minor OWL-QN solver
+    (optim/lane_owlqn.py): each lane must match its own sequential OWL-QN
+    solve — coefficients, achieved objective, AND the L1 sparsity the
+    orthant projection is there to produce."""
     X, y = _sparse_problem(rng)
     batch = make_batch(X, y)
     cfg = OptimizerConfig(max_iters=120, tolerance=1e-6,
                           reg=elastic_net(0.5), reg_weight=0.0, history=5)
-    grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
-                          [1e-2, 1e-1])
-    for wt, (model, res) in zip([1e-2, 1e-1], grid):
-        m_seq, _ = train_glm(
+    weights = [1e-2, 1e-1, 3.0]
+    grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg, weights)
+    for wt, (model, res) in zip(weights, grid):
+        m_seq, r_seq = train_glm(
             batch, TaskType.LOGISTIC_REGRESSION,
             dataclasses.replace(cfg, reg_weight=wt,
                                 optimizer=OptimizerType.OWLQN))
+        np.testing.assert_allclose(float(res.value), float(r_seq.value),
+                                   rtol=1e-5,
+                                   err_msg=f"objective mismatch at {wt}")
         np.testing.assert_allclose(np.asarray(model.coefficients.means),
                                    np.asarray(m_seq.coefficients.means),
                                    atol=2e-3)
+    # The heavy-L1 lane must be genuinely sparse — exact zeros, not small
+    # (the sequential OWL-QN zeroes the same ~40% at this weight).
+    w_heavy = np.asarray(grid[-1][0].coefficients.means)
+    assert (w_heavy == 0.0).sum() > w_heavy.size // 3
+
+
+def test_lane_grid_owlqn_variance_fallback_vmap_path(rng):
+    """L1 grids that request variances cannot ride the lane road (the
+    lane runners skip variance computation) — they must fall back to the
+    vmapped runner and still match sequential solves, variances included."""
+    from photon_tpu.models.variance import VarianceComputationType
+
+    X, y = _sparse_problem(rng)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(max_iters=120, tolerance=1e-6,
+                          reg=elastic_net(0.5), reg_weight=0.0, history=5)
+    weights = [1e-2, 1e-1]
+    grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg, weights,
+                          variance=VarianceComputationType.SIMPLE)
+    for wt, (model, res) in zip(weights, grid):
+        m_seq, _ = train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, reg_weight=wt,
+                                optimizer=OptimizerType.OWLQN),
+            variance=VarianceComputationType.SIMPLE)
+        np.testing.assert_allclose(np.asarray(model.coefficients.means),
+                                   np.asarray(m_seq.coefficients.means),
+                                   atol=2e-3)
+        assert model.coefficients.variances is not None
+        np.testing.assert_allclose(np.asarray(model.coefficients.variances),
+                                   np.asarray(m_seq.coefficients.variances),
+                                   rtol=2e-2, atol=1e-4)
+
+
+def test_lane_grid_owlqn_sharded_hybrid(rng, mesh8):
+    from photon_tpu.data.dataset import shard_hybrid_batch
+
+    X, y = _sparse_problem(rng, n=640, d=400, k=10)
+    H = to_hybrid(X, 64)
+    batch = shard_hybrid_batch(make_batch(H, y), mesh8.devices.size)
+    cfg = OptimizerConfig(max_iters=120, tolerance=1e-6,
+                          reg=elastic_net(0.5), reg_weight=0.0, history=5)
+    weights = [1e-1, 1.0]
+    grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg, weights,
+                          mesh=mesh8)
+    single = make_batch(to_hybrid(X, 64), y)
+    for wt, (model, res) in zip(weights, grid):
+        m_seq, r_seq = train_glm(
+            single, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, reg_weight=wt,
+                                optimizer=OptimizerType.OWLQN))
+        np.testing.assert_allclose(float(res.value), float(r_seq.value),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(model.coefficients.means),
+                                   np.asarray(m_seq.coefficients.means),
+                                   atol=2e-2)
 
 
 def test_lane_grid_sharded_hybrid(rng, mesh8):
