@@ -38,7 +38,15 @@ def main() -> None:
     p.add_argument("--reg", choices=["l2", "elastic"], default="l2",
                    help="elastic = elastic_net(0.5): the sweep rides the "
                         "lane-minor OWL-QN road (L1 production shape)")
+    p.add_argument("--opt", choices=["lbfgs", "tron"], default="lbfgs",
+                   help="tron: the sweep rides the lane-minor margin-"
+                        "cached TRON (smooth reg only)")
     args = p.parse_args()
+    if args.opt == "tron" and args.reg == "elastic":
+        # lane_weight_arrays force-routes any L1 sweep to OWL-QN (upstream
+        # rule), so this combination would silently measure the OWL-QN
+        # solver under a TRON label.
+        p.error("--opt tron requires --reg l2 (L1 sweeps always run OWL-QN)")
 
     import jax
     import jax.numpy as jnp
@@ -46,7 +54,7 @@ def main() -> None:
     import bench
     from photon_tpu.models.training import train_glm_grid
     from photon_tpu.ops.losses import TaskType
-    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.config import OptimizerConfig, OptimizerType
     from photon_tpu.optim.regularization import elastic_net, l2
 
     if args.leg == "sparse":
@@ -63,6 +71,8 @@ def main() -> None:
         jax.block_until_ready(batch.X)
         iters_cfg = bench.D_ITERS
     cfg = OptimizerConfig(
+        optimizer=(OptimizerType.TRON if args.opt == "tron"
+                   else OptimizerType.LBFGS),
         max_iters=iters_cfg, tolerance=0.0,
         reg=elastic_net(0.5) if args.reg == "elastic" else l2(),
         reg_weight=0.0, history=5,

@@ -36,6 +36,7 @@ from photon_tpu.optim.config import OptimizerConfig, OptimizerType
 from photon_tpu.ops.lane_objective import supports_lanes
 from photon_tpu.optim.lane_lbfgs import minimize_lbfgs_margin_lanes
 from photon_tpu.optim.lane_owlqn import minimize_owlqn_lanes
+from photon_tpu.optim.lane_tron import minimize_tron_margin_lanes
 from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
 from photon_tpu.optim.owlqn import minimize_owlqn
 from photon_tpu.optim.tron import minimize_tron_margin
@@ -307,21 +308,26 @@ def _lane_result(res) -> OptResult:
 
 def _lane_solve(obj, batch, w0, l2s, l1s, config):
     """The one place a lane-minor solve is dispatched: smooth L2 sweeps on
-    the margin-cached L-BFGS lanes (optim/lane_lbfgs.py), L1/elastic-net
-    sweeps on the OWL-QN lanes (optim/lane_owlqn.py — the orthant
-    projection breaks margin linearity, so its trials pay one SHARED X
-    pass instead of riding cached margins). ``l1s is None`` is the route
-    switch; jit traces each case separately."""
+    the margin-cached L-BFGS or TRON lanes (optim/lane_lbfgs.py,
+    optim/lane_tron.py), L1/elastic-net sweeps on the OWL-QN lanes
+    (optim/lane_owlqn.py — the orthant projection breaks margin linearity,
+    so its trials pay one SHARED X pass instead of riding cached margins).
+    ``l1s is None`` + the static optimizer are the route switch; jit
+    traces each case separately."""
     W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
-    if l1s is None:
-        return minimize_lbfgs_margin_lanes(
-            obj, l2s, batch, W0, max_iters=config.max_iters,
+    if l1s is not None:
+        return minimize_owlqn_lanes(
+            obj, l2s, l1s, batch, W0, max_iters=config.max_iters,
             tolerance=config.tolerance, history=config.history,
-            history_dtype=config.lane_history_dtype)
-    return minimize_owlqn_lanes(
-        obj, l2s, l1s, batch, W0, max_iters=config.max_iters,
+            reg_mask=obj.reg_mask, history_dtype=config.lane_history_dtype)
+    if config.optimizer is OptimizerType.TRON:
+        return minimize_tron_margin_lanes(
+            obj, l2s, batch, W0, max_iters=config.max_iters,
+            tolerance=config.tolerance, cg_max_iters=config.cg_max_iters)
+    return minimize_lbfgs_margin_lanes(
+        obj, l2s, batch, W0, max_iters=config.max_iters,
         tolerance=config.tolerance, history=config.history,
-        reg_mask=obj.reg_mask, history_dtype=config.lane_history_dtype)
+        history_dtype=config.lane_history_dtype)
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -330,9 +336,9 @@ def _train_run_grid_lanes(batch, w0, obj, l2s, l1s, config):
     carries a minor lane axis, so the hot matvec is a true
     (n, d_sel) × (d_sel, G) MXU matmul and the tail gather/scatter costs
     the same index count as a single lane. The vmapped runner below
-    (_train_run_grid) is the general fallback (TRON lanes, variances,
-    priors); for reg sweeps this path is the fast road (the vmapped one
-    measured ~5× a single lane PER LANE at d=10M)."""
+    (_train_run_grid) is the general fallback (variances, priors); for
+    reg sweeps this path is the fast road (the vmapped one measured ~5× a
+    single lane PER LANE at d=10M)."""
     return _lane_result(_lane_solve(obj, batch, w0, l2s, l1s, config)), None
 
 
@@ -463,14 +469,13 @@ def train_glm_grid(
                          normalization=norm_obj,
                          intercept_index=intercept_index)
     # Reg sweeps without variances ride a lane-minor solver (one lock-step
-    # program sharing every X pass): smooth L2 sweeps on the margin-cached
-    # L-BFGS lanes, L1/elastic-net sweeps on the OWL-QN lanes. TRON and
-    # variance requests fall back to the general vmapped runner.
+    # program sharing every X pass): smooth sweeps on the margin-cached
+    # L-BFGS or TRON lanes, L1/elastic-net sweeps on the OWL-QN lanes.
+    # Variance requests fall back to the general vmapped runner.
     use_lanes = (variance is VarianceComputationType.NONE
                  and supports_lanes(obj)
-                 and static_cfg.optimizer in (OptimizerType.LBFGS,
-                                              OptimizerType.OWLQN)
-                 # lane_weight_arrays pins OWLQN <=> l1s is not None
+                 # lane_weight_arrays pins OWLQN <=> l1s is not None;
+                 # all three optimizers have a lane-minor solver
                  and (l1s is not None) == (static_cfg.optimizer
                                            is OptimizerType.OWLQN))
     if sharded_hybrid:

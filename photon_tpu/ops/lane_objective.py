@@ -112,6 +112,23 @@ def phi_at_ray_lanes(obj: Objective, z, dz, a, coeffs, batch: GLMBatch):
     return f + c0 + a * (c1 + 0.5 * a * c2), dphi + c1 + a * c2
 
 
+def hvp_at_margin_lanes(obj: Objective, l2s, z, batch: GLMBatch, V,
+                        dZv=None):
+    """H·v per lane with the margin z cached (Gauss-Newton form, exact for
+    GLMs): the d2 curve is evaluated on z, so an HVP is two shared X
+    passes — one (or zero, when the caller passes ``dZv``) for the
+    directions' margins and one lane-stacked backprop. V: (d, G);
+    dZv: (n, G) if already computed (TRON's CG has it)."""
+    _, _, d2 = loss_fns(obj.task)
+    if dZv is None:
+        dZv = direction_margin_lanes(obj, V, batch)
+    r = batch.weights[:, None] * d2(z, batch.y[:, None]) * dZv
+    gX, gsum = _backprop_lanes(obj, batch, r)
+    hv = _finish_backprop_lanes(obj, *obj._psum_many(gX, gsum))
+    masked = V if obj.reg_mask is None else obj.reg_mask[:, None] * V
+    return hv + l2s[None, :] * masked
+
+
 def value_at_margin_lanes(obj: Objective, l2s, W, z, batch: GLMBatch):
     """Per-lane SMOOTH objective value (data loss + L2) from cached
     margins — one (n, G) elementwise pass + one (G,)-vector psum, no X

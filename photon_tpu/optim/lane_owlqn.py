@@ -17,8 +17,8 @@ Differences from the scalar solver (optim/owlqn.py), all masked per lane:
 - OWL-QN's projected trial point breaks margin linearity (the orthant
   projection zeroes a data-dependent coordinate set), so unlike the
   margin-cached L-BFGS there is no z + a·dz shortcut — each trial pays
-  one SHARED X pass, plus one margin + one gradient pass at the accepted
-  point per iteration;
+  one SHARED X pass; the accepted lane's trial margin is carried out of
+  the search, so the outer step adds only the gradient's Xᵀ pass;
 - the (s, y) history uses the same globally rotating slot + per-(slot,
   lane) validity masks and cached f32 sᵀy/yᵀy steering products as the
   lane L-BFGS (optim/lane_lbfgs._push_lanes), including optional bf16
@@ -54,6 +54,10 @@ def pseudo_gradient_lanes(W, g, l1s, mask):
 
 class _LaneState(NamedTuple):
     W: jax.Array       # (d, G)
+    z: jax.Array       # (n, G) margin at W, shard-local (no chaining:
+    #                    every accepted column came fresh from its trial's
+    #                    margin_lanes(W_try), so there is no f32 drift to
+    #                    refresh away)
     f: jax.Array       # (G,) smooth part (data loss + L2)
     F: jax.Array       # (G,) f + L1
     g: jax.Array       # (d, G) smooth gradient
@@ -75,7 +79,8 @@ class _LaneState(NamedTuple):
 
 class _LaneLS(NamedTuple):
     a: jax.Array     # (G,) current/accepted step length
-    F: jax.Array     # (G,) objective at the accepted (or last tried) point
+    F: jax.Array     # (G,) objective at the accepted point
+    z: jax.Array     # (n, G) margin at the accepted point (trial reuse)
     succ: jax.Array  # (G,) sticky per-lane success
     i: jax.Array
 
@@ -107,11 +112,8 @@ def minimize_owlqn_lanes(
         absw = jnp.abs(W) if reg_mask is None else mask[:, None] * jnp.abs(W)
         return l1s * jnp.sum(absw, axis=0)
 
-    def smooth_value_grad(W):
-        z = lo.margin_lanes(obj, W, batch)
-        return lo.value_and_grad_at_margin_lanes(obj, l2s, W, z, batch)
-
-    f0, g0 = smooth_value_grad(W0)
+    z0 = lo.margin_lanes(obj, W0, batch)
+    f0, g0 = lo.value_and_grad_at_margin_lanes(obj, l2s, W0, z0, batch)
     F0 = f0 + l1_term(W0)
     pg0 = pseudo_gradient_lanes(W0, g0, l1s, mask)
     pg0norm = jnp.sqrt(jnp.sum(pg0 * pg0, axis=0))
@@ -138,12 +140,15 @@ def minimize_owlqn_lanes(
             return jnp.where(W * xi > 0.0, W, 0.0)
 
         def F_at(a):
-            """One SHARED X pass for all lanes' projected trial points."""
+            """One SHARED X pass for all lanes' projected trial points.
+            Also returns the trial margins: the accepted lane's column is
+            exactly the margin the outer step needs, so the caller never
+            re-derives it (saves one full X pass per iteration)."""
             W_try = project(s.W + a[None, :] * D)
             z_try = lo.margin_lanes(obj, W_try, batch)
             f_try = lo.value_at_margin_lanes(obj, l2s, W_try, z_try, batch)
             dec = jnp.sum(pg * (W_try - s.W), axis=0)
-            return f_try + l1_term(W_try), dec
+            return f_try + l1_term(W_try), dec, z_try
 
         has_hist = jnp.any(s.valid, axis=0)
         dnorm = jnp.sqrt(jnp.sum(D * D, axis=0))
@@ -155,29 +160,33 @@ def minimize_owlqn_lanes(
             return jnp.any(~t.succ & ~frozen) & (t.i < max_ls_evals)
 
         def ls_body(t: _LaneLS):
-            F_try, dec = F_at(t.a)
+            F_try, dec, z_try = F_at(t.a)
             ok_now = ((F_try <= s.F + c1 * dec) & (dec < 0.0)
                       & jnp.isfinite(F_try))
             moved = ~t.succ & ~frozen  # lanes this trial actually probed
+            acc = moved & ok_now
             return _LaneLS(
                 a=jnp.where(moved & ~ok_now, 0.5 * t.a, t.a),
-                F=jnp.where(moved & ok_now, F_try, t.F),
-                succ=t.succ | (moved & ok_now),
+                F=jnp.where(acc, F_try, t.F),
+                z=jnp.where(acc[None, :], z_try, t.z),
+                succ=t.succ | acc,
                 i=t.i + 1,
             )
 
         ls = lax.while_loop(
             ls_cond, ls_body,
-            _LaneLS(a=jnp.asarray(a0, dtype), F=s.F,
+            _LaneLS(a=jnp.asarray(a0, dtype), F=s.F, z=s.z,
                     succ=jnp.zeros((G,), bool), i=jnp.zeros((), jnp.int32)))
 
         step = active & ls.succ
         W_new = jnp.where(step[None, :],
                           project(s.W + ls.a[None, :] * D), s.W)
-        # One margin + one gradient pass at the (per-lane) accepted points;
-        # rejected/frozen lanes re-evaluate at their old W — harmless, the
-        # lock-step program pays the pass anyway.
-        f_new, g_new = smooth_value_grad(W_new)
+        # The accepted margins were already computed by the line search
+        # (ls.z; rejected/frozen lanes keep s.z), so the outer step pays
+        # ONE lane-stacked X^T pass for the gradient — no margin re-derive.
+        z_new = jnp.where(step[None, :], ls.z, s.z)
+        f_new, g_new = lo.value_and_grad_at_margin_lanes(
+            obj, l2s, W_new, z_new, batch)
         f_new = jnp.where(step, f_new, s.f)
         g_new = jnp.where(step[None, :], g_new, s.g)
         F_new = jnp.where(step, ls.F, s.F)
@@ -200,7 +209,7 @@ def minimize_owlqn_lanes(
         it = s.it + 1
         its = jnp.where(active, s.its + 1, s.its)
         return _LaneState(
-            W=W_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho,
+            W=W_new, z=z_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho,
             sy=sy, yy=yy, valid=valid, idx=idx, it=it, its=its,
             done=s.done | (active & (converged | ~ls.succ)),
             converged=jnp.where(active, converged, s.converged),
@@ -210,7 +219,7 @@ def minimize_owlqn_lanes(
         )
 
     init = _LaneState(
-        W=W0, f=f0, F=F0, g=g0,
+        W=W0, z=z0, f=f0, F=F0, g=g0,
         S=jnp.zeros((m, d, G), hdtype), Y=jnp.zeros((m, d, G), hdtype),
         rho=jnp.zeros((m, G), dtype), sy=jnp.zeros((m, G), dtype),
         yy=jnp.zeros((m, G), dtype), valid=jnp.zeros((m, G), bool),

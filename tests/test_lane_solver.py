@@ -165,6 +165,43 @@ def test_lane_grid_owlqn_matches_sequential(rng):
     assert (w_heavy == 0.0).sum() > w_heavy.size // 3
 
 
+@pytest.mark.parametrize("task", [TaskType.LOGISTIC_REGRESSION,
+                                  TaskType.LINEAR_REGRESSION])
+def test_lane_grid_tron_matches_sequential(rng, task):
+    """TRON sweeps ride the lane-minor margin-cached TRON
+    (optim/lane_tron.py): each lane must match its own sequential TRON
+    solve — same trust-region constants, same Steihaug subproblem, same
+    stop rules, per lane."""
+    X, y = _sparse_problem(rng, task=task)
+    batch = make_batch(X, y)
+    cfg = OptimizerConfig(optimizer=OptimizerType.TRON, max_iters=80,
+                          tolerance=1e-6, reg=l2(), reg_weight=0.0,
+                          cg_max_iters=20)
+    _grid_vs_sequential(batch, task, cfg, [1e-2, 1.0, 10.0])
+
+
+def test_lane_grid_tron_sharded_hybrid(rng, mesh8):
+    from photon_tpu.data.dataset import shard_hybrid_batch
+
+    X, y = _sparse_problem(rng, n=640, d=400, k=10)
+    H = to_hybrid(X, 64)
+    batch = shard_hybrid_batch(make_batch(H, y), mesh8.devices.size)
+    cfg = OptimizerConfig(optimizer=OptimizerType.TRON, max_iters=80,
+                          tolerance=1e-6, reg=l2(), reg_weight=0.0)
+    weights = [1e-1, 1.0, 30.0]
+    grid = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg, weights,
+                          mesh=mesh8)
+    single = make_batch(to_hybrid(X, 64), y)
+    for wt, (model, res) in zip(weights, grid):
+        m_seq, r_seq = train_glm(single, TaskType.LOGISTIC_REGRESSION,
+                                 dataclasses.replace(cfg, reg_weight=wt))
+        np.testing.assert_allclose(float(res.value), float(r_seq.value),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(model.coefficients.means),
+                                   np.asarray(m_seq.coefficients.means),
+                                   atol=2e-2)
+
+
 def test_lane_grid_owlqn_variance_fallback_vmap_path(rng):
     """L1 grids that request variances cannot ride the lane road (the
     lane runners skip variance computation) — they must fall back to the
