@@ -1,0 +1,200 @@
+"""Ahead-of-time program export (jax.export): skip re-TRACING across
+processes.
+
+The persistent XLA compilation cache (utils/compile_cache.py, round 5)
+removes re-compilation across processes, but a fresh process still pays
+jax tracing + lowering for every program — the measured ~20 s residual of
+the 1M GAME cold fit (docs/PERF.md, "Persistent XLA compilation cache")
+that no compilation cache can touch, and the reference's long-lived JVM
+never re-pays. ``jax.export`` serializes the traced StableHLO itself, so
+a later process deserializes and goes straight to (persistently cached)
+compilation.
+
+Measured honestly (benches/aot_glm.py, 524k×10M lane grid, fresh
+processes through the remote-compile tunnel): the replay removes only
+the trace+lowering share — first-result 16–18 s vs 22–29 s, overlapping
+tunnel-drift bands — because the residual is compile-cache FETCH over
+the tunnel plus the solve itself. The utility earns its keep where
+traces are the bottleneck (many programs / many shapes / local
+compiler); for one big program behind this tunnel the persistent XLA
+cache already did the heavy lifting. docs/PERF.md "AOT export".
+
+Pieces:
+- ``export_program(fn, *args, platforms=None) -> bytes`` — trace + lower
+  ``fn`` at ``args``'s shapes/dtypes and serialize. ``fn`` may be jitted
+  or plain (it is jitted if needed). ``platforms`` (e.g. ``("tpu",
+  "cpu")``) widens the export beyond the current default backend.
+- ``load_program(data)`` — deserialize to a callable. Shape/dtype
+  specialized: calling with different avals raises.
+- ``AotStore(cache_dir)`` — a keyed on-disk store.
+  ``store.call(key, fn, *args)`` replays a previous export when the key
+  AND the arguments' avals match, else exports (and persists) fresh.
+
+Scope: single-controller programs (anything photon-tpu jits on one
+device, including everything ``train_glm``/``train_glm_grid``/
+``score_game`` run there). Mesh/shard_map programs are exportable too,
+but calling a deserialized one requires reconstructing the SAME mesh
+layout first — use ``export_program``/``load_program`` directly and
+own the mesh lifecycle in that case rather than going through the
+store.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+
+__all__ = ["export_program", "load_program", "AotStore"]
+
+_registered = False
+
+
+def _register_serializations() -> None:
+    """Register photon-tpu's pytree node types with jax.export so they can
+    appear in an exported program's calling convention. Auxdata (the
+    static/meta fields of our register_dataclass pytrees — plain
+    ints/strings/enums/arrays-of-ints) rides pickle; these files are
+    local caches written by this process family, the same trust domain
+    as the persistent XLA compilation cache."""
+    global _registered
+    if _registered:
+        return
+    import pickle
+
+    from jax import export as jexport
+
+    from photon_tpu.data import matrix as _mx
+    from photon_tpu.data.dataset import GLMBatch
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.ops.objective import Objective
+    from photon_tpu.optim.tracker import OptResult
+
+    def reg(cls):
+        name = f"photon_tpu.{cls.__module__}.{cls.__name__}"
+        try:
+            jexport.register_pytree_node_serialization(
+                cls, serialized_name=name,
+                serialize_auxdata=pickle.dumps,
+                deserialize_auxdata=pickle.loads)
+        except ValueError:
+            pass  # already registered (e.g. two stores in one process)
+
+    def reg_nt(cls):
+        try:
+            jexport.register_namedtuple_serialization(
+                cls,
+                serialized_name=f"photon_tpu.{cls.__module__}.{cls.__name__}")
+        except ValueError:
+            pass
+
+    for cls in (_mx.SparseRows, _mx.HybridRows, _mx.ShardedHybridRows,
+                _mx.PermutedHybridRows, Objective, Coefficients,
+                GeneralizedLinearModel):
+        reg(cls)
+    for cls in (GLMBatch, OptResult):
+        reg_nt(cls)
+    _registered = True
+
+
+def _ensure_jitted(fn: Callable) -> Callable:
+    # jax.export requires a jitted callable; wrapping an already-jitted
+    # function in jax.jit again is a no-op layer, so just branch.
+    if hasattr(fn, "lower"):  # jitted functions expose .lower
+        return fn
+    return jax.jit(fn)
+
+
+def export_program(fn: Callable, *args,
+                   platforms: Optional[Sequence[str]] = None) -> bytes:
+    """Serialize ``fn`` traced at ``args``'s shapes/dtypes to bytes."""
+    from jax import export as jexport
+
+    _register_serializations()
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = tuple(platforms)
+    exp = jexport.export(_ensure_jitted(fn), **kwargs)(*args)
+    return exp.serialize()
+
+
+def load_program(data: bytes) -> Callable:
+    """Deserialize an ``export_program`` blob to a callable."""
+    from jax import export as jexport
+
+    _register_serializations()
+    return jexport.deserialize(data).call
+
+
+def _avals_fingerprint(args) -> str:
+    """Hash of the argument pytree's structure + leaf shapes/dtypes (the
+    specialization key of an export)."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    h = hashlib.sha256(repr(treedef).encode())
+    for leaf in leaves:
+        x = jax.numpy.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+        h.update(f"{tuple(x.shape)}:{x.dtype}".encode())
+    return h.hexdigest()[:16]
+
+
+class AotStore:
+    """On-disk keyed store of exported programs.
+
+    >>> store = AotStore("/path/to/aot")
+    >>> out = store.call("train_glm@2Mx10M", fn, *args)
+
+    First call per (key, avals): traces, exports, persists, runs.
+    Later processes: deserializes (no tracing) and runs — compilation
+    itself is then served by the persistent XLA cache when enabled.
+    """
+
+    def __init__(self, cache_dir: str,
+                 platforms: Optional[Sequence[str]] = None):
+        self.cache_dir = cache_dir
+        self.platforms = platforms
+        self._loaded: dict = {}
+        os.makedirs(cache_dir, exist_ok=True)
+
+    def _path(self, key: str, fp: str) -> str:
+        # The export's platform set is part of its calling convention, so
+        # it is part of the file identity (a store populated for "cpu"
+        # must not shadow one for ("tpu", "cpu")).
+        plat = ",".join(self.platforms) if self.platforms else "default"
+        safe = hashlib.sha256(f"{key}|{plat}".encode()).hexdigest()[:16]
+        return os.path.join(self.cache_dir, f"{safe}-{fp}.jaxexp")
+
+    def call(self, key: str, fn: Callable, *args):
+        """Run ``fn(*args)``, replaying a stored export when available.
+
+        ``key`` must capture everything that changes the PROGRAM beyond
+        the arguments' shapes/dtypes — closure-captured static config,
+        solver version — because the store cannot see inside ``fn``; a
+        stale key replays the old program. Argument avals and the
+        store's platform set are fingerprinted automatically; a replay
+        whose stored platform no longer matches the running backend
+        falls back to a fresh export instead of raising."""
+        fp = _avals_fingerprint(args)
+        path = self._path(key, fp)
+        cached = self._loaded.get(path)
+        if cached is None and os.path.exists(path):
+            with open(path, "rb") as f:
+                cached = load_program(f.read())
+            self._loaded[path] = cached
+        if cached is not None:
+            try:
+                return cached(*args)
+            except ValueError:
+                # jax.export's call-time platform check: the file was
+                # exported for a different backend (e.g. a store
+                # populated on a CPU dev box now read on a TPU VM).
+                # Self-heal by re-exporting for the current platform.
+                self._loaded.pop(path, None)
+        data = export_program(fn, *args, platforms=self.platforms)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: concurrent processes race safely
+        run = load_program(data)
+        self._loaded[path] = run
+        return run(*args)

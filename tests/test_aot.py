@@ -1,0 +1,73 @@
+"""AOT export round-trips (utils/aot.py): an exported lane-grid program
+must replay from bytes — no retracing — with identical results, through
+photon-tpu's registered pytree types (GLMBatch in, OptResult out)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.data.matrix import SparseRows, to_permuted_hybrid
+from photon_tpu.models.training import (_lane_solve, lane_weight_arrays,
+                                        make_objective)
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+from photon_tpu.utils.aot import AotStore, export_program, load_program
+
+
+def _problem(rng, n=400, d=120, k=6):
+    ind = rng.integers(0, d - 1, size=(n, k)).astype(np.int32)
+    ind[:, -1] = d - 1
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    val[:, -1] = 1.0
+    wt = rng.normal(size=d).astype(np.float32) * 0.5
+    z = np.einsum("nk,nk->n", val, wt[ind])
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    X = to_permuted_hybrid(SparseRows(jnp.asarray(ind), jnp.asarray(val), d),
+                           16)
+    return make_batch(X, y)
+
+
+def _fn_and_args(rng):
+    batch = _problem(rng)
+    cfg = OptimizerConfig(max_iters=30, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.0, history=5)
+    l2s, l1s, static_cfg = lane_weight_arrays(cfg, [1e-2, 1.0])
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg,
+                         batch.X.n_features)
+    w0 = jnp.zeros((batch.X.n_features,), jnp.float32)
+
+    def fn(batch, w0, obj, l2s):
+        return _lane_solve(obj, batch, w0, l2s, None, static_cfg)
+
+    return fn, (batch, w0, obj, l2s)
+
+
+def test_export_replay_bitwise(rng, tmp_path):
+    fn, args = _fn_and_args(rng)
+    direct = jax.jit(fn)(*args)
+    data = export_program(fn, *args)
+    replay = load_program(data)(*args)
+    for a, b in zip(jax.tree_util.tree_leaves(direct),
+                    jax.tree_util.tree_leaves(replay)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_store_hits_and_aval_guard(rng, tmp_path):
+    fn, args = _fn_and_args(rng)
+    store = AotStore(str(tmp_path))
+    r1 = store.call("lane", fn, *args)
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".jaxexp")]
+    assert len(files) == 1
+    # Fresh store object (new process analog): replays from disk.
+    store2 = AotStore(str(tmp_path))
+    r2 = store2.call("lane", fn, *args)
+    np.testing.assert_array_equal(np.asarray(r1.w), np.asarray(r2.w))
+    # Different avals under the same key re-export instead of misfiring.
+    bigger = _problem(np.random.default_rng(2), n=512)
+    r3 = store2.call("lane", fn, bigger, args[1], args[2], args[3])
+    assert np.asarray(r3.w).shape == np.asarray(r1.w).shape
+    assert len([f for f in os.listdir(tmp_path)
+                if f.endswith(".jaxexp")]) == 2
