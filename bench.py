@@ -78,6 +78,12 @@ D_ROWS = 1 << 19
 D_FEATURES = 256
 D_ITERS = 40
 D_GRID = list(np.geomspace(1e-4, 1e-2, 16))  # 16 reg weights, one program
+# Model-selection-scale ceiling: at G=256 the (n, 256) x (256, G) lane
+# matmul finally feeds the MXU — G=64 -> 128 runs at FLAT wall time and
+# the knee is ~256 (7.5e9 aggregate; 512 adds only 5% — docs/PERF.md
+# lane curve). 256 lanes = a fine-grained lambda sweep or a q-EI tuner
+# batch; the reference runs one Spark job per point.
+D_GRID_BIG = list(np.geomspace(1e-5, 1e-1, 256))
 
 REPS = 5  # keep the best: tunnel throughput drifts ±30% between runs
 
@@ -184,14 +190,14 @@ def run_sparse_grid(batch) -> float:
     return rows * int(iters) / best
 
 
-def run_dense(batch) -> float:
+def run_dense(batch, grid_weights) -> float:
     cfg = OptimizerConfig(max_iters=D_ITERS, tolerance=0.0, reg=l2(),
                           reg_weight=0.0)
 
     def once():
         # train_glm_grid's internal device_get closes the timing
         return train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
-                              D_GRID)
+                              grid_weights)
 
     best, grid = _best_of(once)
     iters = sum(int(res.iterations) for _, res in grid)
@@ -202,7 +208,9 @@ def main() -> None:
     batch = sparse_problem()
     grid_value = run_sparse_grid(batch)
     single_value = run_sparse(batch)
-    dense_value = run_dense(dense_problem())
+    dense_batch = dense_problem()
+    dense_value = run_dense(dense_batch, D_GRID)
+    dense_big_value = run_dense(dense_batch, D_GRID_BIG)
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
     print(json.dumps({
         "metric": "sparse10m_logistic_grid8_rows_iters_per_sec_per_chip",
@@ -216,6 +224,9 @@ def main() -> None:
                                                        3),
             "dense_grid16_rows_iters_per_sec_per_chip": round(dense_value, 1),
             "dense_grid16_vs_baseline": round(dense_value / base, 3),
+            "dense_grid256_rows_iters_per_sec_per_chip":
+                round(dense_big_value, 1),
+            "dense_grid256_vs_baseline": round(dense_big_value / base, 3),
         },
     }))
 

@@ -528,6 +528,34 @@ class TestVectorizedFixedGrid:
             assert rf.configs["fixed"].optimizer.reg_weight == \
                 rs.configs["fixed"].optimizer.reg_weight
 
+    def test_matches_sequential_path_elastic_net(self, rng):
+        """Fixed-only L1 grids through the estimator ride the OWL-QN lane
+        road inside train_glm_grid and must still match the sequential
+        estimator path point for point (incl. exact-zero sparsity)."""
+        data = self._data(rng)
+        cfg = OptimizerConfig(max_iters=80, reg=reg.elastic_net(0.5),
+                              reg_weight=1.0, regularize_intercept=True)
+        grid = [{"fixed": FixedEffectConfig(
+            "fixed", dataclasses.replace(cfg, reg_weight=wt))}
+            for wt in (0.05, 0.5, 5.0)]
+
+        def run(vectorized):
+            est = GameEstimator(
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configs={"fixed": FixedEffectConfig("fixed", cfg)},
+                n_sweeps=1, vectorized_grid=vectorized, warm_start=False)
+            return est.fit(data, config_grid=grid)
+
+        fast = run(True)
+        slow = run(False)
+        for rf, rs in zip(fast, slow):
+            wf = np.asarray(
+                rf.model.coordinates["fixed"].model.coefficients.means)
+            ws = np.asarray(
+                rs.model.coordinates["fixed"].model.coefficients.means)
+            np.testing.assert_allclose(wf, ws, atol=2e-3)
+            np.testing.assert_array_equal(wf == 0.0, ws == 0.0)
+
     def test_fast_path_not_taken_with_random_effects(self, rng):
         """Mixed-effect grids must keep the sequential path (probe None)."""
         data = self._data(rng)
