@@ -18,6 +18,7 @@ from photon_tpu.data.matrix import (
     Matrix,
     PermutedHybridRows,
     ShardedHybridRows,
+    ShardedPermutedHybridRows,
     SparseRows,
     shard_hybrid,
 )
@@ -42,7 +43,7 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
     if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
-                          PermutedHybridRows)):
+                          PermutedHybridRows, ShardedPermutedHybridRows)):
         import jax
 
         # host numpy transfers as f32; an already-device FLOATING array
@@ -64,10 +65,10 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
         return batch
     extra = target_n - n
     X = batch.X
-    if isinstance(X, ShardedHybridRows):
+    if isinstance(X, (ShardedHybridRows, ShardedPermutedHybridRows)):
         raise ValueError(
-            "cannot pad a ShardedHybridRows batch (per-shard tails are "
-            "already laid out); pad before shard_hybrid_batch")
+            "cannot pad a sharded batch (per-shard tails are already laid "
+            "out); pad before shard_hybrid_batch/shard_permuted_batch")
     if isinstance(X, HybridRows):
         import dataclasses
 
@@ -123,6 +124,25 @@ def shard_hybrid_batch(batch: GLMBatch, n_shards: int,
     return batch._replace(X=shard_hybrid(batch.X, n_shards, d_dense))
 
 
+def shard_permuted_batch(batch: GLMBatch, n_shards: int,
+                         d_dense: int = 1024,
+                         device_dense_dtype=None) -> GLMBatch:
+    """Pad a sparse batch to the mesh and re-lay its X as
+    ShardedPermutedHybridRows (data.matrix.shard_permuted_hybrid): the
+    mesh-ready form of the SCATTER-FREE permuted layout — each device gets
+    its own cumsum flat tail + local-row bucket matrices under one global
+    column permutation, so the sharded solve compiles to one all-reduce,
+    zero other collectives, and zero scatters (tests/test_multihost.py)."""
+    from photon_tpu.data.matrix import shard_permuted_hybrid
+    from photon_tpu.parallel.mesh import pad_to_multiple
+
+    if not isinstance(batch.X, SparseRows):
+        raise TypeError("shard_permuted_batch expects SparseRows")
+    batch = pad_batch(batch, pad_to_multiple(batch.n, n_shards))
+    return batch._replace(X=shard_permuted_hybrid(
+        batch.X, n_shards, d_dense, device_dense_dtype=device_dense_dtype))
+
+
 def with_offsets(batch: GLMBatch, offsets) -> GLMBatch:
     return batch._replace(offsets=jnp.asarray(offsets, jnp.float32))
 
@@ -134,7 +154,7 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
     (data.matrix matvec/rmatvec use preferred_element_type=float32).
     Labels/weights/offsets and all solver state stay f32."""
     X = batch.X
-    if isinstance(X, PermutedHybridRows):
+    if isinstance(X, (PermutedHybridRows, ShardedPermutedHybridRows)):
         import dataclasses
 
         X = dataclasses.replace(

@@ -227,8 +227,107 @@ class PermutedHybridRows:
         return jnp.asarray(w)[self.inv_perm]
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "tail_pcols", "tail_vals", "row_bounds",
+                 "bucket_rows", "bucket_vals", "perm_cols", "inv_perm"),
+    meta_fields=("n_features", "n_prefix", "last_col_pos"),
+)
+@dataclasses.dataclass(frozen=True)
+class ShardedPermutedHybridRows:
+    """PermutedHybridRows laid out for a device mesh: the multi-chip form
+    of the scatter-free layout.
+
+    Round 5 measured TPU scatter-adds as the sparse X-pass wall (~12 ns
+    per ELEMENT vs ~7 ns per gather INDEX regardless of width —
+    PermutedHybridRows docstring); ShardedHybridRows still pays them in
+    every per-shard tail segment_sum. This layout gives each shard its
+    own complete scatter-free piece: per-shard row-major flat tails
+    (matvec's cumsum reduction) and per-shard occurrence-bucket matrices
+    with LOCAL row ids (rmatvec's gather+reduce concatenation), under ONE
+    GLOBAL column permutation so the (d,)-space solver state and the
+    single gradient all-reduce stay aligned across shards. Inside
+    shard_map, `local()` squeezes the shard axis into a plain
+    PermutedHybridRows and the single-device ops run unchanged — the
+    compiled per-evaluation pattern is ONE all-reduce, zero other
+    collectives, zero scatters (pinned by tests/test_multihost.py).
+
+    Scaling caveat (documented, not hidden): the hot dense block and the
+    flat-tail matvec shard perfectly (per-device work ∝ n/S), but the
+    bucket CONCATENATION does not — every shard must emit the full
+    (P - d_sel,) tail-column block for the aligned psum, so its c_b axis
+    is the GLOBAL distinct-tail-column count regardless of S (a column's
+    absent shards carry zero-padded slots). Per-device bucket work is
+    therefore ~the single-device cost, not 1/S of it; the layout wins
+    where the hot block + lane-stacked scatters dominate (the measured
+    regime for reg sweeps) and the bucket exponent uses MAX-LOCAL
+    occurrence counts, so per-shard padding stays ≤2× per present
+    column + one slot per absent shard.
+
+    Works in two views like ShardedHybridRows: global (plain jit; ops
+    vmap the shard axis) and local (inside shard_map via `local()`).
+    Residency contract: host numpy leaves (dense inherits the builder
+    input's residency); `models.training._sharded_prep` does the one
+    device_put into the mesh sharding. COORDINATE CONVENTION as
+    PermutedHybridRows: solver vectors live in permuted space;
+    `to_model_space` / `from_model_space` translate at the public
+    boundary.
+    """
+
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot block, global rows
+    tail_pcols: jax.Array | np.ndarray  # (S, m) int32 PERMUTED col ids
+    tail_vals: jax.Array | np.ndarray   # (S, m) tail values (padding: 0)
+    row_bounds: jax.Array | np.ndarray  # (S, n_local + 1) int32
+    bucket_rows: tuple                  # per bucket: (S, c_b, k_b) LOCAL rows
+    bucket_vals: tuple                  # per bucket: (S, c_b, k_b) values
+    perm_cols: jax.Array | np.ndarray   # (d,) replicated
+    inv_perm: jax.Array | np.ndarray    # (d,) replicated
+    n_features: int
+    n_prefix: int
+    last_col_pos: int
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+    @property
+    def d_sel(self) -> int:
+        return self.dense.shape[1]
+
+    @property
+    def n_shards(self) -> int:
+        return self.tail_pcols.shape[0]
+
+    @property
+    def n_local(self) -> int:
+        return self.dense.shape[0] // self.tail_pcols.shape[0]
+
+    def local(self) -> PermutedHybridRows:
+        """The one-shard view (inside shard_map, where the shard axis has
+        been sliced to length 1)."""
+        return PermutedHybridRows(
+            dense=self.dense,
+            tail_pcols=self.tail_pcols[0],
+            tail_vals=self.tail_vals[0],
+            row_bounds=self.row_bounds[0],
+            bucket_rows=tuple(b[0] for b in self.bucket_rows),
+            bucket_vals=tuple(b[0] for b in self.bucket_vals),
+            perm_cols=self.perm_cols,
+            inv_perm=self.inv_perm,
+            n_features=self.n_features,
+            n_prefix=self.n_prefix,
+            last_col_pos=self.last_col_pos,
+        )
+
+    def from_model_space(self, v):
+        return jnp.asarray(v)[self.perm_cols]
+
+    def to_model_space(self, w):
+        return jnp.asarray(w)[self.inv_perm]
+
+
 Matrix = (jax.Array | SparseRows | HybridRows | ShardedHybridRows
-          | PermutedHybridRows)
+          | PermutedHybridRows | ShardedPermutedHybridRows)
 
 
 _SCATTER_CHUNK_ELEMS = 1 << 29  # ~2 GB f32 intermediate per scatter chunk
@@ -507,6 +606,128 @@ def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
     )
 
 
+def shard_permuted_hybrid(X: SparseRows, n_shards: int,
+                          d_dense: int = 1024,
+                          device_dense_dtype=None
+                          ) -> ShardedPermutedHybridRows:
+    """Build the scatter-free SHARDED permuted hybrid (see
+    ShardedPermutedHybridRows) from padded COO rows. Rows must already
+    divide ``n_shards`` (`data.dataset.shard_permuted_batch` pads + builds).
+
+    One vectorized host pass, mirroring `to_permuted_hybrid` with a
+    GLOBAL column permutation (hot prefix from global frequencies, tail
+    ranks by occurrence bucket) and PER-SHARD structures: each shard's
+    row-major flat tail slice (padded to the max shard length) and its
+    occurrence-bucket matrices holding the shard's LOCAL occurrences of
+    every bucket column (absent shards carry zero slots). The bucket
+    exponent uses the MAX-LOCAL count across shards — not the global
+    count — so per-shard padding stays ≤2× per present column.
+    """
+    n = np.asarray(X.indices).shape[0]
+    d = X.n_features
+    if n % n_shards != 0:
+        raise ValueError(
+            f"{n} rows do not divide {n_shards} shards; pad the batch first "
+            "(data.dataset.shard_permuted_batch)")
+    n_local = n // n_shards
+    d_sel = min(d_dense, d)
+    dense, sel, t_rows, t_cols, t_vals = _hot_cold_split(
+        X, d_dense, device_dense_dtype)
+    t_vals = t_vals.astype(np.float32)
+    m_tot = t_rows.size
+    S = n_shards
+
+    if m_tot == 0:
+        perm_cols = np.concatenate(
+            [sel, np.setdiff1d(np.arange(d), sel)]).astype(np.int32)
+        inv_perm = np.empty(d, np.int64)
+        inv_perm[perm_cols] = np.arange(d)
+        return ShardedPermutedHybridRows(
+            dense=dense, tail_pcols=np.zeros((S, 1), np.int32),
+            tail_vals=np.zeros((S, 1), np.float32),
+            row_bounds=np.zeros((S, n_local + 1), np.int32),
+            bucket_rows=(), bucket_vals=(),
+            perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+            n_features=d, n_prefix=d_sel,
+            last_col_pos=int(inv_perm[d - 1]))
+
+    s_ids = (t_rows // n_local).astype(np.int64)       # (m,) shard per nnz
+    loc_rows = (t_rows - s_ids * n_local).astype(np.int64)
+
+    u_cols, inv, u_counts = np.unique(t_cols, return_inverse=True,
+                                      return_counts=True)
+    U = u_cols.size
+    # per-(column, shard) occurrence counts -> MAX-LOCAL count per column
+    cs_counts = np.bincount(inv * S + s_ids, minlength=U * S).reshape(U, S)
+    max_local = cs_counts.max(axis=1)
+    e = np.zeros(U, np.int64)
+    big = max_local > 1
+    e[big] = np.ceil(np.log2(max_local[big].astype(np.float64))).astype(
+        np.int64)
+    order = np.lexsort((u_cols, e))   # bucket-major, col-id within bucket
+    rank = np.empty(U, np.int64)
+    rank[order] = np.arange(U)
+
+    pcol = (d_sel + rank[inv]).astype(np.int32)   # (m,) global prefix ids
+
+    perm_prefix = np.concatenate([sel, u_cols[order]])
+    untouched = np.setdiff1d(np.arange(d), perm_prefix)
+    perm_cols = np.concatenate([perm_prefix, untouched]).astype(np.int32)
+    inv_perm = np.empty(d, np.int64)
+    inv_perm[perm_cols] = np.arange(d)
+
+    # per-shard row-major flat tails (t_rows ascending -> shard slices are
+    # contiguous); padding entries (pcol=d_sel, val=0) sit past each
+    # shard's last row bound and contribute nothing either way
+    sb = np.searchsorted(t_rows, np.arange(S + 1) * n_local)
+    m = max(1, int(np.max(np.diff(sb))))
+    tail_pcols = np.full((S, m), d_sel, np.int32)
+    tail_vals = np.zeros((S, m), np.float32)
+    row_bounds = np.zeros((S, n_local + 1), np.int32)
+    for s in range(S):
+        lo, hi = int(sb[s]), int(sb[s + 1])
+        c = hi - lo
+        tail_pcols[s, :c] = pcol[lo:hi]
+        tail_vals[s, :c] = t_vals[lo:hi]
+        row_bounds[s] = np.searchsorted(
+            loc_rows[lo:hi], np.arange(n_local + 1)).astype(np.int32)
+
+    # per-shard occurrence-bucket matrices: sort nnz by (rank, shard);
+    # within a (rank, shard) group the row-major source keeps local rows
+    # ascending
+    rank_nnz = rank[inv]
+    nnz_order = np.lexsort((s_ids, rank_nnz))
+    rs_key = (rank_nnz * S + s_ids)[nnz_order]
+    counts_rs = np.bincount(rs_key, minlength=U * S)
+    offsets_rs = np.concatenate([[0], np.cumsum(counts_rs)])
+    pos_within = np.arange(m_tot) - offsets_rs[rs_key]
+    rank_sorted = rank_nnz[nnz_order]
+    es = e[order]                      # exponent per rank, ascending
+    bucket_rows, bucket_vals = [], []
+    for e_v in np.unique(es):
+        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
+        c_b, k_b = int(r1 - r0), 1 << int(e_v)
+        lo, hi = np.searchsorted(rank_sorted, [r0, r1])
+        br = np.zeros((S, c_b, k_b), np.int32)
+        bv = np.zeros((S, c_b, k_b), np.float32)
+        sel_nnz = nnz_order[lo:hi]
+        ls = s_ids[sel_nnz]
+        lr = rank_nnz[sel_nnz] - r0
+        pw = pos_within[lo:hi]
+        br[ls, lr, pw] = loc_rows[sel_nnz]
+        bv[ls, lr, pw] = t_vals[sel_nnz]
+        bucket_rows.append(br)
+        bucket_vals.append(bv)
+
+    return ShardedPermutedHybridRows(
+        dense=dense, tail_pcols=tail_pcols, tail_vals=tail_vals,
+        row_bounds=row_bounds,
+        bucket_rows=tuple(bucket_rows), bucket_vals=tuple(bucket_vals),
+        perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+        n_features=d, n_prefix=d_sel + U,
+        last_col_pos=int(inv_perm[d - 1]))
+
+
 def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
     """Pad a scipy CSR matrix to fixed nnz-per-row (fully vectorized —
     no per-row Python loop, so billion-row ingestion is numpy-bound).
@@ -567,6 +788,48 @@ def _permuted_matvec(X: PermutedHybridRows, w):
     return hot + _tail_rowsum(contrib, X.row_bounds)
 
 
+def _sperm_matvec(X: ShardedPermutedHybridRows, w):
+    """Global (plain-jit) view of the sharded permuted matvec: per-shard
+    cumsum tails vmapped over the shard axis. Inside shard_map the solver
+    never reaches this — `local()` routes to the single-device ops."""
+    hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
+                     preferred_element_type=jnp.float32)
+    if w.ndim == 1:
+        contrib = X.tail_vals.astype(jnp.float32) * w[X.tail_pcols]
+    else:
+        contrib = X.tail_vals.astype(jnp.float32)[..., None] * w[X.tail_pcols]
+    tails = jax.vmap(_tail_rowsum)(contrib, X.row_bounds)
+    return hot + tails.reshape((X.dense.shape[0],) + w.shape[1:])
+
+
+def _sperm_rmatvec(X: ShardedPermutedHybridRows, r, square: bool = False):
+    """Global view of the sharded permuted rmatvec: per-shard bucket
+    gather+reduce (local row ids index the shard's row slice), summed over
+    shards, assembled by concatenation — still no scatter."""
+    f32 = jnp.float32
+    S, n_local = X.n_shards, X.n_local
+    lanes = r.ndim == 2
+    dense = X.dense * X.dense if square else X.dense
+    parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
+                        preferred_element_type=f32)]
+    r2 = r.reshape((S, n_local) + r.shape[1:])
+    s_idx = jnp.arange(S)[:, None, None]
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        v = bv.astype(f32)
+        if square:
+            v = v * v
+        g = r2[s_idx, br]                      # (S, c_b, k_b[, G])
+        if lanes:
+            parts.append(jnp.einsum("sck,sckg->cg", v, g))
+        else:
+            parts.append(jnp.einsum("sck,sck->c", v, g))
+    pad = X.n_features - X.n_prefix
+    if pad:
+        shape = (pad, r.shape[1]) if lanes else (pad,)
+        parts.append(jnp.zeros(shape, f32))
+    return jnp.concatenate(parts, axis=0)
+
+
 def _permuted_rmatvec(X: PermutedHybridRows, r, square: bool = False):
     """Xᵀr (or (X∘X)ᵀr with square=True): assembled by CONCATENATION — the
     hot block's matmul, each occurrence bucket's gather+reduce (columns
@@ -625,6 +888,8 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     """
     if isinstance(X, PermutedHybridRows):
         return _permuted_matvec(X, w)
+    if isinstance(X, ShardedPermutedHybridRows):
+        return _sperm_matvec(X, w)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         tail = jax.ops.segment_sum(
@@ -655,6 +920,8 @@ def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     bf16-storage aware like matvec)."""
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec(X, r)
+    if isinstance(X, ShardedPermutedHybridRows):
+        return _sperm_rmatvec(X, r)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         out = jax.ops.segment_sum(
@@ -691,6 +958,8 @@ def matvec_lanes(X: Matrix, W: jax.Array) -> jax.Array:
     """
     if isinstance(X, PermutedHybridRows):
         return _permuted_matvec_lanes(X, W)
+    if isinstance(X, ShardedPermutedHybridRows):
+        return _sperm_matvec(X, W)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         tail = jax.ops.segment_sum(
@@ -724,6 +993,8 @@ def rmatvec_lanes(X: Matrix, R: jax.Array) -> jax.Array:
     """
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec_lanes(X, R)
+    if isinstance(X, ShardedPermutedHybridRows):
+        return _sperm_rmatvec(X, R)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         out = jax.ops.segment_sum(
@@ -760,6 +1031,8 @@ def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec(X, r, square=True)
+    if isinstance(X, ShardedPermutedHybridRows):
+        return _sperm_rmatvec(X, r, square=True)
     if isinstance(X, ShardedHybridRows):
         rows, cols, vals = X._global_tail()
         tv = vals.astype(jnp.float32)

@@ -27,7 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_tpu.data.dataset import GLMBatch, pad_batch
 from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
-                                    ShardedHybridRows, SparseRows)
+                                    ShardedHybridRows,
+                                    ShardedPermutedHybridRows, SparseRows)
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
@@ -143,14 +144,23 @@ def _train_run(batch, w0, obj, l1_lam, config, variance):
     return res, var
 
 
-def _hybrid_specs(X: ShardedHybridRows, axes: tuple, wrap=lambda s: s):
-    """(batch_spec_tree) for a ShardedHybridRows batch: every data leaf's
-    axis 0 over all mesh axes, dense_cols replicated. ``wrap`` lifts each
-    PartitionSpec (e.g. into a NamedSharding for device_put)."""
+def _hybrid_specs(X, axes: tuple, wrap=lambda s: s):
+    """(batch_spec_tree) for a sharded hybrid batch: every per-shard data
+    leaf's axis 0 over all mesh axes, global vectors replicated. ``wrap``
+    lifts each PartitionSpec (e.g. into a NamedSharding for device_put)."""
     dat, rep = wrap(P(axes)), wrap(P())
-    x = ShardedHybridRows(dense=dat, dense_cols=rep, tail_rows=dat,
-                          tail_cols=dat, tail_vals=dat,
-                          n_features=X.n_features)
+    if isinstance(X, ShardedPermutedHybridRows):
+        x = ShardedPermutedHybridRows(
+            dense=dat, tail_pcols=dat, tail_vals=dat, row_bounds=dat,
+            bucket_rows=tuple(dat for _ in X.bucket_rows),
+            bucket_vals=tuple(dat for _ in X.bucket_vals),
+            perm_cols=rep, inv_perm=rep,
+            n_features=X.n_features, n_prefix=X.n_prefix,
+            last_col_pos=X.last_col_pos)
+    else:
+        x = ShardedHybridRows(dense=dat, dense_cols=rep, tail_rows=dat,
+                              tail_cols=dat, tail_vals=dat,
+                              n_features=X.n_features)
     return GLMBatch(X=x, y=dat, weights=dat, offsets=dat)
 
 
@@ -215,7 +225,8 @@ def _train_run_sharded_grid(batch, w0, obj, l2s, l1s, config, variance,
 def _matrix_dim(X) -> int:
     return (X.n_features
             if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
-                              PermutedHybridRows))
+                              PermutedHybridRows,
+                              ShardedPermutedHybridRows))
             else X.shape[1])
 
 
@@ -446,14 +457,16 @@ def train_glm_grid(
     should fetch only what they need.
     """
     d = _matrix_dim(batch.X)
-    sharded_hybrid = mesh is not None and isinstance(batch.X,
-                                                     ShardedHybridRows)
-    permuted = isinstance(batch.X, PermutedHybridRows)
-    if permuted and mesh is not None:
+    sharded_hybrid = mesh is not None and isinstance(
+        batch.X, (ShardedHybridRows, ShardedPermutedHybridRows))
+    permuted = isinstance(batch.X, (PermutedHybridRows,
+                                    ShardedPermutedHybridRows))
+    if isinstance(batch.X, PermutedHybridRows) and mesh is not None:
         raise ValueError(
             "PermutedHybridRows is a single-device representation (its "
-            "bucketed tail cannot be row-sharded); use ShardedHybridRows "
-            "under a mesh")
+            "bucketed tail cannot be row-sharded); use "
+            "ShardedPermutedHybridRows (data.dataset.shard_permuted_batch) "
+            "or ShardedHybridRows under a mesh")
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
     norm_obj, intercept_index = norm, -1
@@ -597,12 +610,14 @@ def train_glm(
     """
     d = _matrix_dim(batch.X)
     norm = _active_norm(normalization)
-    permuted = isinstance(batch.X, PermutedHybridRows)
-    if permuted and mesh is not None:
+    permuted = isinstance(batch.X, (PermutedHybridRows,
+                                    ShardedPermutedHybridRows))
+    if isinstance(batch.X, PermutedHybridRows) and mesh is not None:
         raise ValueError(
             "PermutedHybridRows is a single-device representation (its "
-            "bucketed tail cannot be row-sharded); use ShardedHybridRows "
-            "under a mesh")
+            "bucketed tail cannot be row-sharded); use "
+            "ShardedPermutedHybridRows (data.dataset.shard_permuted_batch) "
+            "or ShardedHybridRows under a mesh")
     prior_full_precision = None
     if prior is not None:
         if prior_mean is not None or prior_precision is not None:
@@ -649,8 +664,8 @@ def train_glm(
             batch.X, w0, prior_mean, prior_precision, norm)
         intercept_index = batch.X.last_col_pos
         use_fused = False
-    sharded_hybrid = mesh is not None and isinstance(batch.X,
-                                                     ShardedHybridRows)
+    sharded_hybrid = mesh is not None and isinstance(
+        batch.X, (ShardedHybridRows, ShardedPermutedHybridRows))
     axis_name = None
     if sharded_hybrid:
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)

@@ -176,6 +176,73 @@ def test_sharded_hybrid_solve_collectives(rng, mesh8):
         assert bad not in hlo, f"unexpected collective {bad} in hybrid solve"
 
 
+def test_sharded_permuted_solve_collectives_and_no_scatter(rng, mesh8):
+    """The ShardedPermutedHybridRows shard_map solve — the multi-chip form
+    of the scatter-free layout — compiles to exactly ONE all-reduce, NO
+    other collectives, and ZERO scatter ops: the round-5 measured wall
+    (TPU scatter-adds at ~12 ns/element vs ~7 ns/gather-index,
+    docs/PERF.md) is eliminated by construction on the mesh path too,
+    where ShardedHybridRows still pays a per-shard tail segment_sum. The
+    pin covers both one value_and_grad and the FULL lane-grid solver
+    program (L-BFGS state updates are dynamic-update-slices, not
+    scatters)."""
+    from photon_tpu.data.dataset import shard_permuted_batch
+    from photon_tpu.models.training import (_hybrid_specs,
+                                            _train_run_grid_lanes,
+                                            _train_run_sharded_grid_lanes,
+                                            lane_weight_arrays,
+                                            make_objective)
+    from photon_tpu.optim.config import OptimizerConfig as OC
+
+    n, d, k = 512, 300, 6
+    cols = (rng.zipf(1.5, size=(n, k)).astype(np.int64) - 1) % d
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    from photon_tpu.data.matrix import SparseRows
+
+    X = SparseRows(jnp.asarray(cols.astype(np.int32)), jnp.asarray(vals), d)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = shard_permuted_batch(make_batch(X, y), 8, d_dense=16)
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=0.5,
+                    axis_name="data")
+
+    @jax.jit
+    def vg(batch, w):
+        def body(b, w):
+            return obj.value_and_grad(w, b._replace(X=b.X.local()))
+
+        return shard_map(
+            body, mesh=mesh8,
+            in_specs=(_hybrid_specs(batch.X, ("data",)), P()),
+            out_specs=(P(), P()))(batch, w)
+
+    placed = jax.device_put(batch, _hybrid_specs(
+        batch.X, ("data",), wrap=lambda s: NamedSharding(mesh8, s)))
+    w_r = jax.device_put(jnp.zeros(d), NamedSharding(mesh8, P()))
+    hlo = vg.lower(placed, w_r).compile().as_text()
+    n_ar = sum(1 for line in hlo.splitlines()
+               if "= " in line and "all-reduce(" in line)
+    assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
+    for bad in ("all-to-all(", "collective-permute(", "all-gather(",
+                "scatter("):
+        assert bad not in hlo, f"unexpected {bad} in sharded permuted solve"
+
+    # The whole lane-grid solver program: still scatter-free end to end.
+    cfg = OC(max_iters=10, tolerance=1e-7, reg=reg.l2(), reg_weight=0.0,
+             history=5)
+    l2s, l1s, static_cfg = lane_weight_arrays(cfg, [0.1, 1.0])
+    obj_g = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
+                           axis_name="data",
+                           intercept_index=batch.X.last_col_pos)
+    w0 = jax.device_put(jnp.zeros(d), NamedSharding(mesh8, P()))
+    lowered = _train_run_sharded_grid_lanes.lower(
+        placed, w0, jax.device_put(obj_g, NamedSharding(mesh8, P())),
+        jax.device_put(l2s, NamedSharding(mesh8, P())), None, static_cfg,
+        mesh8)
+    hlo_g = lowered.compile().as_text()
+    assert "scatter(" not in hlo_g, \
+        "scatter op in the sharded permuted lane-grid program"
+
+
 def test_sharded_hybrid_on_hybrid_mesh(rng, hybrid_mesh):
     """ShardedHybridRows solves on a 2-D (replica × data) mesh: tails shard
     over BOTH axes, psums lower hierarchically, results match single-device."""

@@ -287,3 +287,147 @@ def test_perm_mesh_rejected(rng, mesh8):
     with pytest.raises(ValueError, match="single-device"):
         train_glm(make_batch(P, y), TaskType.LINEAR_REGRESSION, cfg,
                   mesh=mesh8)
+
+
+class TestShardedPermuted:
+    """ShardedPermutedHybridRows (the mesh form of the scatter-free
+    layout): op + solve parity vs the single-device permuted build, with
+    user-facing vectors in original column order."""
+
+    def _problem(self, rng, n=640, d=500, k=9):
+        col = (rng.zipf(1.5, size=(n, k - 1)).astype(np.int64) - 1) % (d - 1)
+        val = rng.normal(size=(n, k - 1)).astype(np.float32)
+        order = np.argsort(col, axis=1, kind="stable")
+        sorted_col = np.take_along_axis(col, order, axis=1)
+        dup = sorted_col[:, 1:] == sorted_col[:, :-1]
+        dupmask = np.zeros_like(col, bool)
+        np.put_along_axis(dupmask, order[:, 1:], dup, axis=1)
+        val[dupmask] = 0.0
+        ind = np.concatenate([col, np.full((n, 1), d - 1)], axis=1).astype(
+            np.int32)
+        va = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+        X = SparseRows(jnp.asarray(ind), jnp.asarray(va), d)
+        wt = rng.normal(size=d).astype(np.float32) * 0.5
+        z = np.einsum("nk,nk->n", va, wt[ind])
+        y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+        return X, y
+
+    def test_ops_match_single_device_permuted(self, rng):
+        from photon_tpu.data.matrix import shard_permuted_hybrid
+
+        X, _ = self._problem(rng)
+        n, d = X.shape
+        P1 = to_permuted_hybrid(X, 64)
+        SP = shard_permuted_hybrid(X, 8, 64)
+        assert SP.n_shards == 8 and SP.shape == (n, d)
+        w = rng.normal(size=d).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(SP, SP.from_model_space(w))),
+            np.asarray(matvec(P1, P1.from_model_space(w))),
+            rtol=2e-5, atol=1e-5)
+        r = rng.normal(size=n).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(SP.to_model_space(rmatvec(SP, r))),
+            np.asarray(P1.to_model_space(rmatvec(P1, r))),
+            rtol=2e-5, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(SP.to_model_space(sq_rmatvec(SP, r))),
+            np.asarray(P1.to_model_space(sq_rmatvec(P1, r))),
+            rtol=2e-5, atol=1e-4)
+        W = rng.normal(size=(d, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec_lanes(SP, SP.from_model_space(W))),
+            np.asarray(matvec_lanes(P1, P1.from_model_space(W))),
+            rtol=2e-5, atol=1e-4)
+        R = rng.normal(size=(n, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(SP.to_model_space(rmatvec_lanes(SP, R))),
+            np.asarray(P1.to_model_space(rmatvec_lanes(P1, R))),
+            rtol=2e-5, atol=1e-4)
+
+    def test_local_view_composes_to_global(self, rng):
+        """Slicing shard s's leaves + local() must equal the global op on
+        that shard's row range — the shard_map contract, checked without a
+        mesh."""
+        from photon_tpu.data.matrix import shard_permuted_hybrid
+
+        X, _ = self._problem(rng)
+        n, d = X.shape
+        SP = shard_permuted_hybrid(X, 4, 64)
+        n_local = SP.n_local
+        w = rng.normal(size=d).astype(np.float32)
+        wp = SP.from_model_space(w)
+        full = np.asarray(matvec(SP, wp))
+        grads = []
+        for s in range(SP.n_shards):
+            sliced = dataclasses.replace(
+                SP,
+                dense=SP.dense[s * n_local:(s + 1) * n_local],
+                tail_pcols=SP.tail_pcols[s:s + 1],
+                tail_vals=SP.tail_vals[s:s + 1],
+                row_bounds=SP.row_bounds[s:s + 1],
+                bucket_rows=tuple(b[s:s + 1] for b in SP.bucket_rows),
+                bucket_vals=tuple(b[s:s + 1] for b in SP.bucket_vals))
+            loc = sliced.local()
+            np.testing.assert_allclose(
+                np.asarray(matvec(loc, wp)),
+                full[s * n_local:(s + 1) * n_local], rtol=2e-5, atol=1e-5)
+            r = rng.normal(size=n_local).astype(np.float32)
+            grads.append((loc, r))
+        # per-shard rmatvec partials sum to the global rmatvec
+        r_full = np.concatenate([np.asarray(r) for _, r in grads])
+        total = sum(np.asarray(rmatvec(loc, jnp.asarray(r)))
+                    for loc, r in grads)
+        np.testing.assert_allclose(
+            total, np.asarray(rmatvec(SP, jnp.asarray(r_full))),
+            rtol=2e-5, atol=1e-4)
+
+    def test_train_glm_mesh_matches_single_device(self, rng, mesh8):
+        from photon_tpu.data.dataset import shard_permuted_batch
+
+        X, y = self._problem(rng)
+        sb = shard_permuted_batch(make_batch(X, y), mesh8.devices.size, 64)
+        cfg = OptimizerConfig(max_iters=60, tolerance=1e-7, reg=l2(),
+                              reg_weight=1.0)
+        m_s, r_s = train_glm(sb, TaskType.LOGISTIC_REGRESSION, cfg,
+                             mesh=mesh8)
+        m_1, r_1 = train_glm(make_batch(to_permuted_hybrid(X, 64), y),
+                             TaskType.LOGISTIC_REGRESSION, cfg)
+        np.testing.assert_allclose(float(r_s.value), float(r_1.value),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(m_s.coefficients.means),
+                                   np.asarray(m_1.coefficients.means),
+                                   atol=2e-3)
+
+    def test_train_glm_grid_lanes_mesh(self, rng, mesh8):
+        from photon_tpu.data.dataset import shard_permuted_batch
+
+        X, y = self._problem(rng)
+        sb = shard_permuted_batch(make_batch(X, y), mesh8.devices.size, 64)
+        cfg = OptimizerConfig(max_iters=60, tolerance=1e-7, reg=l2(),
+                              reg_weight=0.0, history=5)
+        weights = [1e-1, 1.0, 10.0]
+        grid = train_glm_grid(sb, TaskType.LOGISTIC_REGRESSION, cfg,
+                              weights, mesh=mesh8)
+        ref = train_glm_grid(make_batch(to_permuted_hybrid(X, 64), y),
+                             TaskType.LOGISTIC_REGRESSION, cfg, weights)
+        for (ms, rs), (m1, r1) in zip(grid, ref):
+            np.testing.assert_allclose(float(rs.value), float(r1.value),
+                                       rtol=1e-4)
+            np.testing.assert_allclose(np.asarray(ms.coefficients.means),
+                                       np.asarray(m1.coefficients.means),
+                                       atol=2e-2)
+
+    def test_cast_features_bf16(self, rng):
+        from photon_tpu.data.matrix import shard_permuted_hybrid
+
+        X, y = self._problem(rng)
+        SP = shard_permuted_hybrid(X, 4, 64)
+        b = cast_features(make_batch(SP, y))
+        assert b.X.dense.dtype == jnp.bfloat16
+        assert all(v.dtype == jnp.bfloat16 for v in b.X.bucket_vals)
+        w = rng.normal(size=X.n_features).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(b.X, b.X.from_model_space(w))),
+            np.asarray(matvec(SP, SP.from_model_space(w))),
+            rtol=2e-2, atol=2e-2)
