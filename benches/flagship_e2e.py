@@ -12,6 +12,15 @@ evaluator — and reports the per-phase timings PERF.md records.
 Run: python benches/flagship_e2e.py [--rows 10000000] [--runs 2]
 Data files cache under --data-dir and are reused across runs (the second
 process run measures the persistent-compilation-cache story end to end).
+
+Round 6 — the 100M-row regime (BASELINE config 4's actual number):
+`--rows 100000000` exceeds the per-chip HBM budget (est. ~17.6 GB
+device-resident vs the 16 GiB default of --hbm-budget-gb), so the driver
+auto-trips into STREAMED-OBJECTIVE mode: the fixed shard stays on host in
+chunks and every fixed-effect L-BFGS iteration accumulates value+gradient
+over streamed device chunks (the literal treeAggregate analog,
+optim/streamed.py); random-effect shards and scalars stay resident. Peak
+HBM is O(chunk + RE data + solver state), not O(dataset).
 """
 from __future__ import annotations
 
@@ -40,6 +49,12 @@ def main() -> None:
                    help="driver invocations (2nd is jit-warm in-process)")
     p.add_argument("--fixed-only", action="store_true",
                    help="also fit the fixed effect alone for the AUC gap")
+    p.add_argument("--hbm-budget-gb", type=float, default=16.0,
+                   help="per-chip HBM budget for the streamed-objective "
+                        "auto-trip (16 = v5e; --rows 100000000 exceeds it "
+                        "and engages the out-of-HBM path)")
+    p.add_argument("--objective-chunk-rows", type=int, default=1 << 20,
+                   help="host chunk height for streamed-objective shards")
     args = p.parse_args()
 
     import _flagship_data as fd
@@ -72,6 +87,11 @@ def main() -> None:
             entity_fields=["userId", "itemId"],
             n_sweeps=args.sweeps,
             streaming=None,  # tri-state auto: 10M rows must trip it
+            # tri-state auto: 100M rows exceed the budget and must trip
+            # the out-of-HBM streamed objective; 10M stays resident
+            streamed_objective=None,
+            hbm_budget_bytes=int(args.hbm_budget_gb * 2**30),
+            objective_chunk_rows=args.objective_chunk_rows,
             evaluators=["AUC"],
             # one cache across every run/tag (per-run output dirs would
             # each get a fresh default cache and defeat the 2nd-run story)

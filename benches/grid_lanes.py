@@ -47,15 +47,17 @@ def main() -> None:
         # rule), so this combination would silently measure the OWL-QN
         # solver under a TRON label.
         p.error("--opt tron requires --reg l2 (L1 sweeps always run OWL-QN)")
-    if (args.opt == "tron" and args.leg == "sparse"
-            and (args.rows or 1 << 21) > 1 << 20):
-        # docs/PERF.md: the TRON lane program at the 2M-row shape
-        # reproducibly crashes the remote-compile service; 1M compiles
-        # and runs. Refuse the documented-fatal default instead of
-        # taking the shared compiler down.
-        p.error("--opt tron on the sparse leg needs --rows <= 1048576 "
-                "(the 2M-row TRON lane program kills the remote compile "
-                "service; docs/PERF.md)")
+    if args.opt == "tron" and args.leg == "sparse":
+        import bench  # the guard must track the sparse leg's REAL default
+
+        if (args.rows or bench.S_ROWS) > 1 << 20:
+            # docs/PERF.md: the TRON lane program at the 2M-row shape
+            # reproducibly crashes the remote-compile service; 1M compiles
+            # and runs. Refuse the documented-fatal default instead of
+            # taking the shared compiler down.
+            p.error("--opt tron on the sparse leg needs --rows <= 1048576 "
+                    "(the 2M-row TRON lane program kills the remote "
+                    "compile service; docs/PERF.md)")
 
     import jax
     import jax.numpy as jnp

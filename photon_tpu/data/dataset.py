@@ -7,7 +7,8 @@ shaped. Padding rows carry weight 0 so all reductions ignore them.
 """
 from __future__ import annotations
 
-from typing import NamedTuple
+import dataclasses
+from typing import Iterator, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -175,3 +176,194 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
 
 def total_weight(batch: GLMBatch) -> float:
     return float(np.sum(np.asarray(batch.weights)))
+
+
+# --------------------------------------------------------------------------
+# Host-resident chunked datasets (the out-of-HBM streamed-objective regime).
+#
+# Reference parity: the dataset in a DistributedGLMLossFunction solve never
+# lives in one executor's memory — Spark partitions stream through each
+# treeAggregate. Here the dataset lives on HOST in uniform row chunks and
+# streams through the device chunk by chunk: HBM only ever holds one or two
+# chunks plus solver state, so a single chip trains datasets far bigger than
+# its HBM (BASELINE config 4's 100M-row regime).
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedMatrix:
+    """A design matrix as HOST-resident uniform row chunks.
+
+    `chunks` are numpy dense (c, d) blocks or host-backed SparseRows with a
+    shared nnz width — every chunk the same shape, so the per-chunk device
+    programs compile exactly once. The LAST chunk is padded with all-zero
+    rows up to the chunk height (`n_real` marks where real rows end; the
+    owning ChunkedBatch gives pad rows weight 0, so every reduction ignores
+    them). Hybrid/permuted layouts are deliberately unsupported: their value
+    is device-side locality, and a host-chunked solve re-uploads every pass
+    anyway — SparseRows/dense are the streaming-native forms.
+    """
+
+    chunks: tuple  # host numpy (c, d) blocks or host SparseRows, uniform
+    n_real: int  # real rows (pre-padding)
+    n_features: int
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def chunk_rows(self) -> int:
+        c = self.chunks[0]
+        return int((c.indices if isinstance(c, SparseRows) else c).shape[0])
+
+    @property
+    def n_padded(self) -> int:
+        return self.n_chunks * self.chunk_rows
+
+    @property
+    def shape(self) -> tuple:
+        return (self.n_real, self.n_features)
+
+    def nbytes(self) -> int:
+        total = 0
+        for c in self.chunks:
+            if isinstance(c, SparseRows):
+                total += c.indices.nbytes + c.values.nbytes
+            else:
+                total += c.nbytes
+        return total
+
+
+class ChunkedBatch(NamedTuple):
+    """A GLMBatch-shaped dataset living on HOST as uniform chunks.
+
+    Scalars are full (n_padded,) numpy vectors (12 bytes/row — the feature
+    chunks dominate); `chunk(i)` slices out one host GLMBatch, and
+    `iter_device()` streams device-resident chunks with the next transfer
+    overlapping the current chunk's compute. models.training.train_glm
+    dispatches a ChunkedBatch to the streamed solvers automatically.
+    """
+
+    X: ChunkedMatrix
+    y: np.ndarray  # (n_padded,)
+    weights: np.ndarray  # (n_padded,) — 0.0 marks padding
+    offsets: np.ndarray  # (n_padded,)
+
+    @property
+    def n(self) -> int:
+        return self.X.n_real
+
+    @property
+    def n_chunks(self) -> int:
+        return self.X.n_chunks
+
+    @property
+    def chunk_rows(self) -> int:
+        return self.X.chunk_rows
+
+    def chunk(self, i: int) -> GLMBatch:
+        """Host-side GLMBatch of chunk i (numpy leaves)."""
+        c = self.X.chunk_rows
+        sl = slice(i * c, (i + 1) * c)
+        return GLMBatch(self.X.chunks[i], self.y[sl], self.weights[sl],
+                        self.offsets[sl])
+
+    def iter_device(self, device=None) -> Iterator:
+        """Yield (i, device-resident GLMBatch) chunk by chunk, DOUBLE-
+        BUFFERED: chunk i+1's device_put is issued before chunk i is
+        consumed, so its host→device transfer overlaps the caller's compute
+        on chunk i (jax transfers are asynchronous). Peak device footprint
+        is therefore ~2 chunks, never the dataset."""
+        n = self.n_chunks
+        if n == 0:
+            return
+        put = (lambda b: jax.device_put(b, device)) if device is not None \
+            else jax.device_put
+        nxt = put(self.chunk(0))
+        for i in range(n):
+            cur = nxt
+            if i + 1 < n:
+                nxt = put(self.chunk(i + 1))
+            yield i, cur
+
+
+def _host_sparse(X: SparseRows) -> SparseRows:
+    return SparseRows(np.asarray(X.indices), np.asarray(X.values),
+                      X.n_features)
+
+
+def chunk_matrix(X, chunk_rows: int) -> ChunkedMatrix:
+    """Split a dense array or SparseRows into a host ChunkedMatrix (last
+    chunk zero-padded to the uniform height)."""
+    if isinstance(X, (HybridRows, ShardedHybridRows, PermutedHybridRows,
+                      ShardedPermutedHybridRows)):
+        raise TypeError(
+            f"{type(X).__name__} cannot be host-chunked (device-locality "
+            "layout); chunk the SparseRows/dense form instead")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    sparse = isinstance(X, SparseRows)
+    if sparse:
+        X = _host_sparse(X)
+        n, d = X.indices.shape[0], X.n_features
+    else:
+        X = np.asarray(X)
+        n, d = X.shape
+    chunks = []
+    for lo in range(0, max(n, 1), chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        pad = chunk_rows - (hi - lo)
+        if sparse:
+            ind = X.indices[lo:hi]
+            val = X.values[lo:hi]
+            if pad:
+                ind = np.concatenate(
+                    [ind, np.zeros((pad, ind.shape[1]), ind.dtype)])
+                val = np.concatenate(
+                    [val, np.zeros((pad, val.shape[1]), val.dtype)])
+            chunks.append(SparseRows(ind, val, d))
+        else:
+            blk = X[lo:hi]
+            if pad:
+                blk = np.concatenate(
+                    [blk, np.zeros((pad, d), blk.dtype)])
+            chunks.append(blk)
+    return ChunkedMatrix(tuple(chunks), n, d)
+
+
+def make_chunked_batch(X: ChunkedMatrix, y, weights=None,
+                       offsets=None) -> ChunkedBatch:
+    """Assemble a ChunkedBatch from a ChunkedMatrix and (n_real,) scalar
+    columns (device arrays are fetched to host; padding rows get weight 0)."""
+    n, n_pad = X.n_real, X.n_padded
+
+    def col(v, fill):
+        if v is None:
+            return np.full(n_pad, fill, np.float32)
+        v = np.asarray(v, np.float32)
+        if v.shape[0] == n_pad:
+            return v
+        if v.shape[0] != n:
+            raise ValueError(
+                f"scalar column has {v.shape[0]} rows; matrix has {n}")
+        return np.concatenate([v, np.zeros(n_pad - n, np.float32)])
+
+    y = col(y, 0.0)
+    weights = col(weights, 1.0)
+    if n_pad > n:
+        weights = weights.copy()
+        weights[n:] = 0.0  # padding must never enter a reduction
+    return ChunkedBatch(X, y, weights, col(offsets, 0.0))
+
+
+def chunk_batch(batch: GLMBatch, chunk_rows: int) -> ChunkedBatch:
+    """Re-lay a (host or device) GLMBatch as a host-resident ChunkedBatch —
+    the test/bench seam for streamed-vs-resident parity."""
+    X = batch.X
+    if isinstance(X, SparseRows):
+        X = _host_sparse(X)
+    else:
+        X = np.asarray(X)
+    return make_chunked_batch(
+        chunk_matrix(X, chunk_rows), np.asarray(batch.y),
+        np.asarray(batch.weights), np.asarray(batch.offsets))

@@ -111,7 +111,10 @@ class IndexMap:
         with p.open("w", encoding="utf-8") as f:
             f.write(f"#photon_tpu-indexmap\t{len(self)}\t{int(self.has_intercept)}\n")
             for k, v in sorted(self.key_to_id.items(), key=lambda kv: kv[1]):
-                f.write(f"{k.replace(DELIMITER, '\\x01')}\t{v}\n")
+                # hoisted out of the f-string: a backslash inside the
+                # expression part is a SyntaxError before Python 3.12
+                escaped = k.replace(DELIMITER, "\\x01")
+                f.write(f"{escaped}\t{v}\n")
 
     @staticmethod
     def load(path) -> "IndexMap":

@@ -461,6 +461,161 @@ def _native_chunks(path, stream: ChunkStream):
     return generator()
 
 
+def stream_to_host(
+    path,
+    config: GameDataConfig,
+    index_maps: dict,
+    chunked_shards=(),
+    chunk_rows: int = 65536,
+    objective_chunk_rows: int = 1 << 20,
+    sparse_k: Optional[int] = None,
+    use_native: Optional[bool] = None,
+    feature_dtype=None,
+    chunk_hook=None,
+    n_rows: Optional[int] = None,
+) -> tuple[GameData, int]:
+    """Stream a dataset into HOST-RESIDENT form for the out-of-HBM
+    streamed-objective solve (drivers.train auto-trips here when the
+    device-resident estimate exceeds the HBM budget).
+
+    Shards named in `chunked_shards` are assembled as
+    data.dataset.ChunkedMatrix — uniform `objective_chunk_rows`-row host
+    chunks the streamed solvers re-upload pass by pass, so HBM holds
+    O(chunk + solver state) instead of O(dataset). Every other shard and
+    the scalar columns assemble as full host numpy (the GAME layer
+    device-puts what it needs — random-effect buckets must be resident).
+
+    Chunk hooks / sparse-k / native-decoder semantics match
+    stream_to_device; `feature_dtype` casts feature values of EVERY shard
+    (chunked ones at buffer fill, resident ones at concat). Host memory
+    holds the whole dataset — this mode trades host RAM (cheap, big) for
+    HBM (scarce), exactly as the reference trades executor memory for
+    HDFS-backed partitions.
+
+    Returns (GameData, n_real); GameData.n == n_real (only the
+    ChunkedMatrix pads internally, weight-0-masked by the solve batches).
+    """
+    from photon_tpu.data.dataset import ChunkedMatrix
+    from photon_tpu.data.matrix import SparseRows
+
+    index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
+    chunked_shards = set(chunked_shards)
+    unknown = chunked_shards - set(config.shards)
+    if unknown:
+        raise ValueError(f"chunked_shards not in config: {sorted(unknown)}")
+    n_real = sum(scan_row_counts(path)) if n_rows is None else int(n_rows)
+    c_rows = max(int(objective_chunk_rows), 1)
+
+    dense_shards = {s: index_maps[s].n_features <= cfg.dense_threshold
+                    for s, cfg in config.shards.items()}
+    f_dtype = np.float32 if feature_dtype is None else feature_dtype
+    for s in chunked_shards:
+        if not dense_shards[s] and sparse_k is None:
+            raise ValueError(
+                f"chunked shard {s!r} is sparse: pass a fixed sparse_k so "
+                "every chunk shares one nnz width")
+
+    def alloc(s):
+        d = index_maps[s].n_features
+        if dense_shards[s]:
+            return np.zeros((c_rows, d), f_dtype)
+        return (np.zeros((c_rows, sparse_k), np.int32),
+                np.zeros((c_rows, sparse_k), f_dtype))
+
+    bufs = {s: alloc(s) for s in chunked_shards}
+    done_chunks: dict = {s: [] for s in chunked_shards}
+    filled = 0  # rows filled in the current uniform chunk buffers
+
+    scal_parts: dict = {k: [] for k in ("y", "weights", "offsets")}
+    res_parts: dict = {s: [] for s in config.shards if s not in chunked_shards}
+    entity_cols: dict = {e: [] for e in config.entity_fields}
+
+    def flush():
+        nonlocal bufs, filled
+        for s in chunked_shards:
+            done_chunks[s].append(bufs[s])
+        bufs = {s: alloc(s) for s in chunked_shards}
+        filled = 0
+
+    stream, chunks = iter_game_chunks(path, config, index_maps,
+                                      chunk_rows=chunk_rows,
+                                      sparse_k=sparse_k,
+                                      use_native=use_native)
+    row = 0
+    for chunk in chunks:
+        if chunk_hook is not None:
+            chunk_hook(chunk)
+        scal_parts["y"].append(np.asarray(chunk.y))
+        scal_parts["weights"].append(np.asarray(chunk.weights))
+        scal_parts["offsets"].append(np.asarray(chunk.offsets))
+        for e in config.entity_fields:
+            entity_cols[e].append(np.asarray(chunk.entity_ids[e]))
+        for s in res_parts:
+            X = chunk.shards[s]
+            if isinstance(X, SparseRows):
+                res_parts[s].append((np.asarray(X.indices),
+                                     np.asarray(X.values).astype(f_dtype)))
+            else:
+                res_parts[s].append(np.asarray(X).astype(f_dtype))
+        host_mat = {}
+        for s in chunked_shards:
+            X = chunk.shards[s]
+            host_mat[s] = (np.asarray(X) if dense_shards[s]
+                           else (np.asarray(X.indices), np.asarray(X.values)))
+        c0, n_c = 0, chunk.n
+        while c0 < n_c:
+            take = min(n_c - c0, c_rows - filled)
+            sl = slice(c0, c0 + take)
+            dst = slice(filled, filled + take)
+            for s in chunked_shards:
+                if dense_shards[s]:
+                    bufs[s][dst] = host_mat[s][sl].astype(f_dtype)
+                else:
+                    ind, val = bufs[s]
+                    h_ind, h_val = host_mat[s]
+                    k_c = h_ind.shape[1]
+                    ind[dst, :k_c] = h_ind[sl]
+                    val[dst, :k_c] = h_val[sl].astype(f_dtype)
+            filled += take
+            c0 += take
+            row += take
+            if filled == c_rows:
+                flush()
+    if filled or (chunked_shards and not done_chunks[next(iter(
+            chunked_shards))]):
+        flush()  # partial tail chunk (pad rows are all-zero → weight 0)
+
+    def concat(parts, width=None, dtype=np.float32):
+        if parts:
+            return np.concatenate(parts)
+        shape = (0,) if width is None else (0, width)
+        return np.zeros(shape, dtype)
+
+    shards: dict = {}
+    for s in config.shards:
+        d = index_maps[s].n_features
+        if s in chunked_shards:
+            cs = tuple(c if dense_shards[s] else SparseRows(c[0], c[1], d)
+                       for c in done_chunks[s])
+            shards[s] = ChunkedMatrix(cs, n_real, d)
+        elif dense_shards[s]:
+            shards[s] = concat(res_parts[s], width=d, dtype=f_dtype)
+        else:
+            k = sparse_k if sparse_k is not None else 1
+            ind = concat([p[0] for p in res_parts[s]], width=k,
+                         dtype=np.int32)
+            val = concat([p[1] for p in res_parts[s]], width=k,
+                         dtype=f_dtype)
+            shards[s] = SparseRows(ind, val, d)
+
+    ids = {e: (np.concatenate([np.asarray(c, dtype=np.str_) for c in cols])
+               if cols else np.zeros(0, dtype="U1"))
+           for e, cols in entity_cols.items()}
+    data = GameData(concat(scal_parts["y"]), concat(scal_parts["weights"]),
+                    concat(scal_parts["offsets"]), shards, ids)
+    return data, n_real
+
+
 def stream_to_device(
     path,
     config: GameDataConfig,

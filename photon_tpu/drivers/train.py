@@ -128,6 +128,26 @@ class TrainingParams:
     streaming: Optional[bool] = None
     streaming_threshold_rows: int = 2_000_000
     streaming_chunk_rows: int = 65536
+    # Streamed-objective (out-of-HBM) mode: the dataset lives on HOST and
+    # every solver evaluation accumulates over streamed device chunks (the
+    # literal treeAggregate analog — optim/streamed.py), so one chip trains
+    # datasets bigger than its HBM (BASELINE config 4's 100M-row regime).
+    # Tri-state: None auto-trips when the device-resident estimate of the
+    # dataset exceeds `hbm_budget_bytes` (single-chip runs only — a mesh
+    # pools HBM and keeps the resident path); True forces it; False never
+    # streams the objective. Only shards used EXCLUSIVELY by fixed-effect
+    # coordinates are host-chunked (random-effect bucketing needs resident
+    # rows); scalars and RE shards stay device-resident, so peak HBM is
+    # O(chunk + RE data + solver state) instead of O(dataset).
+    streamed_objective: Optional[bool] = None
+    # Per-chip HBM budget for the auto-trip. None detects the device's
+    # reported limit and falls back to 16 GiB (v5e).
+    hbm_budget_bytes: Optional[int] = None
+    # Rows per host chunk of a streamed-objective shard. Bigger chunks
+    # amortize per-chunk dispatch and keep transfers long (good for PCIe);
+    # smaller chunks shrink the device footprint. 1M rows ≈ 130 MB for a
+    # 32-feature f32 shard — docs/PERF.md discusses the tradeoff.
+    objective_chunk_rows: int = 1 << 20
     # Storage dtype for streamed feature values (e.g. "bfloat16" halves the
     # HBM footprint of big shards; compute stays f32). None keeps float32.
     streaming_feature_dtype: Optional[str] = None
@@ -312,15 +332,51 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             n_train_rows = sum(scan_row_counts(params.train_path))
             streaming = n_train_rows > params.streaming_threshold_rows
         stream_stats: dict = {}
-        if streaming:
+        streamed_obj = False
+        # The streamed-objective check rides the streaming machinery; an
+        # EXPLICIT hbm_budget_bytes opts into the check even below the
+        # row-count streaming threshold (the auto default only matters at
+        # scales where streaming is already on).
+        frozen_maps = None
+        if (streaming or params.streamed_objective
+                or (params.streamed_objective is None
+                    and params.hbm_budget_bytes is not None)):
+            from photon_tpu.data.streaming import (
+                build_index_maps_streaming,
+                scan_row_counts,
+            )
+
+            # Frozen maps built ONCE, shared by the HBM estimate and
+            # whichever streaming reader runs (both accept them prebuilt).
+            frozen_maps = build_index_maps_streaming(
+                params.train_path, data_cfg, prebuilt_maps)
+            if n_train_rows is None:
+                n_train_rows = sum(scan_row_counts(params.train_path))
+            streamed_obj = _resolve_streamed_objective(
+                params, frozen_maps, n_train_rows, mesh, log)
+        if streamed_obj:
+            index_maps = frozen_maps
+            chunked = _streamable_shards(params)
+            data, validation, stream_stats, n_real = \
+                _read_streamed_objective(
+                    params, data_cfg, task, mode, index_maps,
+                    n_train_rows, chunked)
+            log.info(
+                "streamed objective engaged: %d rows; host-chunked "
+                "shards %s (%d-row chunks), resident shards %s",
+                n_real, sorted(chunked), params.objective_chunk_rows,
+                sorted(set(params.feature_shards) - chunked))
+        elif streaming:
             data, validation, index_maps, stream_stats, n_real = \
-                _read_streaming(params, data_cfg, task, mode, prebuilt_maps,
-                                mesh, n_train_rows)
+                _read_streaming(params, data_cfg, task, mode,
+                                frozen_maps, mesh, n_train_rows)
             log.info("streamed %d training rows (%d with padding), "
                      "%d shards", n_real, data.n, len(data.shards))
         else:
             data, index_maps = read_game_data(
-                params.train_path, data_cfg, index_maps=prebuilt_maps,
+                params.train_path, data_cfg,
+                index_maps=(frozen_maps if frozen_maps is not None
+                            else prebuilt_maps),
                 sparse_k=params.sparse_k)
             validation = None
             if params.validation_path:
@@ -332,7 +388,8 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
     with timers("validate"):
         # streaming already validated every chunk inside the read pass
-        if not streaming:
+        # (both the device-resident and the streamed-objective form)
+        if not streaming and not streamed_obj:
             validate_game_data(data, task, mode)
             if validation is not None:
                 validate_game_data(validation, task, mode)
@@ -387,7 +444,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
 
     if params.down_sampling_rate is not None:
         with timers("down_sample"):
-            if streaming:
+            if streaming or streamed_obj:
                 # device-resident data: dropped rows become weight-0 rows
                 # (identical weighted objective; rows are not re-indexed,
                 # and RandomEffectDataset never lets a weight-0 row into a
@@ -462,7 +519,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         elif params.resume:
             results, n_resumed = _fit_grid_resumable(
                 estimator, params, data, validation, initial_models,
-                index_maps, log, streaming)
+                index_maps, log, streaming, streamed_obj)
         else:
             results = estimator.fit(
                 data, validation=validation,
@@ -501,7 +558,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         if params.output_mode.upper() == "ALL":
             models_dir = os.path.join(params.output_dir, "models")
             os.makedirs(models_dir, exist_ok=True)
-            gsig = _global_signature(params, streaming)
+            gsig = _global_signature(params, streaming, streamed_obj)
             manifest = []
             sigs = _point_signatures(gsig, [r.configs for r in results])
             # Skip rewriting only points the CURRENT resume run persisted or
@@ -603,7 +660,146 @@ def _read_streaming(params: TrainingParams, data_cfg: GameDataConfig,
     return data, validation, index_maps, stats, n_real
 
 
-def _global_signature(params: TrainingParams, streaming: bool) -> str:
+def _streamable_shards(params: TrainingParams) -> set:
+    """Shards eligible for host-chunking: used by fixed-effect coordinates
+    ONLY (random-effect bucketing gathers rows, so its shards must stay
+    resident; shards no coordinate uses stay resident too — they cost
+    nothing on device because nothing device-puts them)."""
+    fixed = {s.feature_shard for s in params.coordinates.values()
+             if s.entity_name is None}
+    re = {s.feature_shard for s in params.coordinates.values()
+          if s.entity_name is not None}
+    return fixed - re
+
+
+def _detect_hbm_budget() -> int:
+    """Per-chip HBM budget: the device's reported bytes_limit when the
+    backend exposes one, else 16 GiB (a v5e chip)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return 16 << 30
+
+
+def _estimate_device_bytes(n_rows: int, index_maps: dict,
+                           params: TrainingParams) -> int:
+    """Device-resident footprint estimate of the dataset from the frozen
+    maps + header row count alone (no data read): scalars at 12 B/row,
+    dense shards at d×value bytes, sparse shards at k×(index+value)."""
+    val_bytes = 2 if params.streaming_feature_dtype in ("bfloat16",
+                                                        "float16") else 4
+    total = 12 * n_rows
+    for s, cfg in params.feature_shards.items():
+        d = index_maps[s].n_features
+        if d <= cfg.dense_threshold:
+            total += n_rows * d * val_bytes
+        elif params.sparse_k is not None:
+            total += n_rows * params.sparse_k * (4 + val_bytes)
+    return int(total)
+
+
+def _resolve_streamed_objective(params: TrainingParams, index_maps: dict,
+                                n_rows: int, mesh, log) -> bool:
+    """The streamed-objective tri-state, resolved: forced True/False wins;
+    None auto-trips on a single chip when the device-resident estimate
+    exceeds the HBM budget — the same shape as the header-count streaming
+    auto-trip, one level up the memory hierarchy."""
+    forced = params.streamed_objective
+    if forced is False:
+        return False
+    if forced and mesh is not None:
+        raise ValueError(
+            "streamed_objective=True is single-chip only (a mesh pools HBM "
+            "and keeps the resident sharded path); drop the mesh or the "
+            "flag")
+    if forced:
+        if not _streamable_shards(params):
+            raise ValueError(
+                "streamed_objective=True needs at least one shard used "
+                "exclusively by fixed-effect coordinates (random-effect "
+                "shards must stay resident for entity bucketing)")
+        return True
+    if mesh is not None:
+        return False
+    est = _estimate_device_bytes(n_rows, index_maps, params)
+    budget = (params.hbm_budget_bytes if params.hbm_budget_bytes
+              else _detect_hbm_budget())
+    if est <= budget:
+        return False
+    chunked = _streamable_shards(params)
+    if not chunked:
+        log.warning(
+            "dataset estimate %.2f GiB exceeds HBM budget %.2f GiB but no "
+            "shard is fixed-effect-only; falling back to device-resident "
+            "streaming (expect OOM at this scale)",
+            est / 2**30, budget / 2**30)
+        return False
+    log.info(
+        "auto-tripping streamed objective: dataset estimate %.2f GiB > "
+        "HBM budget %.2f GiB", est / 2**30, budget / 2**30)
+    return True
+
+
+def _read_streamed_objective(params: TrainingParams,
+                             data_cfg: GameDataConfig, task: TaskType,
+                             mode: DataValidationType, index_maps: dict,
+                             n_train_rows: int, chunked_shards: set):
+    """The out-of-HBM read: training data lands HOST-resident — the
+    fixed-effect shards as uniform ChunkedMatrix chunks the streamed
+    solvers re-upload pass by pass, everything else as full host numpy the
+    GAME layer device-puts as needed. Per-chunk validation and mergeable
+    statistics ride the same pass, exactly as in _read_streaming.
+    Validation data stays device-resident (it is scored, not solved, and
+    is assumed to fit — stream_to_device's own bounded path)."""
+    import jax.numpy as jnp
+
+    from photon_tpu.data.statistics import FeatureSummary
+    from photon_tpu.data.streaming import stream_to_device, stream_to_host
+
+    need_stats = set()
+    if params.summarization_output_dir is not None:
+        need_stats |= set(params.feature_shards)
+    if NormalizationType(params.normalization) is not NormalizationType.NONE:
+        need_stats |= {s.feature_shard for s in params.coordinates.values()}
+
+    stats: dict = {}
+
+    def make_hook(collect_stats: bool):
+        def hook(chunk):
+            validate_game_data(chunk, task, mode)
+            if collect_stats:
+                for s in need_stats:
+                    cs = FeatureSummary.compute_host(chunk.shards[s])
+                    stats[s] = cs if s not in stats else stats[s].merge(cs)
+        return hook
+
+    f_dtype = (None if params.streaming_feature_dtype is None
+               else getattr(jnp, params.streaming_feature_dtype))
+    data, n_real = stream_to_host(
+        params.train_path, data_cfg, index_maps,
+        chunked_shards=chunked_shards,
+        chunk_rows=params.streaming_chunk_rows,
+        objective_chunk_rows=params.objective_chunk_rows,
+        sparse_k=params.sparse_k, feature_dtype=f_dtype,
+        chunk_hook=make_hook(bool(need_stats)), n_rows=n_train_rows)
+    validation = None
+    if params.validation_path:
+        validation, _ = stream_to_device(
+            params.validation_path, data_cfg, index_maps, mesh=None,
+            chunk_rows=params.streaming_chunk_rows,
+            sparse_k=params.sparse_k, feature_dtype=f_dtype,
+            chunk_hook=make_hook(False))
+    return data, validation, stats, n_real
+
+
+def _global_signature(params: TrainingParams, streaming: bool,
+                      streamed_obj: bool = False) -> str:
     """Every training-wide knob that changes what a grid point's model
     means: data, sweeps, normalization, sampling, warm-start mode, …
     Baked into each point's signature so resume can never hand back a
@@ -631,8 +827,12 @@ def _global_signature(params: TrainingParams, streaming: bool) -> str:
         # streaming knobs that change the trained model: the storage dtype
         # casts features, and down-sampling switches to its weight-0 form.
         # `streaming` is the RESOLVED mode (the same train_path resolves
-        # the same way every run, so resume stays stable).
+        # the same way every run, so resume stays stable). The RESOLVED
+        # streamed-objective mode rides along: chunked f32 accumulation
+        # reorders sums, so a resumed point must have trained in the same
+        # regime.
         bool(streaming), params.streaming_feature_dtype,
+        bool(streamed_obj),
     ))
 
 
@@ -700,7 +900,8 @@ def _write_manifest(path: str, rows: list) -> None:
 
 def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
                         data, validation, initial_models, index_maps, log,
-                        streaming: bool = False):
+                        streaming: bool = False,
+                        streamed_obj: bool = False):
     """Fit the grid one point at a time, CHECKPOINTING each point the
     moment it finishes, and loading points a previous (possibly died) run
     already completed. Warm starts chain through loaded models exactly as
@@ -729,7 +930,7 @@ def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,
         {n: s.coordinate_config() for n, s in params.coordinates.items()}
     ]
     base = {n: s.coordinate_config() for n, s in params.coordinates.items()}
-    gsig = _global_signature(params, streaming)
+    gsig = _global_signature(params, streaming, streamed_obj)
     sigs = _point_signatures(gsig, [{**base, **ov} for ov in grid])
     if (not any(s in completed for s in sigs)
             and estimator.would_vectorize(grid, initial_models, data=data)):

@@ -77,6 +77,7 @@ def _fused_fixed_update(batch, base, scores, w0, obj, l1, y, weights,
 
 
 def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
+    from photon_tpu.data.dataset import ChunkedMatrix
     from photon_tpu.data.matrix import PermutedHybridRows, ShardedHybridRows
     from photon_tpu.optim.config import OptimizerType
 
@@ -84,9 +85,12 @@ def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
     # permuted↔original coefficient-space translation — this fused program
     # calling solve() directly would store PERMUTED coefficients in the
     # model and scoring would re-permute them (silently wrong margins).
+    # ChunkedMatrix keeps it too: the streamed solve is a host loop, not a
+    # jittable solve() call.
     return (prior is None and coord.mesh is None
             and not isinstance(coord.dataset.X,
-                               (ShardedHybridRows, PermutedHybridRows))
+                               (ShardedHybridRows, PermutedHybridRows,
+                                ChunkedMatrix))
             and (coord.normalization is None
                  or coord.normalization.is_identity)
             # OWL-QN keeps the train_glm route: its single-device dense

@@ -28,7 +28,8 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.data.dataset import (ChunkedMatrix, GLMBatch,
+                                     make_chunked_batch)
 from photon_tpu.data.matrix import (HybridRows, Matrix,
                                     PermutedHybridRows, SparseRows)
 
@@ -112,6 +113,12 @@ def _gather_rows(X: Matrix, idx: np.ndarray):
             f"{type(X).__name__} shards are not supported for GAME entity bucketing "
             "(single-device fixed-effect representation); use SparseRows or "
             "dense shards for random-effect coordinates")
+    if isinstance(X, ChunkedMatrix):
+        raise TypeError(
+            "random-effect coordinates need a resident shard (entity "
+            "bucketing gathers rows); the training driver only chunks "
+            "shards used exclusively by fixed effects — keep this shard "
+            "out of the streamed-objective set")
     if isinstance(X, SparseRows):
         ind = np.asarray(X.indices)[idx]
         val = np.asarray(X.values)[idx]
@@ -141,6 +148,13 @@ class FixedEffectDataset:
         import jax
 
         X = data.shards[shard_name]
+        if isinstance(X, ChunkedMatrix):
+            # Streamed-objective regime: the shard stays HOST-resident in
+            # chunks, and so do the scalar columns (batch() below assembles
+            # a ChunkedBatch; train_glm streams it through the device).
+            return FixedEffectDataset(
+                shard_name, X, np.asarray(data.y, np.float32),
+                np.asarray(data.weights, np.float32))
         if not isinstance(X, (SparseRows, HybridRows,
                               PermutedHybridRows)) and not (
                 isinstance(X, jax.Array)
@@ -157,6 +171,12 @@ class FixedEffectDataset:
         )
 
     def batch(self, offsets) -> GLMBatch:
+        if isinstance(self.X, ChunkedMatrix):
+            # One (n,)-sized host fetch per solve when offsets live on
+            # device (other coordinates' scores) — 4 bytes/row against the
+            # feature stream the solve saves from HBM.
+            return make_chunked_batch(self.X, self.y, self.weights,
+                                      np.asarray(offsets, np.float32))
         return GLMBatch(self.X, self.y, self.weights, jnp.asarray(offsets, jnp.float32))
 
 

@@ -231,7 +231,7 @@ class GameEstimator:
 
         chain_warm = self.warm_start
         if self.would_vectorize(grid, initial_models):
-            if self.n_sweeps == 1:
+            if self.n_sweeps == 1 and not self._chunked_shards(data):
                 probe = self._fixed_only_reg_grid(grid)
                 if probe is not None and self._fixed_seq_ok(probe):
                     # single fixed effect, one sweep: the leanest form —
@@ -381,6 +381,15 @@ class GameEstimator:
                 lanes[n].append(float(cfg.optimizer.reg_weight))
         return lanes
 
+    def _chunked_shards(self, data: GameData) -> bool:
+        """True when any coordinate's shard is a host-chunked
+        (streamed-objective) matrix — those solves are host loops, so every
+        vectorized grid path must fall back to the sequential sweep."""
+        from photon_tpu.data.dataset import ChunkedMatrix
+
+        return any(isinstance(data.shards[c.feature_shard], ChunkedMatrix)
+                   for c in self.coordinate_configs.values())
+
     def _grid_data_supported(self, data: GameData) -> bool:
         """Matrix layouts the lane-axis grid can run: dense or SparseRows.
         HybridRows' flat COO tail has no (entity, lane) batched form,
@@ -388,13 +397,17 @@ class GameEstimator:
         PermutedHybridRows' coefficient-space translation lives at the
         train_glm/train_glm_grid boundary the game grid bypasses — all
         three fall back to the sequential path (which routes through
-        train_glm and is correct for every layout)."""
+        train_glm and is correct for every layout). ChunkedMatrix
+        (streamed-objective) shards fall back the same way — the lane grid
+        would multiply the per-pass host→device stream per lane."""
+        from photon_tpu.data.dataset import ChunkedMatrix
         from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
                                             ShardedHybridRows)
 
         for cfg in self.coordinate_configs.values():
             X = data.shards[cfg.feature_shard]
-            if isinstance(X, (ShardedHybridRows, PermutedHybridRows)):
+            if isinstance(X, (ShardedHybridRows, PermutedHybridRows,
+                              ChunkedMatrix)):
                 return False
             if isinstance(X, HybridRows) and (
                     self.mesh is not None

@@ -56,11 +56,20 @@ class GeneralizedLinearModel:
 
     def score(self, X: Matrix, offsets=0.0) -> jax.Array:
         """Raw margin x·w + offset (reference: computeScore)."""
+        from photon_tpu.data.dataset import ChunkedMatrix
+
+        if isinstance(X, ChunkedMatrix):
+            return chunked_margins(X, self.coefficients.means,
+                                   jnp.asarray(offsets, jnp.float32))
         return _margin_jit(X, self.coefficients.means,
                            jnp.asarray(offsets, jnp.float32))
 
     def predict_mean(self, X: Matrix, offsets=0.0) -> jax.Array:
         """Mean response via the inverse link (reference: computeMean)."""
+        from photon_tpu.data.dataset import ChunkedMatrix
+
+        if isinstance(X, ChunkedMatrix):
+            return mean_fn(self.task)(self.score(X, offsets))
         return _mean_jit(self.task, X, self.coefficients.means,
                          jnp.asarray(offsets, jnp.float32))
 
@@ -88,6 +97,29 @@ def _margin_jit(X, w, offsets):
 @partial(jax.jit, static_argnames=("task",))
 def _mean_jit(task, X, w, offsets):
     return mean_fn(task)(_margin_jit(X, w, offsets))
+
+
+@jax.jit
+def _chunk_margin(X, w):
+    return matvec(X, w)
+
+
+def chunked_margins(X, w, offsets=0.0) -> jax.Array:
+    """Margins over a host-resident ChunkedMatrix: stream each chunk through
+    one jitted matvec (uploads overlap compute via jax's async transfers)
+    and concatenate on device — the scoring side of the streamed objective
+    regime. Returns (n_real,) — internal chunk padding is trimmed."""
+    import jax as _jax
+
+    w = jnp.asarray(w, jnp.float32)
+    parts, nxt = [], _jax.device_put(X.chunks[0])
+    for i in range(X.n_chunks):
+        cur = nxt
+        if i + 1 < X.n_chunks:
+            nxt = _jax.device_put(X.chunks[i + 1])
+        parts.append(_chunk_margin(cur, w))
+    z = jnp.concatenate(parts)[:X.n_real]
+    return z + offsets
 
 
 @jax.jit

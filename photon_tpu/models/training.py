@@ -22,10 +22,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from photon_tpu.data.dataset import GLMBatch, pad_batch
+from photon_tpu.parallel.mesh import shard_map
+
+from photon_tpu.data.dataset import (ChunkedBatch, ChunkedMatrix, GLMBatch,
+                                     pad_batch)
 from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
                                     ShardedHybridRows,
                                     ShardedPermutedHybridRows, SparseRows)
@@ -226,7 +228,7 @@ def _matrix_dim(X) -> int:
     return (X.n_features
             if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
                               PermutedHybridRows,
-                              ShardedPermutedHybridRows))
+                              ShardedPermutedHybridRows, ChunkedMatrix))
             else X.shape[1])
 
 
@@ -456,6 +458,11 @@ def train_glm_grid(
     G×40 MB; callers selecting one winning lane (or reducing to metrics)
     should fetch only what they need.
     """
+    if isinstance(batch, ChunkedBatch):
+        raise ValueError(
+            "streamed mode has no lane-minor grid (every lane would "
+            "multiply the per-pass host→device stream); run the sweep "
+            "sequentially — each point is a train_glm(ChunkedBatch) solve")
     d = _matrix_dim(batch.X)
     sharded_hybrid = mesh is not None and isinstance(
         batch.X, (ShardedHybridRows, ShardedPermutedHybridRows))
@@ -580,6 +587,66 @@ def _static_config(config: OptimizerConfig) -> OptimizerConfig:
                        optimizer=config.effective_optimizer())
 
 
+def train_glm_streamed(
+    data: ChunkedBatch,
+    task: TaskType,
+    config: OptimizerConfig,
+    w0: Optional[jax.Array] = None,
+    prior_mean=None,
+    prior_precision=None,
+    normalization=None,
+) -> tuple[GeneralizedLinearModel, OptResult]:
+    """The out-of-HBM solve: the dataset is a host-resident ChunkedBatch and
+    every objective evaluation accumulates over streamed device chunks
+    (optim/streamed.py — the single-chip treeAggregate regime). Same
+    objective, same convergence criteria, same returned shapes as the
+    resident `train_glm`; `train_glm` dispatches here automatically when
+    handed a ChunkedBatch.
+
+    Single-chip by construction (a dataset that exceeds one chip's HBM
+    streams through that one chip; a mesh wants `shard_hybrid_batch` /
+    `stream_to_device` instead), and smooth/L1 solves only: TRON's CG inner
+    loop would pay one full dataset stream PER CG step, so it is rejected
+    rather than silently shipped into the wrong cost regime.
+    """
+    from photon_tpu.optim.streamed import (minimize_lbfgs_streamed,
+                                           minimize_owlqn_streamed)
+
+    if config.effective_optimizer() is OptimizerType.TRON:
+        raise ValueError(
+            "TRON is not available in streamed mode (each CG step would "
+            "stream the full dataset once — cg_max_iters streams per "
+            "iteration vs L-BFGS's two); use LBFGS or OWLQN for "
+            "out-of-HBM solves")
+    d = data.X.n_features
+    norm = _active_norm(normalization)
+    w0 = _init_w0(d, w0, norm)
+    if norm is not None and prior_mean is not None:
+        prior_mean = jnp.asarray(norm.to_normalized_space(
+            np.asarray(prior_mean)))
+    if norm is not None and prior_precision is not None:
+        f = np.asarray(norm.factors) if norm.factors is not None else 1.0
+        prior_precision = jnp.asarray(
+            np.asarray(prior_precision, np.float32) * f * f)
+    obj = make_objective(task, config, d, prior_mean=prior_mean,
+                         prior_precision=prior_precision,
+                         normalization=norm)
+    if config.effective_optimizer() is OptimizerType.OWLQN:
+        res = minimize_owlqn_streamed(
+            obj, data, w0, config.reg.l1_weight(config.reg_weight),
+            max_iters=config.max_iters, tolerance=config.tolerance,
+            history=config.history, reg_mask=obj.reg_mask)
+    else:
+        res = minimize_lbfgs_streamed(
+            obj, data, w0, max_iters=config.max_iters,
+            tolerance=config.tolerance, history=config.history)
+    w_out = res.w
+    if norm is not None:
+        w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))
+    model = GeneralizedLinearModel(Coefficients(w_out, None), task)
+    return model, res
+
+
 def train_glm(
     batch: GLMBatch,
     task: TaskType,
@@ -607,7 +674,35 @@ def train_glm(
     reference: PriorDistribution / initial-model priors); shorthand for the
     prior_mean/prior_precision pair, and the only way to pass a
     full-covariance precision.
+
+    A ChunkedBatch (host-resident chunked dataset) dispatches to the
+    streamed out-of-HBM solve — see `train_glm_streamed`.
     """
+    if isinstance(batch, ChunkedBatch):
+        if mesh is not None:
+            raise ValueError(
+                "streamed solves are single-chip (the point is one chip "
+                "training past its own HBM); use stream_to_device + "
+                "shard_hybrid_batch for mesh solves")
+        if variance is not VarianceComputationType.NONE:
+            raise ValueError(
+                "coefficient variances are not available in streamed mode "
+                "(the Hessian-diagonal pass is not chunk-accumulated yet); "
+                "use variance_type=none")
+        if prior is not None:
+            if prior_mean is not None or prior_precision is not None:
+                raise ValueError("pass prior OR prior_mean/prior_precision")
+            if prior.precision_full is not None:
+                raise ValueError(
+                    "full-covariance priors are not supported in streamed "
+                    "mode; use a diagonal prior")
+            prior_mean = jnp.asarray(prior.mean, jnp.float32)
+            prior_precision = (
+                None if prior.precision_diag is None
+                else jnp.asarray(prior.precision_diag, jnp.float32))
+        return train_glm_streamed(
+            batch, task, config, w0=w0, prior_mean=prior_mean,
+            prior_precision=prior_precision, normalization=normalization)
     d = _matrix_dim(batch.X)
     norm = _active_norm(normalization)
     permuted = isinstance(batch.X, (PermutedHybridRows,

@@ -271,6 +271,74 @@ class Objective:
         rv, rg = self._reg_terms(w)
         return value + rv, grad + rg
 
+    # ------------------------------------------------ chunk-partial API
+    # The literal treeAggregate contract (optim/streamed.py): a dataset too
+    # big for HBM streams through the solve as device-resident CHUNKS, and
+    # each evaluation accumulates per-chunk partial sums on device — the
+    # per-chunk leaf of the reference's RDD.treeAggregate, with the Python
+    # chunk loop standing in for Spark's aggregation tree. Partials carry
+    # NO regularization terms (reg is a function of w alone and must be
+    # added exactly once, by `finish_value_grad`); they are LOCAL sums and
+    # never psum (streamed mode is single-chip by construction).
+
+    def chunk_value_grad_partials(self, w, batch: GLMBatch):
+        """(margin, partials) of ONE chunk: the streamed analog of
+        value_and_grad. The margin is returned for the caller's per-chunk
+        cache (the streamed L-BFGS line search rides it); `partials` sum
+        across chunks with `add_partials` and close with
+        `finish_value_grad`."""
+        z = self._margin(w, batch)
+        return z, self.chunk_partials_at_margin(z, batch)
+
+    def chunk_partials_at_margin(self, z, batch: GLMBatch):
+        """(loss_sum, Xᵀr, Σr-or-None) partials from a cached chunk margin
+        — one elementwise pass + one Xᵀr pass, no margin recompute."""
+        loss, d1, _ = loss_fns(self.task)
+        r = batch.weights * d1(z, batch.y)
+        gX, gsum = self._backprop(batch, r)
+        return jnp.sum(batch.weights * loss(z, batch.y)), gX, gsum
+
+    @staticmethod
+    def add_partials(a, b):
+        """Accumulate two chunk-partial pytrees (the treeAggregate `seqOp`/
+        `combOp` — addition either way)."""
+        return jax.tree_util.tree_map(jnp.add, a, b)
+
+    def finish_value_grad(self, w, partials):
+        """(f, g) from summed chunk partials + the regularizer at w."""
+        val, gX, gsum = partials
+        grad = self._finish_backprop(gX, gsum)
+        rv, rg = self._reg_terms(w)
+        return val + rv, grad + rg
+
+    def chunk_phi_partials(self, z, dz, a, y, weights):
+        """(φ_loss, φ'_loss) partials of one chunk at step `a` along its
+        cached (z, dz) margins — elementwise only, no X, no (d,) work. The
+        regularizer's exact quadratic ray (ray_reg_coeffs) is added once
+        by the caller, so a streamed line-search trial uploads 16 bytes/row
+        instead of re-streaming the chunk's features."""
+        loss, d1, _ = loss_fns(self.task)
+        za = z + a * dz
+        return (jnp.sum(weights * loss(za, y)),
+                jnp.sum(weights * d1(za, y) * dz))
+
+    def chunk_value_partials_many(self, W, batch: GLMBatch):
+        """(K,) smooth-objective value partials of K candidate coefficient
+        vectors (rows of W) over ONE chunk — the streamed OWL-QN ladder
+        leaf: the orthant projection breaks margin linearity, so trial
+        points need real margins, and evaluating the whole backtracking
+        ladder per chunk visit shares the chunk upload across all K trials
+        (the reference pays one full treeAggregate per Breeze trial).
+        Loss partials only — the caller adds the per-candidate smooth reg
+        value once, not per chunk."""
+        loss, _, _ = loss_fns(self.task)
+
+        def one(wk):
+            z = self._margin(wk, batch)
+            return jnp.sum(batch.weights * loss(z, batch.y))
+
+        return jax.vmap(one)(W)
+
     def hvp(self, w, batch: GLMBatch, v):
         """Hessian-vector product: Jᵀ diag(weight · d2) J v + reg·v, where
         J = ∂z/∂w (= X when unnormalized).

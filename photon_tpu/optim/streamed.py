@@ -1,0 +1,509 @@
+"""Streamed (out-of-HBM) solvers: L-BFGS and OWL-QN whose every objective
+evaluation accumulates over host-resident device chunks.
+
+Reference parity: com.linkedin.photon.ml.function.glm.DistributedGLMLossFunction
+drives Breeze L-BFGS/OWL-QN with ONE `RDD.treeAggregate` per evaluation — the
+dataset never lives in one executor's memory. This module is the literal
+single-chip analog: the dataset lives on host as a `data.dataset.ChunkedBatch`,
+each evaluation streams the chunks through the device (double-buffered
+`device_put`, so chunk i+1 transfers while chunk i computes) and sums the
+`Objective.chunk_*_partials` leaves on device, so HBM holds O(chunk + solver
+state) instead of O(dataset). That is the one capability the resident solvers
+cannot offer: BASELINE config 4's 100M-row regime on one chip.
+
+Where the execution regime differs from the resident solvers, the MATH does
+not:
+
+- The outer loop runs on HOST (it must re-stream chunks per evaluation, so a
+  `lax.while_loop` cannot express it), but every numeric step — two-loop
+  direction, history push, chunk partials, margin updates — is the SAME
+  device code the resident solvers run (`two_loop` is imported, not
+  reimplemented), and convergence criteria mirror `optim.lbfgs._convergence`
+  / `optim.owlqn` term for term. The parity tests pin streamed == resident
+  to f32 accumulation noise (tests/test_streamed.py).
+- L-BFGS line search rides CACHED PER-CHUNK MARGINS: z chains on host as
+  z += α·dz (refreshed from w every `_Z_REFRESH` iterations, like the
+  resident margin solver), so a Wolfe trial uploads 16 bytes/row of (z, dz)
+  instead of re-streaming the chunk's features, and the first trial
+  piggybacks on the direction pass — the common accept-at-α=1 iteration
+  costs exactly TWO feature-chunk streams (dz pass + gradient pass), the
+  same two X passes per iteration the resident margin-cached solver pays.
+  The reference pays a full treeAggregate per Breeze trial.
+- OWL-QN's orthant projection breaks margin linearity, so its backtracking
+  ladder is evaluated in candidate LANES instead: one chunk stream prices
+  up to `ladder_lanes` trial steps at once (`chunk_value_partials_many`
+  shares the chunk upload across candidates), and selecting the FIRST
+  passing rung is exactly equivalent to the resident solver's sequential
+  halving (each rung's Armijo test is memoryless).
+
+TRON is deliberately absent: its CG inner loop needs one HVP — a full
+dataset stream — per CG step, so a streamed TRON pays cg_max_iters streams
+per outer iteration where L-BFGS pays two. `models.training.train_glm`
+rejects the combination with a pointer here instead of silently shipping a
+solver whose cost model is wrong for the regime.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.optim.lbfgs import _Z_REFRESH, two_loop
+from photon_tpu.optim.linesearch import C1, C2
+from photon_tpu.optim.owlqn import pseudo_gradient
+from photon_tpu.optim.tracker import OptResult
+
+__all__ = ["minimize_lbfgs_streamed", "minimize_owlqn_streamed"]
+
+
+# ---------------------------------------------------------------- device ops
+# Every numeric step is a module-level jitted program (cached by shape), so
+# the host loop costs dispatches, not retraces. Objective/GLMBatch are
+# registered pytrees; host numpy chunk leaves device-put on call.
+
+
+@jax.jit
+def _chunk_init(obj, w, batch):
+    return obj.chunk_value_grad_partials(w, batch)
+
+
+@jax.jit
+def _chunk_grad_at_margin(obj, z, batch):
+    return obj.chunk_partials_at_margin(z, batch)
+
+
+@jax.jit
+def _chunk_dz_phi(obj, p, z, a, batch):
+    dz = obj.direction_margin(p, batch)
+    return dz, obj.chunk_phi_partials(z, dz, a, batch.y, batch.weights)
+
+
+@jax.jit
+def _chunk_phi(obj, z, dz, a, y, weights):
+    return obj.chunk_phi_partials(z, dz, a, y, weights)
+
+
+@jax.jit
+def _chunk_value_many(obj, W, batch):
+    return obj.chunk_value_partials_many(W, batch)
+
+
+@jax.jit
+def _finish(obj, w, partials):
+    return obj.finish_value_grad(w, partials)
+
+
+@jax.jit
+def _acc(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+@jax.jit
+def _ray_coeffs(obj, w, p):
+    return obj.ray_reg_coeffs(w, p)
+
+
+@jax.jit
+def _axpy(w, a, p):
+    return w + a * p
+
+
+@jax.jit
+def _lbfgs_direction(g, S, Y, rho, idx, count, sy, yy):
+    p = -two_loop(g, S, Y, rho, idx, count, sy, yy)
+    dphi0 = jnp.dot(p, g)
+    bad = dphi0 >= 0.0
+    p = jnp.where(bad, -g, p)
+    dphi0 = jnp.where(bad, -jnp.dot(g, g), dphi0)
+    return p, dphi0, jnp.linalg.norm(p)
+
+
+@jax.jit
+def _owlqn_direction(w, g, l1, mask, S, Y, rho, idx, count, sy, yy):
+    pg = pseudo_gradient(w, g, l1, mask)
+    p = -two_loop(pg, S, Y, rho, idx, count, sy, yy)
+    p = jnp.where(p * pg < 0.0, p, 0.0)
+    dphi0 = jnp.dot(p, pg)
+    bad = dphi0 >= 0.0
+    p = jnp.where(bad, -pg, p)
+    dphi0 = jnp.where(bad, -jnp.dot(pg, pg), dphi0)
+    xi = jnp.where(w != 0.0, jnp.sign(w), jnp.sign(-pg))
+    return p, dphi0, xi, pg, jnp.linalg.norm(p)
+
+
+@jax.jit
+def _owlqn_candidates(obj, w, p, xi, alphas, pg, l1, mask):
+    """Projected ladder candidates W (K, d) + their Armijo decrements,
+    L1 terms and smooth-reg values — the per-iteration (d,)-sized work,
+    done ONCE on device, not per chunk."""
+    W = w[None, :] + alphas[:, None] * p[None, :]
+    W = jnp.where(W * xi[None, :] > 0.0, W, 0.0)
+    dec = (W - w[None, :]) @ pg
+    l1t = l1 * jnp.sum(mask[None, :] * jnp.abs(W), axis=1)
+    rv = jax.vmap(lambda wk: obj._reg_terms(wk)[0])(W)
+    return W, dec, l1t, rv
+
+
+@jax.jit
+def _pg_norm(w, g, l1, mask):
+    return jnp.linalg.norm(pseudo_gradient(w, g, l1, mask))
+
+
+@jax.jit
+def _l1_term(w, l1, mask):
+    return l1 * jnp.sum(mask * jnp.abs(w))
+
+
+@jax.jit
+def _pair_stats(s, y):
+    return jnp.dot(s, y), jnp.dot(y, y)
+
+
+@jax.jit
+def _write_slot(S, Y, rho, idx, s, y, sy):
+    return (S.at[idx].set(s), Y.at[idx].set(y),
+            rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)))
+
+
+class _History:
+    """Host-orchestrated circular (s, y) history — device buffers, host
+    bookkeeping. push() applies optim.lbfgs._push's exact curvature gate."""
+
+    def __init__(self, m: int, d: int, dtype=jnp.float32):
+        self.S = jnp.zeros((m, d), dtype)
+        self.Y = jnp.zeros((m, d), dtype)
+        self.rho = jnp.zeros((m,), dtype)
+        self.m, self.idx, self.count = m, 0, 0
+        self.sy, self.yy = 0.0, 0.0
+
+    def push(self, s, y) -> None:
+        sy, yy = (float(v) for v in _pair_stats(s, y))
+        if not sy > 1e-10 * max(yy, 1e-20):
+            return  # curvature condition failed: skip, keep newest stats
+        self.S, self.Y, self.rho = _write_slot(
+            self.S, self.Y, self.rho, np.int32(self.idx), s, y,
+            np.float32(sy))
+        self.idx = (self.idx + 1) % self.m
+        self.count = min(self.count + 1, self.m)
+        self.sy, self.yy = sy, yy
+
+    def args(self) -> tuple:
+        return (self.S, self.Y, self.rho, np.int32(self.idx),
+                np.int32(self.count), np.float32(self.sy),
+                np.float32(self.yy))
+
+
+# ---------------------------------------------------------- host line search
+def _sign(x: float) -> float:
+    return 0.0 if x == 0.0 else math.copysign(1.0, x)
+
+
+def _cubic_min_host(a_lo, f_lo, d_lo, a_hi, f_hi, d_hi) -> float:
+    """Scalar port of optim.linesearch._cubic_min (same safeguards)."""
+    span = a_hi - a_lo
+    d1 = d_lo + d_hi - 3.0 * (f_lo - f_hi) / (1.0 if span == 0.0 else -span)
+    disc = d1 * d1 - d_lo * d_hi
+    d2 = _sign(span) * math.sqrt(max(disc, 0.0))
+    denom = d_hi - d_lo + 2.0 * d2
+    a_c = a_hi - span * (d_hi + d2 - d1) / (1.0 if denom == 0.0 else denom)
+    lo_m = a_lo + 0.1 * span
+    hi_m = a_hi - 0.1 * span
+    inside = ((lo_m <= a_c <= hi_m) if span > 0.0
+              else (hi_m <= a_c <= lo_m))
+    ok = disc >= 0.0 and denom != 0.0 and math.isfinite(a_c) and inside
+    return a_c if ok else 0.5 * (a_lo + a_hi)
+
+
+def _host_wolfe(phi, f0: float, dphi0: float, a_init: float,
+                max_evals: int, first=None):
+    """Host port of optim.linesearch.wolfe_line_search — the same
+    bracket+zoom state machine, one streamed `phi` evaluation per step.
+    `first` short-circuits the first evaluation with (f, dphi) already
+    accumulated during the direction pass (the common accept-at-first-trial
+    iteration then costs ZERO extra margin streams). Returns
+    (alpha, f_alpha, ok) with the resident solver's exact semantics."""
+    phase, i = 0, 0
+    a, a_prev, f_prev, d_prev = a_init, 0.0, f0, dphi0
+    a_lo, f_lo, d_lo = 0.0, f0, dphi0
+    a_hi = f_hi = d_hi = math.inf
+    a_star, f_star = 0.0, f0
+    done = False
+
+    def armijo(a_, f_):
+        return f_ <= f0 + C1 * a_ * dphi0
+
+    while not done and i < max_evals:
+        f, d = first if (first is not None and i == 0) else phi(a)
+        f, d = float(f), float(d)
+        bad = math.isnan(f) or math.isinf(f)
+
+        if phase == 0:  # bracketing (N&W Alg 3.5)
+            to_zoom_hi = bad or not armijo(a, f) or (i > 0 and f >= f_prev)
+            wolfe_ok = not to_zoom_hi and abs(d) <= -C2 * dphi0
+            to_zoom_rev = (not to_zoom_hi and not wolfe_ok and d >= 0.0)
+            expand = not (to_zoom_hi or wolfe_ok or to_zoom_rev)
+            n_phase = 1 if (to_zoom_hi or to_zoom_rev) else 0
+            n_lo = ((a_prev, f_prev, d_prev) if to_zoom_hi else (a, f, d))
+            n_hi = ((a, f, d) if to_zoom_hi else (a_prev, f_prev, d_prev))
+        else:  # zoom (Alg 3.6); `a` is the trial point inside [lo, hi]
+            shrink_hi = bad or not armijo(a, f) or f >= f_lo
+            wolfe_ok = not shrink_hi and abs(d) <= -C2 * dphi0
+            flip = not shrink_hi and d * (a_hi - a_lo) >= 0.0
+            expand, n_phase = False, 1
+            n_lo = (a_lo, f_lo, d_lo) if shrink_hi else (a, f, d)
+            n_hi = ((a, f, d) if shrink_hi
+                    else ((a_lo, f_lo, d_lo) if flip else (a_hi, f_hi, d_hi)))
+
+        done = wolfe_ok
+        a_lo, f_lo, d_lo = n_lo
+        a_hi, f_hi, d_hi = n_hi
+        interp_a = _cubic_min_host(a_lo, f_lo, d_lo, a_hi, f_hi, d_hi)
+        if not (math.isfinite(f_hi) and math.isfinite(d_hi)):
+            interp_a = 0.5 * (a_lo + a_hi)
+        next_a = 2.0 * a if (phase == 0 and expand) else interp_a
+
+        if done or (armijo(a, f) and f < f_star and not bad):
+            a_star, f_star = a, f
+        i += 1
+        a_prev, f_prev, d_prev = a, f, d
+        a, phase = next_a, n_phase
+
+    return a_star, f_star, done or a_star > 0.0
+
+
+def _convergence_host(ok, f_old, f_new, gnorm, g0norm, dphi0,
+                      tolerance) -> bool:
+    """Host mirror of optim.lbfgs._convergence (f32 noise floor)."""
+    grad_conv = gnorm <= tolerance * max(1.0, g0norm)
+    f_conv = ok and abs(f_old - f_new) <= tolerance * max(
+        max(abs(f_old), abs(f_new)), 1e-12)
+    noise = 4.0 * float(np.finfo(np.float32).eps) * max(abs(f_old), 1.0)
+    precision_limited = (not ok) and abs(dphi0) <= noise
+    return grad_conv or f_conv or precision_limited
+
+
+def _result(w, value, gnorm, it, converged, failed, hist, ghist) -> OptResult:
+    return OptResult(
+        w=w, value=jnp.asarray(np.float32(value)),
+        grad_norm=jnp.asarray(np.float32(gnorm)),
+        iterations=jnp.asarray(np.int32(it)),
+        converged=jnp.asarray(bool(converged)),
+        failed=jnp.asarray(bool(failed)),
+        loss_history=jnp.asarray(hist),
+        grad_norm_history=jnp.asarray(ghist),
+    )
+
+
+# --------------------------------------------------------- streamed L-BFGS
+def minimize_lbfgs_streamed(
+    obj,  # ops.objective.Objective (axis_name must be None)
+    data,  # data.dataset.ChunkedBatch
+    w0,
+    max_iters: int = 100,
+    tolerance: float = 1e-7,
+    history: int = 10,
+    max_ls_evals: int = 12,
+) -> OptResult:
+    """L-BFGS whose value+gradient accumulate over streamed device chunks —
+    the treeAggregate-per-iteration execution regime, same math and same
+    convergence criteria as `optim.lbfgs.minimize_lbfgs_margin`."""
+    if obj.axis_name is not None:
+        raise ValueError("streamed solves are single-chip: Objective."
+                         "axis_name must be None")
+    w = jnp.asarray(w0, jnp.float32)
+    d = w.shape[0]
+    hist_st = _History(history, d)
+    n_chunks = data.n_chunks
+
+    # ---- initial pass: margins cached per chunk, (f, g) accumulated
+    z_cache: list = [None] * n_chunks
+    acc = None
+    for i, b in data.iter_device():
+        z, parts = _chunk_init(obj, w, b)
+        z_cache[i] = np.asarray(z)
+        acc = parts if acc is None else _acc(acc, parts)
+    f_dev, g = _finish(obj, w, acc)
+    f = float(f_dev)
+    g0norm = float(jnp.linalg.norm(g))
+
+    hist = np.full(max_iters + 1, np.nan, np.float32)
+    ghist = np.full(max_iters + 1, np.nan, np.float32)
+    hist[0], ghist[0] = f, g0norm
+
+    it, converged, failed = 0, g0norm <= 1e-14, False
+    done = converged
+    dz_cache: list = [None] * n_chunks
+    while not done and it < max_iters:
+        p, dphi0_dev, pnorm = _lbfgs_direction(g, *hist_st.args())
+        dphi0 = float(dphi0_dev)
+        a_init = (1.0 if hist_st.count > 0
+                  else 1.0 / max(float(pnorm), 1.0))
+        c0, c1r, c2r = (float(v) for v in _ray_coeffs(obj, w, p))
+
+        def reg_ray(a):  # exact quadratic reg along the ray (phi_at_ray)
+            return c0 + a * (c1r + 0.5 * a * c2r), c1r + a * c2r
+
+        # ---- direction pass (feature stream 1 of 2): dz per chunk, with
+        # the FIRST Wolfe trial's φ(a_init) partials riding along.
+        wl = wd = None
+        for i, b in data.iter_device():
+            dz, (wl_i, wd_i) = _chunk_dz_phi(obj, p, z_cache[i],
+                                             np.float32(a_init), b)
+            dz_cache[i] = np.asarray(dz)
+            wl = wl_i if wl is None else wl + wl_i
+            wd = wd_i if wd is None else wd + wd_i
+        rv, rd = reg_ray(a_init)
+        first_eval = (float(wl) + rv, float(wd) + rd)
+
+        def phi(a):
+            """Streamed trial: 16 bytes/row of cached margins, no X."""
+            wl = wd = None
+            for i in range(n_chunks):
+                b = data.chunk(i)
+                wl_i, wd_i = _chunk_phi(obj, z_cache[i], dz_cache[i],
+                                        np.float32(a), b.y, b.weights)
+                wl = wl_i if wl is None else wl + wl_i
+                wd = wd_i if wd is None else wd + wd_i
+            rv, rd = reg_ray(a)
+            return float(wl) + rv, float(wd) + rd
+
+        alpha, f_star, ok = _host_wolfe(phi, f, dphi0, a_init,
+                                        max_ls_evals, first=first_eval)
+
+        if ok:
+            w_new = _axpy(w, np.float32(alpha), p)
+            a32 = np.float32(alpha)
+            for i in range(n_chunks):  # host margin chain: z += α·dz
+                z_cache[i] = z_cache[i] + a32 * dz_cache[i]
+            refresh = (max_iters >= _Z_REFRESH
+                       and (it + 1) % _Z_REFRESH == 0)
+            # ---- gradient pass (feature stream 2 of 2)
+            acc = None
+            for i, b in data.iter_device():
+                if refresh:  # re-anchor the chained margin on w (f32 drift)
+                    z, parts = _chunk_init(obj, w_new, b)
+                    z_cache[i] = np.asarray(z)
+                else:
+                    parts = _chunk_grad_at_margin(obj, z_cache[i], b)
+                acc = parts if acc is None else _acc(acc, parts)
+            _, g_new = _finish(obj, w_new, acc)
+            f_new = f_star  # the accepted trial's value, as the resident
+            # margin solver uses it
+            hist_st.push(w_new - w, g_new - g)
+        else:
+            w_new, g_new, f_new = w, g, f
+
+        gnorm = float(jnp.linalg.norm(g_new))
+        converged = _convergence_host(ok, f, f_new, gnorm, g0norm, dphi0,
+                                      tolerance)
+        failed = failed or (not ok and not converged)
+        it += 1
+        hist[it], ghist[it] = f_new, gnorm
+        w, g, f = w_new, g_new, f_new
+        done = converged or not ok
+
+    return _result(w, f, float(jnp.linalg.norm(g)), it, converged, failed,
+                   hist, ghist)
+
+
+# --------------------------------------------------------- streamed OWL-QN
+def minimize_owlqn_streamed(
+    obj,
+    data,
+    w0,
+    l1_weight: float,
+    max_iters: int = 100,
+    tolerance: float = 1e-7,
+    history: int = 10,
+    max_ls_evals: int = 20,
+    reg_mask=None,
+    ladder_lanes: int = 8,
+) -> OptResult:
+    """OWL-QN over streamed chunks. The projected backtracking ladder is
+    evaluated `ladder_lanes` candidates per chunk stream (selecting the
+    first passing rung == the resident solver's sequential halving, rung by
+    rung), so the common iteration costs two feature streams: the ladder
+    pass and the accepted point's gradient pass."""
+    if obj.axis_name is not None:
+        raise ValueError("streamed solves are single-chip: Objective."
+                         "axis_name must be None")
+    w = jnp.asarray(w0, jnp.float32)
+    d = w.shape[0]
+    l1 = np.float32(l1_weight)
+    mask = (jnp.ones((d,), jnp.float32) if reg_mask is None
+            else jnp.asarray(reg_mask, jnp.float32))
+    hist_st = _History(history, d)
+    c1 = 1e-4  # optim.owlqn's Armijo constant
+
+    def value_grad_pass(w_at):
+        acc = None
+        for _, b in data.iter_device():
+            _, parts = _chunk_init(obj, w_at, b)
+            acc = parts if acc is None else _acc(acc, parts)
+        f_dev, g_at = _finish(obj, w_at, acc)
+        return float(f_dev), g_at
+
+    f, g = value_grad_pass(w)
+    F = f + float(_l1_term(w, l1, mask))
+    pg0norm = float(_pg_norm(w, g, l1, mask))
+
+    hist = np.full(max_iters + 1, np.nan, np.float32)
+    ghist = np.full(max_iters + 1, np.nan, np.float32)
+    hist[0], ghist[0] = F, pg0norm
+
+    it, converged, failed = 0, pg0norm <= 1e-14, False
+    done = converged
+    while not done and it < max_iters:
+        p, dphi0_dev, xi, pg, pnorm = _owlqn_direction(
+            w, g, l1, mask, *hist_st.args())
+        dphi0 = float(dphi0_dev)
+        a0 = 1.0 if hist_st.count > 0 else 1.0 / max(float(pnorm), 1.0)
+
+        # ---- ladder line search: blocks of `ladder_lanes` rungs, each
+        # block priced by ONE chunk stream (vmapped candidate margins).
+        ok, w_new = False, None
+        evals = 0
+        while evals < max_ls_evals and not ok:
+            K = min(ladder_lanes, max_ls_evals - evals)
+            alphas = (a0 * 0.5 ** np.arange(evals, evals + K)).astype(
+                np.float32)
+            W, dec, l1t, rv = _owlqn_candidates(obj, w, p, xi,
+                                                alphas, pg, l1, mask)
+            acc = None
+            for _, b in data.iter_device():
+                part = _chunk_value_many(obj, W, b)
+                acc = part if acc is None else acc + part
+            F_cand = np.asarray(acc + rv + l1t, np.float64)
+            dec_np = np.asarray(dec, np.float64)
+            for k in range(K):  # first passing rung == sequential halving
+                if (np.isfinite(F_cand[k]) and dec_np[k] < 0.0
+                        and F_cand[k] <= F + c1 * dec_np[k]):
+                    ok, w_new = True, W[k]
+                    break
+            evals += K
+
+        if ok:
+            f_new, g_new = value_grad_pass(w_new)  # gradient stream
+            F_new = f_new + float(_l1_term(w_new, l1, mask))
+            hist_st.push(w_new - w, g_new - g)  # smooth-gradient history
+        else:
+            w_new, g_new, f_new, F_new = w, g, f, F
+
+        pgnorm = float(_pg_norm(w_new, g_new, l1, mask))
+        grad_conv = pgnorm <= tolerance * max(1.0, pg0norm)
+        f_conv = ok and abs(F - F_new) <= tolerance * max(
+            max(abs(F), abs(F_new)), 1e-12)
+        noise = 4.0 * float(np.finfo(np.float32).eps) * max(abs(F), 1.0)
+        precision_limited = (not ok) and abs(dphi0) <= noise
+        converged = grad_conv or f_conv or precision_limited
+        failed = failed or (not ok and not converged)
+        it += 1
+        hist[it], ghist[it] = F_new, pgnorm
+        w, g, f, F = w_new, g_new, f_new, F_new
+        done = converged or not ok
+
+    return _result(w, F, float(_pg_norm(w, g, l1, mask)), it, converged,
+                   failed, hist, ghist)

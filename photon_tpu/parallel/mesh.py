@@ -27,6 +27,21 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Re-exported from ONE place so every photon_tpu module (and the tests)
+# gets a jax-version-stable shard_map.
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map  # noqa: F401
+except ImportError:
+    # 0.4.x: the experimental home. Its replication checker predates a
+    # rule for `while` (every solver is a lax.while_loop), so default it
+    # off — the modern top-level shard_map handles this case natively,
+    # and check_rep is a static validity check, not a semantics change.
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, /, **kwargs):  # noqa: F811
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_exp(f, **kwargs)
+
 
 def make_mesh(data_axis: str = "data", n_devices: int | None = None,
               devices=None) -> Mesh:

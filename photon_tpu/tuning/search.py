@@ -67,7 +67,11 @@ def sobol_candidates(space: SearchSpace, n: int, seed: int = 0) -> np.ndarray:
     returns UNIT-cube points (n, d)."""
     from scipy.stats import qmc
 
-    eng = qmc.Sobol(space.dim, scramble=True, rng=np.random.default_rng(seed))
+    try:
+        eng = qmc.Sobol(space.dim, scramble=True,
+                        rng=np.random.default_rng(seed))
+    except TypeError:  # scipy < 1.15 spells the argument `seed`
+        eng = qmc.Sobol(space.dim, scramble=True, seed=seed)
     return eng.random(n).astype(np.float64)
 
 

@@ -51,18 +51,67 @@ __all__ = ["export_program", "load_program", "AotStore"]
 _registered = False
 
 
+def _serialize_auxdata(aux) -> bytes:
+    """Auxdata (the static/meta fields of our register_dataclass pytrees)
+    as JSON: the payload is plain ints/strings/bools/enums/tuples, so a
+    safe serializer covers it — pickle.loads on a shared or
+    attacker-writable cache dir would be an arbitrary-code-execution
+    hole, and nothing enforced the single-process trust domain the old
+    comment assumed. Tuples and enums round-trip through tagged dicts
+    (tuple-ness matters: auxdata equality is pytree equality)."""
+    import enum
+    import json
+
+    def enc(v):
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, enum.Enum):
+            t = type(v)
+            return {"__enum__": [t.__module__, t.__qualname__, v.name]}
+        if isinstance(v, tuple):
+            return {"__tuple__": [enc(x) for x in v]}
+        if isinstance(v, list):
+            return [enc(x) for x in v]
+        raise TypeError(
+            f"unsupported auxdata type {type(v).__name__!r}: extend "
+            "_serialize_auxdata rather than falling back to pickle")
+
+    return json.dumps(enc(aux)).encode()
+
+
+def _deserialize_auxdata(data: bytes):
+    import enum
+    import importlib
+    import json
+
+    def dec(v):
+        if isinstance(v, dict):
+            if "__enum__" in v:
+                mod, qual, name = v["__enum__"]
+                obj = importlib.import_module(mod)
+                for part in qual.split("."):
+                    obj = getattr(obj, part)
+                if not (isinstance(obj, type) and issubclass(obj, enum.Enum)):
+                    raise ValueError(
+                        f"auxdata names non-enum {mod}.{qual}")
+                return obj[name]
+            if "__tuple__" in v:
+                return tuple(dec(x) for x in v["__tuple__"])
+            raise ValueError(f"unrecognized auxdata tag {sorted(v)}")
+        if isinstance(v, list):
+            return [dec(x) for x in v]
+        return v
+
+    return dec(json.loads(data.decode()))
+
+
 def _register_serializations() -> None:
     """Register photon-tpu's pytree node types with jax.export so they can
-    appear in an exported program's calling convention. Auxdata (the
-    static/meta fields of our register_dataclass pytrees — plain
-    ints/strings/enums/arrays-of-ints) rides pickle; these files are
-    local caches written by this process family, the same trust domain
-    as the persistent XLA compilation cache."""
+    appear in an exported program's calling convention. Auxdata rides the
+    JSON codec above (no code execution on load)."""
     global _registered
     if _registered:
         return
-    import pickle
-
     from jax import export as jexport
 
     from photon_tpu.data import matrix as _mx
@@ -76,8 +125,8 @@ def _register_serializations() -> None:
         try:
             jexport.register_pytree_node_serialization(
                 cls, serialized_name=name,
-                serialize_auxdata=pickle.dumps,
-                deserialize_auxdata=pickle.loads)
+                serialize_auxdata=_serialize_auxdata,
+                deserialize_auxdata=_deserialize_auxdata)
         except ValueError:
             pass  # already registered (e.g. two stores in one process)
 
@@ -90,8 +139,8 @@ def _register_serializations() -> None:
             pass
 
     for cls in (_mx.SparseRows, _mx.HybridRows, _mx.ShardedHybridRows,
-                _mx.PermutedHybridRows, Objective, Coefficients,
-                GeneralizedLinearModel):
+                _mx.PermutedHybridRows, _mx.ShardedPermutedHybridRows,
+                Objective, Coefficients, GeneralizedLinearModel):
         reg(cls)
     for cls in (GLMBatch, OptResult):
         reg_nt(cls)
@@ -184,11 +233,19 @@ class AotStore:
         if cached is not None:
             try:
                 return cached(*args)
-            except ValueError:
-                # jax.export's call-time platform check: the file was
-                # exported for a different backend (e.g. a store
-                # populated on a CPU dev box now read on a TPU VM).
-                # Self-heal by re-exporting for the current platform.
+            except ValueError as e:
+                # jax.export's call-time platform check raises ValueError
+                # ("Function '<f>' was exported for platforms '<p>' but it
+                # is used on '<q>'") when the file was exported for a
+                # different backend (e.g. a store populated on a CPU dev
+                # box now read on a TPU VM). Self-heal by re-exporting for
+                # the current platform — but ONLY for that error: a
+                # genuine ValueError from the replayed program must
+                # surface, not be swallowed into a silent re-export that
+                # re-runs the same failure.
+                msg = str(e)
+                if not ("was exported for" in msg and "platform" in msg):
+                    raise
                 self._loaded.pop(path, None)
         data = export_program(fn, *args, platforms=self.platforms)
         tmp = f"{path}.tmp.{os.getpid()}"

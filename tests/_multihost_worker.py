@@ -55,9 +55,20 @@ data, n_real = stream_to_device(root, config, maps, mesh=mesh,
                                 chunk_rows=300)
 batch = make_batch(data.shards["dense"], data.y, weights=data.weights,
                    offsets=data.offsets)
-model, res = train_glm(
-    batch, TaskType.LOGISTIC_REGRESSION,
-    OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0), mesh=mesh)
+try:
+    model, res = train_glm(
+        batch, TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0),
+        mesh=mesh)
+except Exception as e:  # noqa: BLE001
+    if "aren't implemented on the CPU backend" in str(e):
+        # This jax build cannot EXECUTE multi-process computations on the
+        # CPU backend at all (cluster formation succeeded; the runtime
+        # refuses the launch) — the same "this sandbox can't run the
+        # 2-process program" condition as a failed handshake.
+        print(f"INIT_FAILED: {type(e).__name__}: {e}", flush=True)
+        sys.exit(42)
+    raise
 w = np.asarray(model.coefficients.means)
 np.save(out, w)
 print(f"OK process {pid}: n_real={n_real} iters={int(res.iterations)} "

@@ -71,3 +71,57 @@ def test_store_hits_and_aval_guard(rng, tmp_path):
     assert np.asarray(r3.w).shape == np.asarray(r1.w).shape
     assert len([f for f in os.listdir(tmp_path)
                 if f.endswith(".jaxexp")]) == 2
+
+
+def test_sharded_permuted_batch_registered(rng):
+    """ADVICE r5 #1: a program whose arguments carry the sharded-permuted
+    batch must export (the pytree type is registered with jax.export)."""
+    from photon_tpu.data.dataset import shard_permuted_batch
+
+    n, d, k = 64, 40, 4
+    ind = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    batch = shard_permuted_batch(
+        make_batch(SparseRows(jnp.asarray(ind), jnp.asarray(val), d), y),
+        1, d_dense=8)
+    fn = jax.jit(lambda b: jnp.sum(b.X.local().dense))
+    data = export_program(fn, batch)
+    np.testing.assert_allclose(np.asarray(load_program(data)(batch)),
+                               np.asarray(fn(batch)), rtol=1e-6)
+
+
+def test_store_reraises_genuine_value_error(rng, tmp_path):
+    """ADVICE r5 #2: only jax.export's platform-mismatch ValueError may
+    trigger the silent re-export; any other ValueError must surface."""
+    import pytest
+
+    store = AotStore(str(tmp_path))
+    fn = jax.jit(lambda x: x * 2)
+    x = jnp.ones(3)
+    store.call("k", fn, x)
+
+    def boom(*a):
+        raise ValueError("boom: genuine error from the replayed program")
+
+    for path in list(store._loaded):
+        store._loaded[path] = boom
+    with pytest.raises(ValueError, match="boom"):
+        store.call("k", fn, x)
+
+
+def test_auxdata_is_json_not_pickle(rng, tmp_path):
+    """ADVICE r5 #3: exported files must not depend on pickle for auxdata
+    (arbitrary-code-execution hazard on shared cache dirs) — the enum-
+    carrying Objective round-trips through the JSON codec."""
+    from photon_tpu.utils.aot import _deserialize_auxdata, _serialize_auxdata
+
+    aux = (TaskType.LOGISTIC_REGRESSION, ("data", None), False, 3, "s")
+    blob = _serialize_auxdata(aux)
+    assert b"photon_tpu" in blob or b"{" in blob  # JSON, readable
+    assert _deserialize_auxdata(blob) == aux
+    # a pickle-only payload type fails loudly at EXPORT time
+    import pytest
+
+    with pytest.raises(TypeError, match="auxdata"):
+        _serialize_auxdata(object())

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 import scipy.sparse as sp
-from jax import shard_map
+from photon_tpu.parallel.mesh import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from photon_tpu.data.dataset import make_batch

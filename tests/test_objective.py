@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from photon_tpu.parallel.mesh import shard_map
 
 from photon_tpu.data.dataset import make_batch
 from photon_tpu.data.matrix import SparseRows, from_scipy_csr, matvec, rmatvec

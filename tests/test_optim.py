@@ -90,11 +90,21 @@ def test_owlqn_matches_sklearn_l1(rng):
     X, y, vg, _ = _logistic_problem(rng, n=400, d=20)
     lam = 10.0
     res = minimize_owlqn(vg, jnp.zeros(20), lam, max_iters=300)
-    # liblinear + l1_ratio=1.0 is the version-proof pure-L1 baseline
-    # (penalty= is deprecated in sklearn 1.8 and removed in 1.10).
-    sk = LogisticRegression(l1_ratio=1.0, C=1.0 / lam,
+    # Pure-L1 baseline, spelled per sklearn version: before 1.8,
+    # penalty="l1" is the ONLY way to get L1 out of liblinear
+    # (l1_ratio is silently ignored there and the fit is L2 — the
+    # baseline objective then lands ~7 units above the true L1 optimum);
+    # penalty= is deprecated in 1.8 and removed in 1.10, where
+    # l1_ratio=1.0 takes over.
+    import sklearn
+
+    if tuple(int(v) for v in sklearn.__version__.split(".")[:2]) >= (1, 8):
+        kw = {"l1_ratio": 1.0}
+    else:
+        kw = {"penalty": "l1"}
+    sk = LogisticRegression(C=1.0 / lam,
                             solver="liblinear", fit_intercept=False,
-                            tol=1e-9, max_iter=3000).fit(X, y)
+                            tol=1e-9, max_iter=3000, **kw).fit(X, y)
     wsk = sk.coef_[0]
 
     def F(w):
