@@ -190,14 +190,9 @@ def run_sparse_grid(batch) -> float:
     return rows * int(iters) / best
 
 
-def run_streamed(chunk_rows: int = 1 << 16) -> float:
-    """Streamed-objective leg (round 6): the out-of-HBM execution regime —
-    the dense problem re-laid as HOST chunks, solved by the streamed
-    L-BFGS (optim/streamed.py), so every iteration re-uploads the dataset
-    twice (direction pass + gradient pass). The number is the price of
-    training past HBM: rows·iters/s here ÷ the resident single-lane number
-    is the host-link tax, and the flagship's 100M-row auto-trip pays
-    exactly this rate on its fixed-effect solves."""
+def _streamed_problem(chunk_rows: int):
+    """The dense problem re-laid as HOST chunks + the streamed solve
+    config (shared by the single-chip and mesh streamed legs)."""
     rng = np.random.default_rng(1)
     X = rng.normal(size=(D_ROWS, D_FEATURES)).astype(np.float32)
     w_true = rng.normal(size=D_FEATURES).astype(np.float32)
@@ -206,6 +201,18 @@ def run_streamed(chunk_rows: int = 1 << 16) -> float:
     cb = chunk_batch(make_batch(X, y), chunk_rows)
     cfg = OptimizerConfig(max_iters=D_ITERS, tolerance=0.0, reg=l2(),
                           reg_weight=1e-3, history=5)
+    return cb, cfg
+
+
+def run_streamed(chunk_rows: int = 1 << 16) -> float:
+    """Streamed-objective leg (round 6): the out-of-HBM execution regime —
+    the dense problem re-laid as HOST chunks, solved by the streamed
+    L-BFGS (optim/streamed.py), so every iteration re-uploads the dataset
+    twice (direction pass + gradient pass). The number is the price of
+    training past HBM: rows·iters/s here ÷ the resident single-lane number
+    is the host-link tax, and the flagship's 100M-row auto-trip pays
+    exactly this rate on its fixed-effect solves."""
+    cb, cfg = _streamed_problem(chunk_rows)
 
     def once():
         # the streamed solver's own host readbacks close the timing
@@ -214,6 +221,29 @@ def run_streamed(chunk_rows: int = 1 << 16) -> float:
 
     best, iters = _best_of(once)
     return D_ROWS * iters / best
+
+
+def run_streamed_mesh(chunk_rows: int = 1 << 16) -> tuple:
+    """Streamed-MESH leg (round 7): the same out-of-HBM problem with every
+    chunk row-sharded across a mesh over ALL visible chips
+    (optim/streamed.py mesh mode — each device streams 1/D of each chunk,
+    one hierarchical psum per evaluation). Aggregate rows·iters/s measures
+    the pod-scale streamed regime; per-chip = aggregate / n_chips pins the
+    sharding overhead against the single-chip `streamed_dense` leg (the
+    acceptance bound: within 2x)."""
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    n_chips = int(mesh.devices.size)
+    cb, cfg = _streamed_problem(chunk_rows)
+
+    def once():
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg,
+                           mesh=mesh)
+        return int(res.iterations)
+
+    best, iters = _best_of(once)
+    return D_ROWS * iters / best, n_chips
 
 
 def run_dense(batch, grid_weights) -> float:
@@ -238,6 +268,7 @@ def main() -> None:
     dense_value = run_dense(dense_batch, D_GRID)
     dense_big_value = run_dense(dense_batch, D_GRID_BIG)
     streamed_value = run_streamed()
+    streamed_mesh_value, streamed_mesh_chips = run_streamed_mesh()
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
     print(json.dumps({
         "metric": "sparse10m_logistic_grid8_rows_iters_per_sec_per_chip",
@@ -259,6 +290,16 @@ def main() -> None:
             "streamed_dense_rows_iters_per_sec_per_chip":
                 round(streamed_value, 1),
             "streamed_dense_vs_baseline": round(streamed_value / base, 3),
+            # streamed MESH regime (round 7): the same host-chunked problem
+            # row-sharded over every visible chip, one psum per evaluation;
+            # per-chip vs streamed_dense bounds the sharding overhead
+            "streamed_mesh_rows_iters_per_sec_aggregate":
+                round(streamed_mesh_value, 1),
+            "streamed_mesh_rows_iters_per_sec_per_chip":
+                round(streamed_mesh_value / streamed_mesh_chips, 1),
+            "streamed_mesh_n_chips": streamed_mesh_chips,
+            "streamed_mesh_vs_baseline": round(streamed_mesh_value / base,
+                                               3),
         },
     }))
 

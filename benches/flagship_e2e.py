@@ -55,10 +55,23 @@ def main() -> None:
                         "and engages the out-of-HBM path)")
     p.add_argument("--objective-chunk-rows", type=int, default=1 << 20,
                    help="host chunk height for streamed-objective shards")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="shard the fit over an N-device mesh (0 = single "
+                        "device). With the streamed objective engaged, "
+                        "every host chunk row-shards across the mesh — the "
+                        "pod-scale out-of-HBM regime — and the auto-trip "
+                        "budgets against the POOLED HBM (per-chip budget "
+                        "x N)")
     args = p.parse_args()
 
     import _flagship_data as fd
     from photon_tpu.drivers.train import TrainingParams, run_training
+
+    mesh = None
+    if args.mesh:
+        from photon_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_devices=args.mesh)
 
     os.makedirs(args.data_dir, exist_ok=True)
     train_path = os.path.join(args.data_dir, f"train_{args.rows}.avro")
@@ -101,7 +114,8 @@ def main() -> None:
 
     for run in range(args.runs):
         t0 = time.perf_counter()
-        out = run_training(params(fd.COORDINATES, f"game_r{run}"))
+        out = run_training(params(fd.COORDINATES, f"game_r{run}"),
+                           mesh=mesh)
         total = time.perf_counter() - t0
         phases = {k: round(v, 1) for k, v in sorted(out.timings.items())}
         print(f"run {run}: total {total:.0f}s  phases {phases}", flush=True)
@@ -112,7 +126,7 @@ def main() -> None:
     if args.fixed_only:
         t0 = time.perf_counter()
         out = run_training(params({"fixed": fd.COORDINATES["fixed"]},
-                                  "fixed_only"))
+                                  "fixed_only"), mesh=mesh)
         print(f"fixed-only: total {time.perf_counter() - t0:.0f}s  "
               f"AUC {out.best.validation_score:.4f}", flush=True)
 

@@ -240,8 +240,10 @@ class ChunkedBatch(NamedTuple):
     Scalars are full (n_padded,) numpy vectors (12 bytes/row — the feature
     chunks dominate); `chunk(i)` slices out one host GLMBatch, and
     `iter_device()` streams device-resident chunks with the next transfer
-    overlapping the current chunk's compute. models.training.train_glm
-    dispatches a ChunkedBatch to the streamed solvers automatically.
+    overlapping the current chunk's compute — onto one device, or (with
+    ``mesh=``) row-sharded across a whole mesh, each device slot fed its
+    own host slice. models.training.train_glm dispatches a ChunkedBatch to
+    the streamed solvers automatically.
     """
 
     X: ChunkedMatrix
@@ -268,23 +270,108 @@ class ChunkedBatch(NamedTuple):
         return GLMBatch(self.X.chunks[i], self.y[sl], self.weights[sl],
                         self.offsets[sl])
 
-    def iter_device(self, device=None) -> Iterator:
-        """Yield (i, device-resident GLMBatch) chunk by chunk, DOUBLE-
-        BUFFERED: chunk i+1's device_put is issued before chunk i is
-        consumed, so its host→device transfer overlaps the caller's compute
-        on chunk i (jax transfers are asynchronous). Peak device footprint
-        is therefore ~2 chunks, never the dataset."""
+    def mesh_chunk_rows(self, mesh) -> int:
+        """Per-chunk row count after padding to the mesh (every chunk pads
+        to the same height, so the per-chunk device programs still compile
+        exactly once)."""
+        from photon_tpu.parallel.mesh import pad_to_multiple
+
+        return pad_to_multiple(self.X.chunk_rows, int(mesh.devices.size))
+
+    def mesh_chunk(self, i: int, mesh) -> GLMBatch:
+        """Chunk i row-sharded over ALL mesh axes: each device slot's host
+        slice is device_put straight onto its device (multi-host: this
+        process uploads only its own slots' rows — features never cross
+        DCN), pad rows carry weight 0."""
+        from photon_tpu.parallel.mesh import shard_rows
+
+        pad = self.mesh_chunk_rows(mesh)
+        X = self.X.chunks[i]
+        if isinstance(X, SparseRows):
+            Xs = SparseRows(shard_rows(X.indices, mesh, pad_rows=pad),
+                            shard_rows(X.values, mesh, pad_rows=pad),
+                            X.n_features)
+        else:
+            Xs = shard_rows(X, mesh, pad_rows=pad)
+        c = self.X.chunk_rows
+        sl = slice(i * c, (i + 1) * c)
+        return GLMBatch(Xs,
+                        shard_rows(self.y[sl], mesh, pad_rows=pad),
+                        shard_rows(self.weights[sl], mesh, pad_rows=pad),
+                        shard_rows(self.offsets[sl], mesh, pad_rows=pad))
+
+    def chunk_scalars_sharded(self, i: int, mesh) -> tuple:
+        """(y, weights) of chunk i row-sharded over the mesh — the 8 B/row
+        a streamed line-search trial re-uploads alongside its cached
+        margins (no feature stream)."""
+        from photon_tpu.parallel.mesh import shard_rows
+
+        pad = self.mesh_chunk_rows(mesh)
+        c = self.X.chunk_rows
+        sl = slice(i * c, (i + 1) * c)
+        return (shard_rows(self.y[sl], mesh, pad_rows=pad),
+                shard_rows(self.weights[sl], mesh, pad_rows=pad))
+
+    def iter_device(self, device=None, mesh=None,
+                    prefetch: int = 2) -> Iterator:
+        """Yield (i, device-resident GLMBatch) chunk by chunk, PREFETCHED:
+        up to ``prefetch`` chunks (default 2 — the classic double buffer)
+        are in flight at once, so chunk i+`k`'s host→device transfer
+        overlaps the caller's compute on chunk i (jax transfers are
+        asynchronous). Peak device footprint is ~``prefetch`` chunks, never
+        the dataset. With ``mesh=``, every chunk is row-sharded across the
+        whole mesh (`mesh_chunk`) instead of landing on one device.
+
+        The iterator times how long it stalls waiting for each prefetched
+        chunk's transfer; when total stall exceeds total compute it logs
+        the imbalance at INFO — the signal that a deeper prefetch or a
+        bigger `objective_chunk_rows` would help."""
+        import time as _time
+        from collections import deque
+
         n = self.n_chunks
         if n == 0:
             return
-        put = (lambda b: jax.device_put(b, device)) if device is not None \
-            else jax.device_put
-        nxt = put(self.chunk(0))
+        depth = max(int(prefetch), 1)
+        if mesh is not None:
+            put = lambda i: self.mesh_chunk(i, mesh)  # noqa: E731
+        else:
+            dput = (lambda b: jax.device_put(b, device)) \
+                if device is not None else jax.device_put
+            put = lambda i: dput(self.chunk(i))  # noqa: E731
+
+        window: deque = deque()
+        issued = 0
+        stall = 0.0
+        t_start = _time.perf_counter()
         for i in range(n):
-            cur = nxt
-            if i + 1 < n:
-                nxt = put(self.chunk(i + 1))
+            # keep chunks i..i+depth-1 issued (async) before blocking on i
+            while issued < min(i + depth, n):
+                window.append(put(issued))
+                issued += 1
+            cur = window.popleft()
+            t0 = _time.perf_counter()
+            jax.block_until_ready(cur)
+            stall += _time.perf_counter() - t0
             yield i, cur
+        compute = (_time.perf_counter() - t_start) - stall
+        _log_stream_stall(stall, compute, n, depth)
+
+
+def _log_stream_stall(stall: float, compute: float, n_chunks: int,
+                      prefetch: int) -> None:
+    """One INFO line per streaming pass when transfer stalls exceed
+    compute — the signal that a deeper prefetch or a bigger chunk would
+    overlap the host link better (iter_device calls this at generator
+    exhaustion with its measured per-pass totals)."""
+    import logging
+
+    if n_chunks > 1 and stall > compute:
+        logging.getLogger("photon_tpu.streamed").info(
+            "chunk upload outpaced compute: stalled %.3fs on transfers vs "
+            "%.3fs compute over %d chunks (prefetch=%d) — a deeper "
+            "prefetch or bigger chunks would overlap better",
+            stall, compute, n_chunks, prefetch)
 
 
 def _host_sparse(X: SparseRows) -> SparseRows:

@@ -476,12 +476,15 @@ def stream_to_host(
 ) -> tuple[GameData, int]:
     """Stream a dataset into HOST-RESIDENT form for the out-of-HBM
     streamed-objective solve (drivers.train auto-trips here when the
-    device-resident estimate exceeds the HBM budget).
+    device-resident estimate exceeds the POOLED HBM budget — per-chip
+    budget × mesh size).
 
     Shards named in `chunked_shards` are assembled as
     data.dataset.ChunkedMatrix — uniform `objective_chunk_rows`-row host
-    chunks the streamed solvers re-upload pass by pass, so HBM holds
-    O(chunk + solver state) instead of O(dataset). Every other shard and
+    chunks the streamed solvers re-upload pass by pass (on a single chip,
+    or row-sharded across a whole mesh via `ChunkedBatch.iter_device(
+    mesh=...)`, each device fed its own slice of every chunk), so HBM
+    holds O(chunk + solver state) per device instead of O(dataset). Every other shard and
     the scalar columns assemble as full host numpy (the GAME layer
     device-puts what it needs — random-effect buckets must be resident).
 
@@ -627,6 +630,7 @@ def stream_to_device(
     feature_dtype=None,
     chunk_hook=None,
     n_rows: Optional[int] = None,
+    prefetch: int = 2,
     _local_mask=None,
 ) -> tuple[GameData, int]:
     """Stream a dataset STRAIGHT into its device placement.
@@ -634,6 +638,12 @@ def stream_to_device(
     `n_rows`: the dataset's total row count, when the caller already ran
     `scan_row_counts` (the training driver's auto-streaming check does) —
     skips a second pass over every container-block header.
+
+    `prefetch`: how many per-device shard uploads may be in flight at once
+    (device_put is asynchronous; the default 2 keeps the classic double
+    buffer — the next shard fills while the previous one transfers). Each
+    completed shard's transfer is awaited once the window fills, bounding
+    how far the host can run ahead of the link.
 
     With a mesh: rows are contiguously sharded over all mesh axes; per
     device a preallocated host buffer of exactly one shard fills from the
@@ -713,22 +723,32 @@ def stream_to_device(
     entity_cols: dict = {e: [] for e in config.entity_fields}
 
     dev_i = 0  # global device-slot cursor (advances on every slot)
+    in_flight: list = []  # shipped shards whose transfer isn't awaited yet
+    depth = max(int(prefetch), 1)
 
     def ship(buf):
-        """device_put one completed shard onto its device; a None buf is a
-        slot another process owns — just advance past it."""
+        """device_put one completed shard onto its device (asynchronous; at
+        most `prefetch` shard transfers run ahead before the oldest is
+        awaited); a None buf is a slot another process owns — just advance
+        past it."""
         nonlocal dev_i
         if buf is not None:
             scal, mats = buf
             dev = devices[dev_i] if mesh is not None else None
+            shipped = []
             for k in SCALARS:
                 scal_parts[k].append(jax.device_put(scal[k], dev))
+                shipped.append(scal_parts[k][-1])
             for s, v in mats.items():
                 if isinstance(v, tuple):
                     mat_parts[s].append(tuple(jax.device_put(a, dev)
                                               for a in v))
                 else:
                     mat_parts[s].append(jax.device_put(v, dev))
+                shipped.append(mat_parts[s][-1])
+            in_flight.append(shipped)
+            if len(in_flight) > depth:
+                jax.block_until_ready(in_flight.pop(0))
         dev_i += 1
 
     def alloc_slot():

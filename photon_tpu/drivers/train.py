@@ -130,18 +130,22 @@ class TrainingParams:
     streaming_chunk_rows: int = 65536
     # Streamed-objective (out-of-HBM) mode: the dataset lives on HOST and
     # every solver evaluation accumulates over streamed device chunks (the
-    # literal treeAggregate analog — optim/streamed.py), so one chip trains
-    # datasets bigger than its HBM (BASELINE config 4's 100M-row regime).
+    # literal treeAggregate analog — optim/streamed.py), so training
+    # handles datasets bigger than HBM (BASELINE config 4's 100M-row
+    # regime). With a mesh, every chunk row-shards across ALL mesh devices
+    # (each chip streams 1/D of each chunk; one hierarchical psum per
+    # evaluation), so the whole pod trains past its POOLED HBM at once.
     # Tri-state: None auto-trips when the device-resident estimate of the
-    # dataset exceeds `hbm_budget_bytes` (single-chip runs only — a mesh
-    # pools HBM and keeps the resident path); True forces it; False never
-    # streams the objective. Only shards used EXCLUSIVELY by fixed-effect
-    # coordinates are host-chunked (random-effect bucketing needs resident
-    # rows); scalars and RE shards stay device-resident, so peak HBM is
-    # O(chunk + RE data + solver state) instead of O(dataset).
+    # dataset exceeds the pooled budget (`hbm_budget_bytes` × mesh size);
+    # True forces it; False never streams the objective. Only shards used
+    # EXCLUSIVELY by fixed-effect coordinates are host-chunked
+    # (random-effect bucketing needs resident rows); scalars and RE shards
+    # stay device-resident, so peak HBM is O(chunk + RE data + solver
+    # state) instead of O(dataset).
     streamed_objective: Optional[bool] = None
-    # Per-chip HBM budget for the auto-trip. None detects the device's
-    # reported limit and falls back to 16 GiB (v5e).
+    # Per-chip HBM budget for the auto-trip (pooled budget = this × mesh
+    # size). None detects the reported limit of the mesh's (addressable)
+    # devices and falls back to 16 GiB (v5e).
     hbm_budget_bytes: Optional[int] = None
     # Rows per host chunk of a streamed-objective shard. Bigger chunks
     # amortize per-chunk dispatch and keep transfers long (good for PCIe);
@@ -360,12 +364,15 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             data, validation, stream_stats, n_real = \
                 _read_streamed_objective(
                     params, data_cfg, task, mode, index_maps,
-                    n_train_rows, chunked)
+                    n_train_rows, chunked, mesh)
             log.info(
                 "streamed objective engaged: %d rows; host-chunked "
-                "shards %s (%d-row chunks), resident shards %s",
+                "shards %s (%d-row chunks), resident shards %s%s",
                 n_real, sorted(chunked), params.objective_chunk_rows,
-                sorted(set(params.feature_shards) - chunked))
+                sorted(set(params.feature_shards) - chunked),
+                ("" if mesh is None else
+                 f"; chunks row-shard over {int(mesh.devices.size)} mesh "
+                 "devices"))
         elif streaming:
             data, validation, index_maps, stream_stats, n_real = \
                 _read_streaming(params, data_cfg, task, mode,
@@ -672,19 +679,31 @@ def _streamable_shards(params: TrainingParams) -> set:
     return fixed - re
 
 
-def _detect_hbm_budget() -> int:
-    """Per-chip HBM budget: the device's reported bytes_limit when the
-    backend exposes one, else 16 GiB (a v5e chip)."""
+def _detect_hbm_budget(mesh=None) -> int:
+    """Per-chip HBM budget of the mesh ACTUALLY in use: the smallest
+    reported bytes_limit over the mesh's addressable devices (other
+    processes' devices cannot be queried; a mesh is homogeneous in
+    practice), else 16 GiB (a v5e chip). Without a mesh: the default
+    device. The caller multiplies by the mesh size for the POOLED
+    budget."""
     import jax
 
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-        limit = int(stats.get("bytes_limit", 0))
-        if limit > 0:
-            return limit
-    except Exception:
-        pass
-    return 16 << 30
+    if mesh is not None:
+        proc = jax.process_index()
+        devices = [d for d in mesh.devices.reshape(-1)
+                   if d.process_index == proc]
+    else:
+        devices = jax.devices()[:1]
+    limits = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                limits.append(limit)
+        except Exception:
+            pass
+    return min(limits) if limits else 16 << 30
 
 
 def _estimate_device_bytes(n_rows: int, index_maps: dict,
@@ -707,56 +726,67 @@ def _estimate_device_bytes(n_rows: int, index_maps: dict,
 def _resolve_streamed_objective(params: TrainingParams, index_maps: dict,
                                 n_rows: int, mesh, log) -> bool:
     """The streamed-objective tri-state, resolved: forced True/False wins;
-    None auto-trips on a single chip when the device-resident estimate
-    exceeds the HBM budget — the same shape as the header-count streaming
-    auto-trip, one level up the memory hierarchy."""
+    None auto-trips when the device-resident estimate exceeds the POOLED
+    HBM budget — per-chip budget × mesh size, since a mesh-sharded
+    streamed solve (optim/streamed.py mesh mode) gives every chip 1/D of
+    each chunk and the resident path pools HBM the same way. The same
+    shape as the header-count streaming auto-trip, one level up the memory
+    hierarchy. Every resolution is logged at INFO — estimate, budget, mesh
+    size, verdict — so a surprising regime choice is diagnosable from the
+    run log."""
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
     forced = params.streamed_objective
     if forced is False:
+        log.info("streamed objective: OFF (forced by streamed_objective="
+                 "False)")
         return False
-    if forced and mesh is not None:
-        raise ValueError(
-            "streamed_objective=True is single-chip only (a mesh pools HBM "
-            "and keeps the resident sharded path); drop the mesh or the "
-            "flag")
     if forced:
         if not _streamable_shards(params):
             raise ValueError(
                 "streamed_objective=True needs at least one shard used "
                 "exclusively by fixed-effect coordinates (random-effect "
                 "shards must stay resident for entity bucketing)")
+        log.info(
+            "streamed objective: ON (forced by streamed_objective=True; "
+            "%d-device %s)", n_dev,
+            "mesh — chunks row-shard across it" if mesh is not None
+            else "single chip")
         return True
-    if mesh is not None:
-        return False
     est = _estimate_device_bytes(n_rows, index_maps, params)
-    budget = (params.hbm_budget_bytes if params.hbm_budget_bytes
-              else _detect_hbm_budget())
-    if est <= budget:
-        return False
+    per_chip = (params.hbm_budget_bytes if params.hbm_budget_bytes
+                else _detect_hbm_budget(mesh))
+    budget = per_chip * n_dev
     chunked = _streamable_shards(params)
-    if not chunked:
-        log.warning(
-            "dataset estimate %.2f GiB exceeds HBM budget %.2f GiB but no "
-            "shard is fixed-effect-only; falling back to device-resident "
-            "streaming (expect OOM at this scale)",
-            est / 2**30, budget / 2**30)
-        return False
+    verdict = est > budget and bool(chunked)
     log.info(
-        "auto-tripping streamed objective: dataset estimate %.2f GiB > "
-        "HBM budget %.2f GiB", est / 2**30, budget / 2**30)
-    return True
+        "streamed objective auto-resolution: dataset estimate %.2f GiB "
+        "(%d rows), pooled HBM budget %.2f GiB (%d device(s) x %.2f GiB "
+        "per chip), verdict %s",
+        est / 2**30, n_rows, budget / 2**30, n_dev, per_chip / 2**30,
+        "STREAM" if verdict else "resident")
+    if est > budget and not chunked:
+        log.warning(
+            "dataset estimate %.2f GiB exceeds pooled HBM budget %.2f GiB "
+            "but no shard is fixed-effect-only; falling back to "
+            "device-resident streaming (expect OOM at this scale)",
+            est / 2**30, budget / 2**30)
+    return verdict
 
 
 def _read_streamed_objective(params: TrainingParams,
                              data_cfg: GameDataConfig, task: TaskType,
                              mode: DataValidationType, index_maps: dict,
-                             n_train_rows: int, chunked_shards: set):
+                             n_train_rows: int, chunked_shards: set,
+                             mesh=None):
     """The out-of-HBM read: training data lands HOST-resident — the
     fixed-effect shards as uniform ChunkedMatrix chunks the streamed
-    solvers re-upload pass by pass, everything else as full host numpy the
-    GAME layer device-puts as needed. Per-chunk validation and mergeable
-    statistics ride the same pass, exactly as in _read_streaming.
-    Validation data stays device-resident (it is scored, not solved, and
-    is assumed to fit — stream_to_device's own bounded path)."""
+    solvers re-upload pass by pass (row-sharded over the mesh when one is
+    given), everything else as full host numpy the GAME layer device-puts
+    as needed. Per-chunk validation and mergeable statistics ride the same
+    pass, exactly as in _read_streaming. Validation data stays
+    device-resident (it is scored, not solved, and is assumed to fit —
+    stream_to_device's own bounded path, sharded over the mesh when one is
+    given, as in _read_streaming)."""
     import jax.numpy as jnp
 
     from photon_tpu.data.statistics import FeatureSummary
@@ -791,7 +821,7 @@ def _read_streamed_objective(params: TrainingParams,
     validation = None
     if params.validation_path:
         validation, _ = stream_to_device(
-            params.validation_path, data_cfg, index_maps, mesh=None,
+            params.validation_path, data_cfg, index_maps, mesh=mesh,
             chunk_rows=params.streaming_chunk_rows,
             sparse_k=params.sparse_k, feature_dtype=f_dtype,
             chunk_hook=make_hook(False))

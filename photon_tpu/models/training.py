@@ -595,19 +595,22 @@ def train_glm_streamed(
     prior_mean=None,
     prior_precision=None,
     normalization=None,
+    mesh: Optional[Mesh] = None,
 ) -> tuple[GeneralizedLinearModel, OptResult]:
     """The out-of-HBM solve: the dataset is a host-resident ChunkedBatch and
     every objective evaluation accumulates over streamed device chunks
-    (optim/streamed.py — the single-chip treeAggregate regime). Same
-    objective, same convergence criteria, same returned shapes as the
-    resident `train_glm`; `train_glm` dispatches here automatically when
-    handed a ChunkedBatch.
+    (optim/streamed.py — the treeAggregate regime). Same objective, same
+    convergence criteria, same returned shapes as the resident `train_glm`;
+    `train_glm` dispatches here automatically when handed a ChunkedBatch.
 
-    Single-chip by construction (a dataset that exceeds one chip's HBM
-    streams through that one chip; a mesh wants `shard_hybrid_batch` /
-    `stream_to_device` instead), and smooth/L1 solves only: TRON's CG inner
-    loop would pay one full dataset stream PER CG step, so it is rejected
-    rather than silently shipped into the wrong cost regime.
+    With a ``mesh``, every streamed chunk row-shards across ALL mesh
+    devices (each device streams 1/D of every feature chunk, the chunk
+    partials run under shard_map, and ONE hierarchical psum per evaluation
+    combines the (value, gradient) partials — the pod-scale treeAggregate),
+    so an out-of-HBM dataset trains against the mesh's POOLED HBM-bandwidth
+    and compute. Smooth/L1 solves only either way: TRON's CG inner loop
+    would pay one full dataset stream PER CG step, so it is rejected rather
+    than silently shipped into the wrong cost regime.
     """
     from photon_tpu.optim.streamed import (minimize_lbfgs_streamed,
                                            minimize_owlqn_streamed)
@@ -635,11 +638,11 @@ def train_glm_streamed(
         res = minimize_owlqn_streamed(
             obj, data, w0, config.reg.l1_weight(config.reg_weight),
             max_iters=config.max_iters, tolerance=config.tolerance,
-            history=config.history, reg_mask=obj.reg_mask)
+            history=config.history, reg_mask=obj.reg_mask, mesh=mesh)
     else:
         res = minimize_lbfgs_streamed(
             obj, data, w0, max_iters=config.max_iters,
-            tolerance=config.tolerance, history=config.history)
+            tolerance=config.tolerance, history=config.history, mesh=mesh)
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))
@@ -676,14 +679,11 @@ def train_glm(
     full-covariance precision.
 
     A ChunkedBatch (host-resident chunked dataset) dispatches to the
-    streamed out-of-HBM solve — see `train_glm_streamed`.
+    streamed out-of-HBM solve — single-chip, or with ``mesh`` row-sharded
+    across every mesh device with one psum per evaluation; see
+    `train_glm_streamed`.
     """
     if isinstance(batch, ChunkedBatch):
-        if mesh is not None:
-            raise ValueError(
-                "streamed solves are single-chip (the point is one chip "
-                "training past its own HBM); use stream_to_device + "
-                "shard_hybrid_batch for mesh solves")
         if variance is not VarianceComputationType.NONE:
             raise ValueError(
                 "coefficient variances are not available in streamed mode "
@@ -702,7 +702,8 @@ def train_glm(
                 else jnp.asarray(prior.precision_diag, jnp.float32))
         return train_glm_streamed(
             batch, task, config, w0=w0, prior_mean=prior_mean,
-            prior_precision=prior_precision, normalization=normalization)
+            prior_precision=prior_precision, normalization=normalization,
+            mesh=mesh)
     d = _matrix_dim(batch.X)
     norm = _active_norm(normalization)
     permuted = isinstance(batch.X, (PermutedHybridRows,

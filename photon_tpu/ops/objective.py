@@ -279,7 +279,12 @@ class Objective:
     # chunk loop standing in for Spark's aggregation tree. Partials carry
     # NO regularization terms (reg is a function of w alone and must be
     # added exactly once, by `finish_value_grad`); they are LOCAL sums and
-    # never psum (streamed mode is single-chip by construction).
+    # NEVER psum here — under a mesh the streamed machinery runs these
+    # methods inside shard_map, keeps each device's running partial local
+    # across chunks, and issues exactly ONE hierarchical psum per
+    # evaluation when it closes with finish_value_grad
+    # (optim.streamed._MeshChunkOps). An axis_name psum inside a chunk
+    # partial would multiply that single collective by n_chunks.
 
     def chunk_value_grad_partials(self, w, batch: GLMBatch):
         """(margin, partials) of ONE chunk: the streamed analog of

@@ -1,15 +1,29 @@
 """Streamed (out-of-HBM) solvers: L-BFGS and OWL-QN whose every objective
-evaluation accumulates over host-resident device chunks.
+evaluation accumulates over host-resident device chunks — on one chip, or
+row-sharded across a whole mesh.
 
 Reference parity: com.linkedin.photon.ml.function.glm.DistributedGLMLossFunction
 drives Breeze L-BFGS/OWL-QN with ONE `RDD.treeAggregate` per evaluation — the
 dataset never lives in one executor's memory. This module is the literal
-single-chip analog: the dataset lives on host as a `data.dataset.ChunkedBatch`,
-each evaluation streams the chunks through the device (double-buffered
-`device_put`, so chunk i+1 transfers while chunk i computes) and sums the
+analog: the dataset lives on host as a `data.dataset.ChunkedBatch`, each
+evaluation streams the chunks through the device (prefetched `device_put`,
+so chunk i+1 transfers while chunk i computes) and sums the
 `Objective.chunk_*_partials` leaves on device, so HBM holds O(chunk + solver
 state) instead of O(dataset). That is the one capability the resident solvers
-cannot offer: BASELINE config 4's 100M-row regime on one chip.
+cannot offer: BASELINE config 4's 100M-row regime past the HBM budget.
+
+MESH MODE (``mesh=``): every streamed chunk is row-sharded over ALL mesh
+axes — each device slot is fed its own host slice (`ChunkedBatch.
+mesh_chunk`; on multi-host each process device_puts only its own slots'
+rows, so features never cross DCN) and the chunk-partial programs run under
+`shard_map` with NO internal collective: per-chunk partial sums stay
+device-local, accumulate device-local across chunks, and each evaluation
+closes with ONE hierarchical `psum` of the (value, (d,)-gradient) partials
+(`_MeshChunkOps.finish`) — reduce over the ICI inside the slice, one (d,)
+vector across DCN per evaluation, the exact treeAggregate shape of
+`parallel/mesh.py`'s docstring, driven chunk by chunk. An out-of-HBM
+dataset therefore trains on every chip of a pod at once, each device
+streaming 1/D of every feature chunk.
 
 Where the execution regime differs from the resident solvers, the MATH does
 not:
@@ -50,7 +64,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
+from photon_tpu.data.dataset import GLMBatch
+from photon_tpu.data.matrix import SparseRows
 from photon_tpu.optim.lbfgs import _Z_REFRESH, two_loop
 from photon_tpu.optim.linesearch import C1, C2
 from photon_tpu.optim.owlqn import pseudo_gradient
@@ -166,6 +184,261 @@ def _pair_stats(s, y):
 def _write_slot(S, Y, rho, idx, s, y, sy):
     return (S.at[idx].set(s), Y.at[idx].set(y),
             rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-20)))
+
+
+# ------------------------------------------------------------- mesh backend
+# Mesh-sharded streamed execution. Chunk programs run under shard_map with
+# NO collective inside: partials come back STACKED (one block per device
+# slot, leading axis sharded over the whole mesh), accumulate elementwise
+# across chunks (still no communication), and the evaluation closes with
+# ONE psum in `finish` / `psum_tree` — hierarchical on a hybrid
+# replica×data mesh (ICI inside the slice, the (d,) vector across DCN once
+# per evaluation).
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), tree)
+
+
+class _MeshChunkOps:
+    """Per-mesh jitted shard_map programs for the streamed chunk-partial
+    evaluation (cached per mesh by `_mesh_ops`)."""
+
+    def __init__(self, mesh):
+        from photon_tpu.parallel.mesh import shard_map
+
+        self.mesh = mesh
+        axes = tuple(mesh.axis_names)
+        self.axes = axes
+        row, rep = P(axes), P()
+
+        def ospec(obj):
+            return jax.tree_util.tree_map(lambda _: rep, obj)
+
+        def bspec(b):
+            X = b.X
+            xs = (SparseRows(row, row, X.n_features)
+                  if isinstance(X, SparseRows) else row)
+            return GLMBatch(xs, row, row, row)
+
+        def pspec(obj):
+            # (loss_sum, gX, gsum-or-None) stacked one block per device
+            return (row, row, row if obj.norm_shifts is not None else None)
+
+        def stack(parts):
+            return jax.tree_util.tree_map(lambda x: x[None], parts)
+
+        @jax.jit
+        def chunk_init(obj, w, b):
+            def body(obj, w, b):
+                z, parts = obj.chunk_value_grad_partials(w, b)
+                return z, stack(parts)
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), rep, bspec(b)),
+                             out_specs=(row, pspec(obj)))(obj, w, b)
+
+        @jax.jit
+        def chunk_grad(obj, z, b):
+            def body(obj, z, b):
+                return stack(obj.chunk_partials_at_margin(z, b))
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), row, bspec(b)),
+                             out_specs=pspec(obj))(obj, z, b)
+
+        @jax.jit
+        def chunk_dz_phi(obj, p, z, a, b):
+            def body(obj, p, z, a, b):
+                dz = obj.direction_margin(p, b)
+                wl, wd = obj.chunk_phi_partials(z, dz, a, b.y, b.weights)
+                return dz, (wl[None], wd[None])
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), rep, row, rep, bspec(b)),
+                             out_specs=(row, (row, row)))(obj, p, z, a, b)
+
+        @jax.jit
+        def chunk_phi(obj, z, dz, a, y, wt):
+            def body(obj, z, dz, a, y, wt):
+                wl, wd = obj.chunk_phi_partials(z, dz, a, y, wt)
+                return wl[None], wd[None]
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), row, row, rep, row, row),
+                             out_specs=(row, row))(obj, z, dz, a, y, wt)
+
+        @jax.jit
+        def chunk_value_many(obj, W, b):
+            def body(obj, W, b):
+                return obj.chunk_value_partials_many(W, b)[None]
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), rep, bspec(b)),
+                             out_specs=row)(obj, W, b)
+
+        @jax.jit
+        def finish(obj, w, parts):
+            def body(obj, w, parts):
+                # THE one collective of a streamed-mesh evaluation: value
+                # and gradient partials ride a single (hierarchical) psum.
+                total = lax.psum(_squeeze0(parts), axes)
+                return obj.finish_value_grad(w, total)
+
+            return shard_map(body, mesh=mesh,
+                             in_specs=(ospec(obj), rep, pspec(obj)),
+                             out_specs=(rep, rep))(obj, w, parts)
+
+        @jax.jit
+        def psum_tree(parts):
+            def body(parts):
+                return lax.psum(_squeeze0(parts), axes)
+
+            specs = jax.tree_util.tree_map(lambda _: row, parts)
+            outs = jax.tree_util.tree_map(lambda _: rep, parts)
+            return shard_map(body, mesh=mesh,
+                             in_specs=(specs,), out_specs=outs)(parts)
+
+        self.chunk_init = chunk_init
+        self.chunk_grad = chunk_grad
+        self.chunk_dz_phi = chunk_dz_phi
+        self.chunk_phi = chunk_phi
+        self.chunk_value_many = chunk_value_many
+        self.finish = finish
+        self.psum_tree = psum_tree
+
+
+_MESH_OPS_CACHE: dict = {}
+
+
+def _mesh_ops(mesh) -> _MeshChunkOps:
+    ops = _MESH_OPS_CACHE.get(mesh)
+    if ops is None:
+        ops = _MESH_OPS_CACHE[mesh] = _MeshChunkOps(mesh)
+    return ops
+
+
+class _SingleDeviceStream:
+    """The single-chip execution regime: chunks upload whole, margin caches
+    are (chunk_rows,) host numpy, partial totals are plain device scalars."""
+
+    def __init__(self, data, prefetch: int = 2):
+        self.data, self.prefetch = data, prefetch
+
+    def iter_chunks(self):
+        return self.data.iter_device(prefetch=self.prefetch)
+
+    def chunk_init(self, obj, w, b):
+        z, parts = _chunk_init(obj, w, b)
+        return np.asarray(z), parts
+
+    def chunk_grad(self, obj, z, b):
+        return _chunk_grad_at_margin(obj, z, b)
+
+    def chunk_dz_phi(self, obj, p, z, a, b):
+        dz, wlwd = _chunk_dz_phi(obj, p, z, np.float32(a), b)
+        return np.asarray(dz), wlwd
+
+    def chunk_phi(self, obj, i, z, dz, a):
+        b = self.data.chunk(i)
+        return _chunk_phi(obj, z, dz, np.float32(a), b.y, b.weights)
+
+    def chunk_value_many(self, obj, W, b):
+        return _chunk_value_many(obj, W, b)
+
+    def finish(self, obj, w, acc):
+        return _finish(obj, w, acc)
+
+    def totals(self, tree) -> tuple:
+        return tuple(float(x) for x in tree)
+
+    def values_total(self, acc) -> np.ndarray:
+        return np.asarray(acc, np.float64)
+
+    def result_w(self, w):
+        return w
+
+
+class _MeshStream:
+    """Mesh-sharded streamed execution: every chunk row-shards over the
+    whole mesh, chunk partials stay device-local (stacked one block per
+    device slot), margin caches live on HOST in local-slot layout
+    ((n_local_slots, s) numpy — `parallel.mesh.fetch_local_rows`), and each
+    evaluation closes with the backend's single psum."""
+
+    def __init__(self, data, mesh, prefetch: int = 2):
+        self.data, self.mesh, self.prefetch = data, mesh, prefetch
+        self.ops = _mesh_ops(mesh)
+
+    def iter_chunks(self):
+        return self.data.iter_device(mesh=self.mesh, prefetch=self.prefetch)
+
+    def _fetch(self, arr):
+        from photon_tpu.parallel.mesh import fetch_local_rows
+
+        return fetch_local_rows(arr, self.mesh)
+
+    def _put(self, local):
+        from photon_tpu.parallel.mesh import shard_local_rows
+
+        return shard_local_rows(local, self.mesh)
+
+    def chunk_init(self, obj, w, b):
+        z, parts = self.ops.chunk_init(obj, w, b)
+        return self._fetch(z), parts
+
+    def chunk_grad(self, obj, z, b):
+        return self.ops.chunk_grad(obj, self._put(z), b)
+
+    def chunk_dz_phi(self, obj, p, z, a, b):
+        dz, wlwd = self.ops.chunk_dz_phi(obj, p, self._put(z),
+                                         np.float32(a), b)
+        return self._fetch(dz), wlwd
+
+    def chunk_phi(self, obj, i, z, dz, a):
+        y, wt = self.data.chunk_scalars_sharded(i, self.mesh)
+        return self.ops.chunk_phi(obj, self._put(z), self._put(dz),
+                                  np.float32(a), y, wt)
+
+    def chunk_value_many(self, obj, W, b):
+        return self.ops.chunk_value_many(obj, W, b)
+
+    def finish(self, obj, w, acc):
+        return self.ops.finish(obj, w, acc)
+
+    def totals(self, tree) -> tuple:
+        return tuple(float(x) for x in self.ops.psum_tree(tree))
+
+    def values_total(self, acc) -> np.ndarray:
+        return np.asarray(self.ops.psum_tree(acc), np.float64)
+
+    def result_w(self, w):
+        # hand back a host-backed (uncommitted) array: downstream scoring
+        # and model assembly run on the default device, and a mesh-committed
+        # w would poison every eager op it meets with a device mismatch
+        return jnp.asarray(np.asarray(w))
+
+
+def _backend(data, mesh, prefetch: int):
+    if mesh is not None:
+        return _MeshStream(data, mesh, prefetch)
+    return _SingleDeviceStream(data, prefetch)
+
+
+def _check_streamable(obj, mesh) -> None:
+    if obj.axis_name is not None:
+        raise ValueError(
+            "streamed solves own their collective: Objective.axis_name must "
+            "be None (chunk partials are LOCAL sums; under a mesh the "
+            "streamed machinery issues exactly one psum per evaluation)")
+    if mesh is not None:
+        import jax as _jax
+
+        if not any(d.process_index == _jax.process_index()
+                   for d in mesh.devices.reshape(-1)):
+            raise ValueError(
+                "streamed mesh solve: no device in the mesh is addressable "
+                "from this process")
 
 
 class _History:
@@ -306,14 +579,24 @@ def minimize_lbfgs_streamed(
     tolerance: float = 1e-7,
     history: int = 10,
     max_ls_evals: int = 12,
+    mesh=None,
+    prefetch: int = 2,
 ) -> OptResult:
     """L-BFGS whose value+gradient accumulate over streamed device chunks —
     the treeAggregate-per-iteration execution regime, same math and same
-    convergence criteria as `optim.lbfgs.minimize_lbfgs_margin`."""
-    if obj.axis_name is not None:
-        raise ValueError("streamed solves are single-chip: Objective."
-                         "axis_name must be None")
+    convergence criteria as `optim.lbfgs.minimize_lbfgs_margin`. With
+    ``mesh=``, chunks row-shard over every mesh device and each evaluation
+    closes with one hierarchical psum (see the module docstring)."""
+    _check_streamable(obj, mesh)
+    be = _backend(data, mesh, prefetch)
     w = jnp.asarray(w0, jnp.float32)
+    if mesh is not None:
+        from photon_tpu.parallel.mesh import replicated
+
+        # solver state lives mesh-replicated so every derived array shares
+        # one device assignment (mixing mesh- and single-device-committed
+        # operands is an error in eager ops)
+        w = jax.device_put(w, replicated(mesh))
     d = w.shape[0]
     hist_st = _History(history, d)
     n_chunks = data.n_chunks
@@ -321,11 +604,10 @@ def minimize_lbfgs_streamed(
     # ---- initial pass: margins cached per chunk, (f, g) accumulated
     z_cache: list = [None] * n_chunks
     acc = None
-    for i, b in data.iter_device():
-        z, parts = _chunk_init(obj, w, b)
-        z_cache[i] = np.asarray(z)
+    for i, b in be.iter_chunks():
+        z_cache[i], parts = be.chunk_init(obj, w, b)
         acc = parts if acc is None else _acc(acc, parts)
-    f_dev, g = _finish(obj, w, acc)
+    f_dev, g = be.finish(obj, w, acc)
     f = float(f_dev)
     g0norm = float(jnp.linalg.norm(g))
 
@@ -348,27 +630,24 @@ def minimize_lbfgs_streamed(
 
         # ---- direction pass (feature stream 1 of 2): dz per chunk, with
         # the FIRST Wolfe trial's φ(a_init) partials riding along.
-        wl = wd = None
-        for i, b in data.iter_device():
-            dz, (wl_i, wd_i) = _chunk_dz_phi(obj, p, z_cache[i],
-                                             np.float32(a_init), b)
-            dz_cache[i] = np.asarray(dz)
-            wl = wl_i if wl is None else wl + wl_i
-            wd = wd_i if wd is None else wd + wd_i
+        phis = None
+        for i, b in be.iter_chunks():
+            dz_cache[i], wlwd = be.chunk_dz_phi(obj, p, z_cache[i],
+                                                a_init, b)
+            phis = wlwd if phis is None else _acc(phis, wlwd)
+        wl0, wd0 = be.totals(phis)
         rv, rd = reg_ray(a_init)
-        first_eval = (float(wl) + rv, float(wd) + rd)
+        first_eval = (wl0 + rv, wd0 + rd)
 
         def phi(a):
             """Streamed trial: 16 bytes/row of cached margins, no X."""
-            wl = wd = None
+            phis = None
             for i in range(n_chunks):
-                b = data.chunk(i)
-                wl_i, wd_i = _chunk_phi(obj, z_cache[i], dz_cache[i],
-                                        np.float32(a), b.y, b.weights)
-                wl = wl_i if wl is None else wl + wl_i
-                wd = wd_i if wd is None else wd + wd_i
+                wlwd = be.chunk_phi(obj, i, z_cache[i], dz_cache[i], a)
+                phis = wlwd if phis is None else _acc(phis, wlwd)
+            wl, wd = be.totals(phis)
             rv, rd = reg_ray(a)
-            return float(wl) + rv, float(wd) + rd
+            return wl + rv, wd + rd
 
         alpha, f_star, ok = _host_wolfe(phi, f, dphi0, a_init,
                                         max_ls_evals, first=first_eval)
@@ -382,14 +661,13 @@ def minimize_lbfgs_streamed(
                        and (it + 1) % _Z_REFRESH == 0)
             # ---- gradient pass (feature stream 2 of 2)
             acc = None
-            for i, b in data.iter_device():
+            for i, b in be.iter_chunks():
                 if refresh:  # re-anchor the chained margin on w (f32 drift)
-                    z, parts = _chunk_init(obj, w_new, b)
-                    z_cache[i] = np.asarray(z)
+                    z_cache[i], parts = be.chunk_init(obj, w_new, b)
                 else:
-                    parts = _chunk_grad_at_margin(obj, z_cache[i], b)
+                    parts = be.chunk_grad(obj, z_cache[i], b)
                 acc = parts if acc is None else _acc(acc, parts)
-            _, g_new = _finish(obj, w_new, acc)
+            _, g_new = be.finish(obj, w_new, acc)
             f_new = f_star  # the accepted trial's value, as the resident
             # margin solver uses it
             hist_st.push(w_new - w, g_new - g)
@@ -405,8 +683,8 @@ def minimize_lbfgs_streamed(
         w, g, f = w_new, g_new, f_new
         done = converged or not ok
 
-    return _result(w, f, float(jnp.linalg.norm(g)), it, converged, failed,
-                   hist, ghist)
+    return _result(be.result_w(w), f, float(jnp.linalg.norm(g)), it,
+                   converged, failed, hist, ghist)
 
 
 # --------------------------------------------------------- streamed OWL-QN
@@ -421,16 +699,23 @@ def minimize_owlqn_streamed(
     max_ls_evals: int = 20,
     reg_mask=None,
     ladder_lanes: int = 8,
+    mesh=None,
+    prefetch: int = 2,
 ) -> OptResult:
     """OWL-QN over streamed chunks. The projected backtracking ladder is
     evaluated `ladder_lanes` candidates per chunk stream (selecting the
     first passing rung == the resident solver's sequential halving, rung by
     rung), so the common iteration costs two feature streams: the ladder
-    pass and the accepted point's gradient pass."""
-    if obj.axis_name is not None:
-        raise ValueError("streamed solves are single-chip: Objective."
-                         "axis_name must be None")
+    pass and the accepted point's gradient pass. With ``mesh=``, chunks
+    row-shard over every mesh device; each ladder block and each gradient
+    pass still closes with one psum (see the module docstring)."""
+    _check_streamable(obj, mesh)
+    be = _backend(data, mesh, prefetch)
     w = jnp.asarray(w0, jnp.float32)
+    if mesh is not None:
+        from photon_tpu.parallel.mesh import replicated
+
+        w = jax.device_put(w, replicated(mesh))
     d = w.shape[0]
     l1 = np.float32(l1_weight)
     mask = (jnp.ones((d,), jnp.float32) if reg_mask is None
@@ -440,10 +725,10 @@ def minimize_owlqn_streamed(
 
     def value_grad_pass(w_at):
         acc = None
-        for _, b in data.iter_device():
-            _, parts = _chunk_init(obj, w_at, b)
+        for i, b in be.iter_chunks():
+            _, parts = be.chunk_init(obj, w_at, b)
             acc = parts if acc is None else _acc(acc, parts)
-        f_dev, g_at = _finish(obj, w_at, acc)
+        f_dev, g_at = be.finish(obj, w_at, acc)
         return float(f_dev), g_at
 
     f, g = value_grad_pass(w)
@@ -473,10 +758,11 @@ def minimize_owlqn_streamed(
             W, dec, l1t, rv = _owlqn_candidates(obj, w, p, xi,
                                                 alphas, pg, l1, mask)
             acc = None
-            for _, b in data.iter_device():
-                part = _chunk_value_many(obj, W, b)
-                acc = part if acc is None else acc + part
-            F_cand = np.asarray(acc + rv + l1t, np.float64)
+            for _, b in be.iter_chunks():
+                part = be.chunk_value_many(obj, W, b)
+                acc = part if acc is None else _acc(acc, part)
+            F_cand = (be.values_total(acc) + np.asarray(rv, np.float64)
+                      + np.asarray(l1t, np.float64))
             dec_np = np.asarray(dec, np.float64)
             for k in range(K):  # first passing rung == sequential halving
                 if (np.isfinite(F_cand[k]) and dec_np[k] < 0.0
@@ -505,5 +791,5 @@ def minimize_owlqn_streamed(
         w, g, f, F = w_new, g_new, f_new, F_new
         done = converged or not ok
 
-    return _result(w, F, float(_pg_norm(w, g, l1, mask)), it, converged,
-                   failed, hist, ghist)
+    return _result(be.result_w(w), F, float(_pg_norm(w, g, l1, mask)), it,
+                   converged, failed, hist, ghist)

@@ -146,3 +146,84 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pad_to_multiple(n: int, m: int) -> int:
     """Examples are padded (with weight 0) so shards are equal-size/static."""
     return ((n + m - 1) // m) * m
+
+
+# --------------------------------------------------------------------------
+# Row-slot helpers for the STREAMED mesh regime (optim/streamed.py): a host
+# chunk is split into one equal row slice per device slot — slot j of a
+# D-device mesh owns rows [j·s, (j+1)·s) of the (padded) chunk — and each
+# process device_puts only the slots its own devices own, so on multi-host
+# the features a process uploads are exactly its host-local row range and
+# never cross DCN. The per-chunk partial sums then accumulate device-local
+# and close with ONE hierarchical psum per evaluation: reduce over the ICI
+# axis inside the slice, one (d,) vector across DCN — the literal
+# treeAggregate shape of the docstring above, driven chunk by chunk.
+
+
+def flat_mesh_devices(mesh: Mesh) -> list:
+    """Mesh devices flattened in P(axis_names) shard order (row-major over
+    the axis grid) — slot j of this list owns row-shard j."""
+    return list(np.asarray(mesh.devices).reshape(-1))
+
+
+def local_row_slots(mesh: Mesh) -> list:
+    """Global device-slot indices owned by THIS process, in slot order."""
+    proc = jax.process_index()
+    return [j for j, d in enumerate(flat_mesh_devices(mesh))
+            if d.process_index == proc]
+
+
+def shard_rows(host, mesh: Mesh, pad_rows: int | None = None):
+    """Row-shard a host array over ALL mesh axes: per-slot host slices are
+    device_put straight onto their device (multi-host: local slots only —
+    other processes' rows are never touched) and assembled with
+    `make_array_from_single_device_arrays`. Rows pad with zeros to
+    ``pad_rows`` (default: the next device multiple) — zero rows carry
+    weight 0 in every GLMBatch, so no reduction sees them."""
+    host = np.asarray(host)
+    devices = flat_mesh_devices(mesh)
+    n_dev = len(devices)
+    n = host.shape[0]
+    n_pad = pad_to_multiple(max(n, 1), n_dev) if pad_rows is None \
+        else int(pad_rows)
+    s = n_pad // n_dev
+    tail = host.shape[1:]
+    arrays = []
+    for j in local_row_slots(mesh):
+        lo, hi = j * s, min((j + 1) * s, n)
+        if hi - lo == s:
+            buf = host[lo:hi]
+        else:
+            buf = np.zeros((s,) + tail, host.dtype)
+            if hi > lo:
+                buf[:hi - lo] = host[lo:hi]
+        arrays.append(jax.device_put(buf, devices[j]))
+    return jax.make_array_from_single_device_arrays(
+        (n_pad,) + tail, NamedSharding(mesh, P(tuple(mesh.axis_names))),
+        arrays)
+
+
+def shard_local_rows(local, mesh: Mesh):
+    """Re-shard a (n_local_slots, s, ...) host stack (the layout
+    `fetch_local_rows` returns — one row-slice per LOCAL device slot, in
+    slot order) back onto the mesh without touching other processes'
+    rows."""
+    local = np.asarray(local)
+    devices = flat_mesh_devices(mesh)
+    slots = local_row_slots(mesh)
+    s = local.shape[1]
+    arrays = [jax.device_put(local[k], devices[j])
+              for k, j in enumerate(slots)]
+    return jax.make_array_from_single_device_arrays(
+        (len(devices) * s,) + local.shape[2:],
+        NamedSharding(mesh, P(tuple(mesh.axis_names))), arrays)
+
+
+def fetch_local_rows(arr, mesh: Mesh) -> np.ndarray:
+    """The inverse of `shard_local_rows`: this process's row shards of a
+    P(axes)-sharded array as one (n_local_slots, s, ...) numpy stack in
+    slot order — the host-side cache layout of the streamed solvers'
+    margin chains."""
+    shards = sorted(arr.addressable_shards,
+                    key=lambda sh: sh.index[0].start or 0)
+    return np.stack([np.asarray(sh.data) for sh in shards])

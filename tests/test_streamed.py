@@ -266,15 +266,20 @@ class TestStreamedSolvers:
         with pytest.raises(ValueError, match="TRON"):
             train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
 
-    def test_grid_and_mesh_rejected(self, rng, mesh8):
+    def test_grid_rejected_mesh_dispatches(self, rng, mesh8):
+        """The lane grid still refuses ChunkedBatch (every lane would
+        multiply the host stream), but a mesh now DISPATCHES to the
+        sharded streamed solve (tests/test_streamed_mesh.py pins its
+        parity) instead of raising."""
         cb = chunk_batch(_problem(rng, TaskType.LOGISTIC_REGRESSION, n=256),
                          128)
-        cfg = OptimizerConfig(reg=l2(), reg_weight=0.1)
+        cfg = OptimizerConfig(max_iters=10, reg=l2(), reg_weight=0.1)
         with pytest.raises(ValueError, match="sequential"):
             train_glm_grid(cb, TaskType.LOGISTIC_REGRESSION, cfg,
                            [0.1, 1.0])
-        with pytest.raises(ValueError, match="single-chip"):
-            train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg, mesh=mesh8)
+        model, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg,
+                               mesh=mesh8)
+        assert np.isfinite(np.asarray(model.coefficients.means)).all()
 
 
 # ------------------------------------------------------------------ driver
@@ -367,6 +372,28 @@ class TestStreamedDriver:
             np.asarray(b.best.model.coordinates["perUser"].coefficients),
             np.asarray(a.best.model.coordinates["perUser"].coefficients),
             rtol=5e-3, atol=5e-4)
+
+    def test_forced_streamed_with_mesh_matches_resident(
+            self, streamed_job, tmp_path, mesh8):
+        """The whole driver pipeline with a mesh + streamed objective: the
+        fixed shard's chunks row-shard across the mesh (the pod-scale
+        treeAggregate), RE shards stay resident, and the fit matches the
+        resident single-device driver."""
+        from photon_tpu.drivers import run_training
+
+        a = run_training(_params(streamed_job, tmp_path / "resident",
+                                 streaming=False, streamed_objective=False))
+        b = run_training(_params(streamed_job, tmp_path / "mesh_streamed",
+                                 streamed_objective=True,
+                                 objective_chunk_rows=100,
+                                 streaming_chunk_rows=128), mesh=mesh8)
+        assert b.best.validation_score == pytest.approx(
+            a.best.validation_score, abs=5e-3)
+        wa = np.asarray(
+            a.best.model.coordinates["fixed"].model.coefficients.means)
+        wb = np.asarray(
+            b.best.model.coordinates["fixed"].model.coefficients.means)
+        np.testing.assert_allclose(wb, wa, rtol=5e-3, atol=5e-4)
 
     def test_auto_trip_on_tiny_budget(self, streamed_job, tmp_path,
                                       monkeypatch):
