@@ -40,7 +40,24 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+# --check-contracts: trace the full photon_tpu.analysis contract registry
+# and exit — a no-op guard proving every benchmarked hot path still holds
+# its communication/dtype/transfer/retrace contracts, runnable anywhere
+# (CI pins `JAX_PLATFORMS=cpu python bench.py --check-contracts`). The
+# platform env must be set BEFORE jax initializes, hence before the
+# imports below.
+if "--check-contracts" in sys.argv:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and \
+            "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count"
+                                   "=8").strip()
 
 import jax
 import jax.numpy as jnp
@@ -260,7 +277,24 @@ def run_dense(batch, grid_weights) -> float:
     return D_ROWS * iters / best
 
 
+def check_contracts() -> int:
+    """Trace-only registry check (no benchmark legs, no compiles): exit 0
+    iff every hot-path contract holds. See photon_tpu/analysis."""
+    from photon_tpu.analysis.contracts import check_registry
+    from photon_tpu.analysis.registry import load_registry
+
+    report = check_registry(load_registry())
+    violations = [v for entry in report.values()
+                  for v in entry.get("violations", [])]
+    print(json.dumps({"metric": "analysis_contracts", "ok": not violations,
+                      "n_specs": len(report),
+                      "n_violations": len(violations)}))
+    return 1 if violations else 0
+
+
 def main() -> None:
+    if "--check-contracts" in sys.argv:
+        raise SystemExit(check_contracts())
     batch = sparse_problem()
     grid_value = run_sparse_grid(batch)
     single_value = run_sparse(batch)

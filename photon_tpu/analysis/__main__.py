@@ -1,0 +1,98 @@
+"""CLI: trace every registered hot-path contract and report violations.
+
+    python -m photon_tpu.analysis            # human report, exit 1 on drift
+    python -m photon_tpu.analysis --json     # machine report (one object)
+    python -m photon_tpu.analysis --list     # names + budgets only
+    python -m photon_tpu.analysis --tag mesh-streamed --only NAME ...
+
+Runs trace-only (jax.make_jaxpr): no lowering, no compile, no device
+programs — safe anywhere, including CI under JAX_PLATFORMS=cpu (bench.py's
+``--check-contracts`` guard runs exactly this). The environment defaults
+below mirror tests/conftest.py's virtual 8-device CPU platform so mesh
+contracts trace the same topology CI pins, and MUST run before jax loads.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    """conftest.py's platform defaults, applied only where unset."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    list_only = "--list" in argv
+    tags: list = []
+    only: list = []
+    it = iter(argv)
+    for a in it:
+        if a == "--tag":
+            tags.append(next(it))
+        elif a == "--only":
+            only.append(next(it))
+
+    _default_env()
+    import json
+
+    from photon_tpu.analysis.contracts import check_registry
+    from photon_tpu.analysis.registry import load_registry
+
+    specs = load_registry()
+    if only:
+        missing = sorted(set(only) - set(specs))
+        if missing:
+            print(f"unknown contract(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            return 2
+        specs = {k: v for k, v in specs.items() if k in only}
+
+    if list_only:
+        for name in sorted(specs):
+            s = specs[name]
+            if tags and not (set(tags) & set(s.tags)):
+                continue
+            budget = dict(s.collectives or {})
+            print(f"{name:40s} tags={','.join(s.tags) or '-':28s} "
+                  f"collectives={budget or 'none'}")
+        return 0
+
+    report = check_registry(specs, tags=tuple(tags) or None)
+    violations = [v for entry in report.values()
+                  for v in entry.get("violations", [])]
+    if as_json:
+        print(json.dumps({
+            "ok": not violations,
+            "n_specs": len(report),
+            "n_violations": len(violations),
+            "specs": report,
+        }))
+        return 1 if violations else 0
+
+    for name, entry in report.items():
+        colls = entry.get("collectives", {})
+        head = (f"{name}: {entry.get('eqns', '?')} eqns, "
+                f"collectives={colls or 'none'}, "
+                f"consts={entry.get('const_bytes', 0) / 1e3:.1f} kB, "
+                f"loop_depth={entry.get('max_loop_depth', 0)}")
+        marks = entry["violations"]
+        print(("FAIL " if marks else "ok   ") + head)
+        for v in marks:
+            loc = f"  [at {v['where']}]" if v.get("where") else ""
+            print(f"     !! ({v['rule']}) {v['message']}{loc}")
+    n = len(violations)
+    print(f"{len(report)} contract(s) checked, "
+          f"{n} violation(s)" + ("" if n else " — all hot paths hold"))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

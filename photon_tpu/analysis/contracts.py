@@ -1,0 +1,151 @@
+"""ContractSpec: a hot-path program builder + its declared performance
+budgets, and the engine that traces and checks one.
+
+Hot paths register their own specs NEXT TO the code they pin (the bottom
+of optim/streamed.py, models/training.py, ops/objective.py,
+parallel/mesh.py, game/*.py, drivers/score.py) via `register_contract`, so
+a change to a hot path and the contract it must keep land in the same
+diff. `photon_tpu.analysis.registry` imports those modules and hands the
+collected registry to the CLI (`python -m photon_tpu.analysis`) and the
+tier-1 contract tests (tests/test_analysis_contracts.py).
+
+A spec's ``build`` thunk returns ``(fn, example_args)``; checking traces
+``jax.make_jaxpr(fn)(*example_args)`` — tracing only, no lowering, no
+compile, no device program — and runs every rule in `rules.RULES` against
+the jaxpr. Builders must therefore construct example arguments directly
+(zeros of the right shape are fine: contracts are shape/dtype/structure
+facts, not value facts) and never execute jitted programs to produce them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Optional
+
+import jax
+
+from photon_tpu.analysis import walker
+from photon_tpu.analysis.rules import RULES, TracedContract, Violation
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractSpec:
+    """One hot-path program and the performance law it must obey.
+
+    collectives: exact per-primitive collective budget (e.g.
+        ``{"psum": 1}`` — ONE psum per evaluation); any collective not
+        named budgets to ZERO. None is shorthand for {} (communication-
+        free).
+    forbid: extra primitives that must not appear at all (e.g. the
+        scatter family on the permuted scatter-free layouts).
+    max_const_bytes: baked-constant budget (rule 4).
+    allow_transfers / allow_f64 / allow_weak_args: opt-outs for rules
+        2/3/5 — default is the strict policy.
+    tags: workload families for filtering/reporting ("resident",
+        "streamed", "mesh-streamed", "lane", "game", ...).
+    """
+
+    name: str
+    build: Callable[[], tuple]
+    description: str = ""
+    collectives: Optional[Mapping[str, int]] = None
+    forbid: frozenset = frozenset()
+    max_const_bytes: int = 1 << 20
+    allow_transfers: bool = False
+    allow_f64: bool = False
+    allow_weak_args: bool = False
+    tags: tuple = ()
+
+
+# name -> ContractSpec; populated at import time by the hot-path modules.
+REGISTRY: dict[str, ContractSpec] = {}
+
+
+def register_contract(name: str, *, description: str = "",
+                      collectives: Optional[Mapping[str, int]] = None,
+                      forbid=frozenset(), max_const_bytes: int = 1 << 20,
+                      allow_transfers: bool = False, allow_f64: bool = False,
+                      allow_weak_args: bool = False, tags: tuple = ()):
+    """Decorator: register the decorated zero-arg builder as ``name``.
+
+    ::
+
+        @register_contract(name="streamed_mesh_finish",
+                           collectives={"psum": 1}, tags=("mesh-streamed",))
+        def _contract_finish():
+            return fn, (obj, w, parts)
+    """
+
+    def wrap(build: Callable[[], tuple]):
+        spec = ContractSpec(
+            name=name, build=build, description=description,
+            collectives=collectives, forbid=frozenset(forbid),
+            max_const_bytes=max_const_bytes,
+            allow_transfers=allow_transfers, allow_f64=allow_f64,
+            allow_weak_args=allow_weak_args, tags=tuple(tags))
+        if name in REGISTRY:
+            raise ValueError(f"duplicate contract name: {name!r}")
+        REGISTRY[name] = spec
+        return build
+
+    return wrap
+
+
+def trace_contract(spec: ContractSpec) -> TracedContract:
+    """Build and trace one spec (no compile — see module docstring)."""
+    fn, args = spec.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    return TracedContract(spec=spec, closed_jaxpr=closed, example_args=args)
+
+
+def check_contract(spec: ContractSpec,
+                   traced: Optional[TracedContract] = None
+                   ) -> list[Violation]:
+    """All rule violations of one spec (empty == contract holds)."""
+    t = traced if traced is not None else trace_contract(spec)
+    out: list[Violation] = []
+    for rule in RULES.values():
+        out.extend(rule(t))
+    return out
+
+
+def summarize(t: TracedContract) -> dict:
+    """Per-program facts for the report: size, communication pattern,
+    const payload, loop nesting."""
+    all_sites = list(walker.sites(t.closed_jaxpr))
+    return {
+        "eqns": len(all_sites),
+        "collectives": dict(sorted(
+            walker.collective_counts(t.closed_jaxpr).items())),
+        "const_bytes": walker.const_bytes(t.closed_jaxpr),
+        "max_loop_depth": max((s.loop_depth for s in all_sites), default=0),
+    }
+
+
+def check_registry(specs: Optional[Mapping[str, ContractSpec]] = None,
+                   tags: Optional[tuple] = None) -> dict:
+    """Trace + check every spec; returns name -> {spec facts, violations}.
+
+    A builder or trace that ERRORS is itself reported as a violation of
+    that spec (a contract you can no longer even trace has drifted).
+    """
+    specs = dict(REGISTRY if specs is None else specs)
+    report: dict = {}
+    for name in sorted(specs):
+        spec = specs[name]
+        if tags and not (set(tags) & set(spec.tags)):
+            continue
+        entry: dict = {"description": spec.description,
+                       "tags": list(spec.tags)}
+        try:
+            traced = trace_contract(spec)
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            entry["violations"] = [Violation(
+                "trace-error", name,
+                f"builder/trace failed: {type(e).__name__}: {e}").to_json()]
+            report[name] = entry
+            continue
+        entry.update(summarize(traced))
+        entry["violations"] = [v.to_json()
+                               for v in check_contract(spec, traced)]
+        report[name] = entry
+    return report

@@ -1,0 +1,33 @@
+"""The central contract registry: importing this module imports every
+hot-path module, which registers its ContractSpecs into
+`photon_tpu.analysis.contracts.REGISTRY` as a side effect of import (each
+spec lives at the bottom of the module whose program it pins — a hot-path
+change and its contract change land in the same diff).
+
+Everything here is import + registration only; nothing traces until
+`load_registry()`'s caller asks `contracts.check_registry` to.
+"""
+from __future__ import annotations
+
+import importlib
+
+# Every module that registers ContractSpecs. Order is import order only;
+# the registry itself is a flat name -> spec mapping.
+HOT_PATH_MODULES = (
+    "photon_tpu.ops.objective",       # resident evaluation + trial programs
+    "photon_tpu.parallel.mesh",       # shard_map value_and_grad (1-D, hybrid)
+    "photon_tpu.models.training",     # resident/lane solvers, sharded hybrids
+    "photon_tpu.optim.streamed",      # streamed + mesh-streamed chunk regime
+    "photon_tpu.game.random_effect",  # vmapped per-entity lane solves
+    "photon_tpu.game.coordinate_descent",  # fused GAME coordinate update
+    "photon_tpu.drivers.score",       # chunked scoring driver program
+)
+
+
+def load_registry() -> dict:
+    """Import all hot-path modules and return {name: ContractSpec}."""
+    for mod in HOT_PATH_MODULES:
+        importlib.import_module(mod)
+    from photon_tpu.analysis.contracts import REGISTRY
+
+    return dict(REGISTRY)

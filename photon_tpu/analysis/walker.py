@@ -1,0 +1,149 @@
+"""Recursive jaxpr walker: the one traversal every contract rule shares.
+
+photon-tpu's performance invariants (one psum per evaluation, no transfers
+inside hot loops, f32 accumulation, no captured-scalar retraces) live in
+the traced program, not in any single source file — so the checker walks
+the jaxpr IR, the XLA analog of the reference Photon-ML auditing its Spark
+plans for shuffle boundaries. The walker descends into every sub-jaxpr an
+equation carries (`scan`/`while`/`cond` branches, `pjit`, `shard_map`,
+`custom_vjp`/`custom_jvp`, remat, ...): any param value that IS a jaxpr —
+or a tuple/list of them, as `cond`'s ``branches`` is — is recursed into,
+so new higher-order primitives are covered without enumeration.
+
+Counting collectives HERE, at trace level, is deliberately backend-
+independent: the CPU test backend's missing all-reduce combiner splits one
+variadic `lax.psum` into several compiled ``all-reduce`` HLO ops, which is
+a lowering detail — the contract is the single psum *equation*
+(tests/test_multihost.py pins exactly this).
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+from jax.core import ClosedJaxpr, Jaxpr
+
+# Cross-device communication primitives (jax.lax.parallel binds).
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "pgather", "psum_invariant",
+})
+
+# Primitives that move data across the host/device boundary (or call back
+# into Python) from INSIDE a traced program.
+TRANSFER_PRIMITIVES = frozenset({
+    "device_put", "pure_callback", "io_callback", "callback",
+    "debug_callback",
+})
+
+# Combining scatters: the measured TPU wall the permuted layouts eliminate
+# by construction (~12 ns/element scatter-add vs ~7 ns/index gather,
+# docs/PERF.md) — pinned via `ContractSpec.forbid` on scatter-free paths.
+SCATTER_ADD_PRIMITIVES = frozenset({
+    "scatter-add", "scatter-mul", "scatter-min", "scatter-max",
+})
+
+# The full family. NOTE: `.at[i].set(x)` with a scalar index traces to a
+# plain `scatter` equation that XLA lowers to dynamic-update-slice, so
+# whole-SOLVER programs forbid only SCATTER_ADD_PRIMITIVES (the
+# performance fact), while single-evaluation programs can forbid the full
+# family.
+SCATTER_PRIMITIVES = SCATTER_ADD_PRIMITIVES | frozenset({
+    "scatter", "scatter_apply",
+})
+
+# Bodies of these run many times per dispatch: a transfer inside is a
+# per-iteration stall, not a one-off.
+LOOP_PRIMITIVES = frozenset({"scan", "while"})
+
+
+def as_jaxpr(jaxpr) -> Jaxpr:
+    """The underlying Jaxpr of a ClosedJaxpr (identity on a plain Jaxpr)."""
+    return jaxpr.jaxpr if isinstance(jaxpr, ClosedJaxpr) else jaxpr
+
+
+def sub_jaxprs(eqn) -> Iterator:
+    """Every jaxpr carried by one equation's params, in param order.
+
+    Yields ClosedJaxpr | Jaxpr. Handles scalar params (`pjit`/`scan`'s
+    ``jaxpr``, `while`'s ``cond_jaxpr``/``body_jaxpr``, `shard_map`'s body,
+    `custom_vjp_call_jaxpr`'s ``fun_jaxpr``) and sequence params (`cond`'s
+    ``branches``) uniformly.
+    """
+    for v in eqn.params.values():
+        for u in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(u, (ClosedJaxpr, Jaxpr)):
+                yield u
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One equation plus where the walk found it."""
+
+    eqn: object
+    path: tuple[str, ...]  # primitive names of the enclosing eqns
+    loop_depth: int  # enclosing scan/while bodies (×N execution)
+
+    @property
+    def name(self) -> str:
+        return self.eqn.primitive.name
+
+    @property
+    def where(self) -> str:
+        return "/".join(self.path + (self.name,))
+
+
+def sites(jaxpr, _path: tuple = (), _loops: int = 0) -> Iterator[Site]:
+    """Depth-first walk over every equation of ``jaxpr`` and all its
+    sub-jaxprs. Accepts a ClosedJaxpr or Jaxpr."""
+    for eqn in as_jaxpr(jaxpr).eqns:
+        yield Site(eqn, _path, _loops)
+        name = eqn.primitive.name
+        deeper = _loops + (1 if name in LOOP_PRIMITIVES else 0)
+        for sub in sub_jaxprs(eqn):
+            yield from sites(sub, _path + (name,), deeper)
+
+
+def count_primitives(jaxpr, names: Optional[Iterable[str]] = None) -> Counter:
+    """Occurrence count per primitive name over the whole recursive walk;
+    ``names`` restricts the census (None counts everything)."""
+    wanted = None if names is None else frozenset(names)
+    out: Counter = Counter()
+    for site in sites(jaxpr):
+        if wanted is None or site.name in wanted:
+            out[site.name] += 1
+    return out
+
+
+def collective_counts(jaxpr) -> Counter:
+    """How many of each collective primitive the program traces to —
+    the jaxpr-level communication pattern (see module docstring for why
+    this, not compiled-HLO text, is the pinnable quantity)."""
+    return count_primitives(jaxpr, COLLECTIVE_PRIMITIVES)
+
+
+def collective_sites(jaxpr) -> list[Site]:
+    return [s for s in sites(jaxpr) if s.name in COLLECTIVE_PRIMITIVES]
+
+
+def iter_consts(jaxpr, _path: tuple = ()) -> Iterator[tuple]:
+    """(const, path) for every constant baked into ``jaxpr`` or any
+    sub-ClosedJaxpr (sub-jaxpr consts are usually hoisted, but remat and
+    custom-derivative wrappers can keep their own)."""
+    if isinstance(jaxpr, ClosedJaxpr):
+        for c in jaxpr.consts:
+            yield c, _path
+    for eqn in as_jaxpr(jaxpr).eqns:
+        for sub in sub_jaxprs(eqn):
+            yield from iter_consts(sub, _path + (eqn.primitive.name,))
+
+
+def const_bytes(jaxpr) -> int:
+    """Total bytes of baked-in constants — silent HBM + compile-time
+    payload shipped with every executable of this program."""
+    total = 0
+    for c, _ in iter_consts(jaxpr):
+        total += getattr(c, "nbytes", None) or np.asarray(c).nbytes
+    return total

@@ -398,6 +398,43 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     return ScoringOutput(scores, out_path, metric, metrics)
 
 
+# ----------------------------------------------------------------- contracts
+# The chunked scoring pipeline's hot device program (fixed-effect matvec +
+# per-row random-effect gather/dot + offsets sum, per padded chunk): the
+# software pipeline only overlaps host decode with device compute if the
+# program itself never exits to host — photon_tpu/analysis enforces that,
+# plus zero collectives/f64 and an empty const payload, on every PR.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+@register_contract(
+    name="driver_scoring_chunk",
+    description="the scoring driver's per-chunk device program: offsets + "
+                "fixed-effect margin + random-effect rowwise gather-dot, "
+                "no collectives, no host exits, nothing baked in",
+    collectives={}, tags=("game", "driver"))
+def _contract_driver_scoring_chunk():
+    import jax.numpy as jnp
+
+    from photon_tpu.data.matrix import matvec
+    from photon_tpu.game.model import _padded_coeffs, score_rows
+
+    n, d, k, E = 32, 10, 3, 4
+    rng = np.random.default_rng(0)
+    X = SparseRows(rng.integers(0, d, size=(n, k)).astype(np.int32),
+                   rng.normal(size=(n, k)).astype(np.float32), d)
+    offsets = jnp.zeros((n,), jnp.float32)
+    w_fixed = jnp.zeros((d,), jnp.float32)
+    coeffs = jnp.zeros((E, d), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, E + 1, size=n).astype(np.int32))
+
+    def program(offs, Xs, wf, C, dense_ids):
+        return offs + matvec(Xs, wf) + score_rows(
+            Xs, _padded_coeffs(C, dense_ids))
+
+    return program, (offsets, X, w_fixed, coeffs, ids)
+
+
 def main(argv=None) -> None:
     import argparse
 

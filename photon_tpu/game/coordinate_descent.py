@@ -255,3 +255,46 @@ def coordinate_descent(
     return CoordinateDescentResult(
         GameModel(ordered, task), objective_history, coordinate_stats
     )
+
+
+# ----------------------------------------------------------------- contracts
+# The GAME descent loop's ≤1-dispatch-per-update claim rests on
+# _fused_fixed_update being one clean device program: no collectives, no
+# host exits, f32 accumulation, nothing baked into the trace
+# (photon_tpu/analysis enforces it statically on every PR).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+@register_contract(
+    name="game_fixed_update",
+    description="the fused fixed-effect coordinate update: offsets sum + "
+                "full L-BFGS solve + margins + objective as ONE device "
+                "program with zero communication and zero host exits",
+    collectives={}, tags=("game",))
+def _contract_game_fixed_update():
+    import numpy as np
+
+    from photon_tpu.data.dataset import GLMBatch
+    from photon_tpu.models.training import (_static_config, make_objective)
+    from photon_tpu.models.variance import VarianceComputationType
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    n, d = 32, 6
+    rng = np.random.default_rng(0)
+    task = TaskType.LOGISTIC_REGRESSION
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.4, history=3)
+    obj = make_objective(task, cfg, d)
+    batch = GLMBatch(
+        X=jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)),
+        y=jnp.asarray((rng.uniform(size=n) < 0.5).astype(np.float32)),
+        weights=jnp.ones((n,), jnp.float32),
+        offsets=jnp.zeros((n,), jnp.float32))
+    base = jnp.zeros((n,), jnp.float32)
+    scores = (jnp.zeros((n,), jnp.float32),)  # one other coordinate
+    w0 = jnp.zeros((d,), jnp.float32)
+    fn = lambda b, bs, sc, w, o, y, wt: _fused_fixed_update(  # noqa: E731
+        b, bs, sc, w, o, None, y, wt, _static_config(cfg), task,
+        VarianceComputationType.NONE)
+    return fn, (batch, base, scores, w0, obj, batch.y, batch.weights)

@@ -445,3 +445,35 @@ def _fused_re_fn(solver_fns, meta: tuple, task, variance):
     fn = jax.jit(run)
     _FUSED_RE[key] = fn
     return fn
+
+
+# ----------------------------------------------------------------- contracts
+# The vmapped per-entity solve block — the "lane" workload (one whole
+# L-BFGS while_loop per entity lane, batched): every lane is device-local,
+# so the block is communication-free, f32, and host-exit-free end to end
+# (photon_tpu/analysis traces and enforces this on every PR).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+@register_contract(
+    name="game_re_vmapped_solve",
+    description="one random-effect bucket's vmapped per-entity L-BFGS "
+                "solves: E lanes, zero communication, no transfers inside "
+                "the vmapped while_loop",
+    collectives={}, tags=("game", "lane"))
+def _contract_re_vmapped_solve():
+    from photon_tpu.data.dataset import GLMBatch
+    from photon_tpu.optim.regularization import l2
+
+    E, m, d = 4, 16, 5
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.3, history=3)
+    raw = _re_solver(False, _static_config(cfg),
+                     VarianceComputationType.NONE)[1]
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d)
+    batch = GLMBatch(X=jnp.zeros((E, m, d), jnp.float32),
+                     y=jnp.zeros((E, m), jnp.float32),
+                     weights=jnp.ones((E, m), jnp.float32),
+                     offsets=jnp.zeros((E, m), jnp.float32))
+    w0 = jnp.zeros((E, d), jnp.float32)
+    return (lambda o, b, w: raw(o, None, b, w)), (obj, batch, w0)

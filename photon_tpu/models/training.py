@@ -82,7 +82,10 @@ def make_objective(
             norm_shifts = jnp.asarray(normalization.shifts, jnp.float32)
     return Objective(
         task=task,
-        l2=config.reg.l2_weight(config.reg_weight),
+        # np.float32, NOT the raw Python float: a weak-typed scalar leaf
+        # would make jit's cache key differ between scalar and array
+        # callers (the analysis retrace-hazard rule pins this canon).
+        l2=np.float32(config.reg.l2_weight(config.reg_weight)),
         axis_name=axis_name,
         fused=fused,
         reg_mask=reg_mask,
@@ -804,3 +807,170 @@ def train_glm(
             var = jnp.asarray(norm.variances_to_original_space(np.asarray(var)))
     model = GeneralizedLinearModel(Coefficients(w_out, var), task)
     return model, res
+
+
+# ----------------------------------------------------------------- contracts
+# Static-analysis contracts for this module's solver programs (see
+# photon_tpu/analysis): the full resident L-BFGS program and the lane-minor
+# grid are communication-free on one device; the sharded hybrid/permuted
+# solves close each evaluation with ONE psum; the permuted layout is
+# additionally scatter-free BY CONSTRUCTION (the round-5 measured wall —
+# ~12 ns/element TPU scatter-adds — cannot regress silently).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import (  # noqa: E402
+    SCATTER_ADD_PRIMITIVES,
+    SCATTER_PRIMITIVES,
+)
+
+
+def _contract_cfg(**kw):
+    from photon_tpu.optim.regularization import l2
+
+    kw.setdefault("max_iters", 6)
+    kw.setdefault("tolerance", 1e-7)
+    kw.setdefault("reg", l2())
+    kw.setdefault("history", 4)
+    return OptimizerConfig(**kw)
+
+
+def _contract_dense_batch(n=64, d=8):
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=(n, d)).astype(np.float32),
+            (rng.uniform(size=n) < 0.5).astype(np.float32))
+
+
+def _contract_sparse_batch(n, d, k=4):
+    from photon_tpu.data.dataset import make_batch
+
+    rng = np.random.default_rng(0)
+    ind = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    return make_batch(SparseRows(ind, val, d), y)
+
+
+@register_contract(
+    name="resident_lbfgs_solve",
+    description="the whole jitted margin-cached L-BFGS solve+variance "
+                "program (_train_run): single device, zero communication, "
+                "no host exits anywhere in the solver loop",
+    collectives={}, tags=("resident",))
+def _contract_resident_lbfgs_solve():
+    from photon_tpu.data.dataset import make_batch
+
+    X, y = _contract_dense_batch()
+    cfg = _contract_cfg(reg_weight=0.5)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, X.shape[1])
+    fn = lambda b, w, o: _train_run(  # noqa: E731
+        b, w, o, None, _static_config(cfg), VarianceComputationType.NONE)
+    return fn, (make_batch(X, y), jnp.zeros((X.shape[1],), jnp.float32),
+                obj)
+
+
+@register_contract(
+    name="resident_grid_lanes",
+    description="the lane-minor reg-weight grid (_train_run_grid_lanes): "
+                "G lock-step lanes, one program, zero communication",
+    collectives={}, tags=("resident", "lane"))
+def _contract_resident_grid_lanes():
+    from photon_tpu.data.dataset import make_batch
+
+    X, y = _contract_dense_batch()
+    cfg = _contract_cfg(reg_weight=0.0)
+    l2s, l1s, static_cfg = lane_weight_arrays(cfg, [0.1, 1.0])
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, X.shape[1])
+    fn = lambda b, w, o, l2v: _train_run_grid_lanes(  # noqa: E731
+        b, w, o, l2v, None, static_cfg)
+    return fn, (make_batch(X, y), jnp.zeros((X.shape[1],), jnp.float32),
+                obj, l2s)
+
+
+def _contract_sharded_vg(batch, mesh):
+    axes = tuple(mesh.axis_names)
+    batch_spec = _hybrid_specs(batch.X, axes)
+
+    def vg(obj, b, w):
+        def body(obj, b, w):
+            return obj.value_and_grad(w, b._replace(X=b.X.local()))
+
+        obj_spec = jax.tree_util.tree_map(lambda _: P(), obj)
+        return shard_map(body, mesh=mesh,
+                         in_specs=(obj_spec, batch_spec, P()),
+                         out_specs=(P(), P()))(obj, b, w)
+
+    return vg
+
+
+@register_contract(
+    name="sharded_hybrid_value_and_grad",
+    description="ShardedHybridRows shard_map evaluation: ONE psum, and the "
+                "per-shard tail provably never crosses devices (no gather/"
+                "scatter collectives)",
+    collectives={"psum": 1}, tags=("resident", "mesh"))
+def _contract_sharded_hybrid_value_and_grad():
+    from photon_tpu.data.dataset import shard_hybrid_batch
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    d = 64
+    batch = shard_hybrid_batch(_contract_sparse_batch(16 * n_sh, d), n_sh,
+                               d_dense=16)
+    cfg = _contract_cfg(reg_weight=0.5)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
+                         axis_name=mesh.axis_names[0])
+    return _contract_sharded_vg(batch, mesh), \
+        (obj, batch, jnp.zeros((d,), jnp.float32))
+
+
+@register_contract(
+    name="sharded_permuted_value_and_grad",
+    description="ShardedPermutedHybridRows shard_map evaluation: ONE psum "
+                "and ZERO scatter ops — the scatter-free layout holds on "
+                "the mesh path",
+    collectives={"psum": 1}, forbid=SCATTER_PRIMITIVES,
+    tags=("resident", "mesh"))
+def _contract_sharded_permuted_value_and_grad():
+    from photon_tpu.data.dataset import shard_permuted_batch
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    d = 96
+    batch = shard_permuted_batch(_contract_sparse_batch(16 * n_sh, d),
+                                 n_sh, d_dense=16)
+    cfg = _contract_cfg(reg_weight=0.5)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
+                         axis_name=mesh.axis_names[0],
+                         intercept_index=batch.X.last_col_pos)
+    return _contract_sharded_vg(batch, mesh), \
+        (obj, batch, jnp.zeros((d,), jnp.float32))
+
+
+@register_contract(
+    name="sharded_permuted_grid_lanes",
+    description="the FULL sharded lane-grid solver program "
+                "(_train_run_sharded_grid_lanes on ShardedPermutedHybrid"
+                "Rows): no combining scatters anywhere (history writes "
+                "are .at[i].set -> dynamic-update-slice), and exactly 3 "
+                "psum eqns — the init value+grad, the line-search trial's "
+                "phi (inner while), the accepted step's grad (outer while)",
+    collectives={"psum": 3}, forbid=SCATTER_ADD_PRIMITIVES,
+    tags=("resident", "mesh", "lane"))
+def _contract_sharded_permuted_grid_lanes():
+    from photon_tpu.data.dataset import shard_permuted_batch
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    d = 96
+    batch = shard_permuted_batch(_contract_sparse_batch(16 * n_sh, d),
+                                 n_sh, d_dense=16)
+    cfg = _contract_cfg(reg_weight=0.0)
+    l2s, l1s, static_cfg = lane_weight_arrays(cfg, [0.1, 1.0])
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
+                         axis_name=mesh.axis_names[0],
+                         intercept_index=batch.X.last_col_pos)
+    fn = lambda b, w, o, l2v: _train_run_sharded_grid_lanes(  # noqa: E731
+        b, w, o, l2v, None, static_cfg, mesh)
+    return fn, (batch, jnp.zeros((d,), jnp.float32), obj, l2s)

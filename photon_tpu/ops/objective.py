@@ -421,3 +421,77 @@ jax.tree_util.register_dataclass(
                  "prior_full_precision", "norm_factors", "norm_shifts"],
     meta_fields=["task", "axis_name", "fused"],
 )
+
+
+# ----------------------------------------------------------------- contracts
+# Static-analysis contracts for this module's hot programs (registered next
+# to the code they pin; traced and enforced by `python -m
+# photon_tpu.analysis` and tests/test_analysis_contracts.py). Builders run
+# only when the checker traces them — module import just records the spec.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+def _contract_batch(n=64, d=8, feature_dtype=None):
+    import numpy as np
+
+    from photon_tpu.data.dataset import cast_features, make_batch
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = make_batch(X, y)
+    if feature_dtype is not None:
+        batch = cast_features(batch, feature_dtype)
+    return batch
+
+
+def _contract_objective():
+    import numpy as np
+
+    # l2 as np.float32, matching models.training.make_objective's canon:
+    # a Python-float leaf is weak-typed and the retrace-hazard rule
+    # (rightly) rejects it.
+    return Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.4))
+
+
+@register_contract(
+    name="resident_value_and_grad",
+    description="single-device Objective.value_and_grad: communication-"
+                "free, transfer-free, f32 throughout",
+    collectives={}, tags=("resident",))
+def _contract_resident_value_and_grad():
+    batch = _contract_batch()
+    obj = _contract_objective()
+    w = jnp.zeros((8,), jnp.float32)
+    return (lambda o, wv, b: o.value_and_grad(wv, b)), (obj, w, batch)
+
+
+@register_contract(
+    name="resident_value_and_grad_bf16",
+    description="value_and_grad on bf16 features: every contraction "
+                "accumulates f32 (the MXU policy the dtype rule enforces)",
+    collectives={}, tags=("resident",))
+def _contract_resident_value_and_grad_bf16():
+    batch = _contract_batch(feature_dtype=jnp.bfloat16)
+    obj = _contract_objective()
+    w = jnp.zeros((8,), jnp.float32)
+    return (lambda o, wv, b: o.value_and_grad(wv, b)), (obj, w, batch)
+
+
+@register_contract(
+    name="resident_linesearch_trial",
+    description="margin-cached Wolfe trial (phi_at_ray): elementwise on "
+                "cached (z, dz) — ZERO passes over X, pinned by forbidding "
+                "dot_general outright",
+    collectives={}, forbid=("dot_general",), tags=("resident",))
+def _contract_linesearch_trial():
+    import numpy as np
+
+    batch = _contract_batch()
+    obj = _contract_objective()
+    z = jnp.zeros((64,), jnp.float32)
+    dz = jnp.zeros((64,), jnp.float32)
+    coeffs = tuple(jnp.asarray(v, jnp.float32) for v in (0.1, 0.2, 0.3))
+    a = np.float32(0.5)
+    return (lambda o, zz, dd, aa, cc, b: o.phi_at_ray(zz, dd, aa, cc, b)), \
+        (obj, z, dz, a, coeffs, batch)

@@ -793,3 +793,89 @@ def minimize_owlqn_streamed(
 
     return _result(be.result_w(w), F, float(_pg_norm(w, g, l1, mask)), it,
                    converged, failed, hist, ghist)
+
+
+# ----------------------------------------------------------------- contracts
+# The module docstring's communication law, as enforced static analysis
+# (photon_tpu/analysis; tests/test_streamed_mesh.py pins the same facts
+# dynamically): chunk-partial programs are communication-FREE — a psum
+# inside one would multiply the per-evaluation collective by n_chunks —
+# and an evaluation (or a line-search trial's totals) closes with exactly
+# ONE hierarchical psum.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+def _contract_problem(mesh=None, d=6):
+    """(obj, w, batch) with rows divisible by the mesh (trace-only; zeros
+    are fine — contracts are shape/structure facts, not value facts)."""
+    from photon_tpu.data.dataset import GLMBatch
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.ops.objective import Objective
+
+    n = 16 * (int(mesh.devices.size) if mesh is not None else 1)
+    batch = GLMBatch(X=jnp.zeros((n, d), jnp.float32),
+                     y=jnp.zeros((n,), jnp.float32),
+                     weights=jnp.ones((n,), jnp.float32),
+                     offsets=jnp.zeros((n,), jnp.float32))
+    # l2 as np.float32 (make_objective's canon): a Python-float leaf is
+    # weak-typed and the retrace-hazard rule rejects it.
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.4))
+    return obj, jnp.zeros((d,), jnp.float32), batch
+
+
+@register_contract(
+    name="streamed_chunk_init",
+    description="single-chip streamed chunk-partial program (_chunk_init): "
+                "margins + (loss, grad) partials, LOCAL sums only",
+    collectives={}, tags=("streamed",))
+def _contract_streamed_chunk_init():
+    obj, w, batch = _contract_problem()
+    return (lambda o, wv, b: _chunk_init(o, wv, b)), (obj, w, batch)
+
+
+@register_contract(
+    name="streamed_mesh_chunk_init",
+    description="mesh-streamed chunk-partial program under shard_map: "
+                "partials stay device-local, ZERO collectives per chunk",
+    collectives={}, tags=("mesh-streamed",))
+def _contract_streamed_mesh_chunk_init():
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ops = _mesh_ops(mesh)
+    obj, w, batch = _contract_problem(mesh)
+    return (lambda o, wv, b: ops.chunk_init(o, wv, b)), (obj, w, batch)
+
+
+@register_contract(
+    name="streamed_mesh_finish",
+    description="the evaluation close (_MeshChunkOps.finish): value and "
+                "gradient partials ride ONE hierarchical psum — the whole "
+                "evaluation's only collective",
+    collectives={"psum": 1}, tags=("mesh-streamed",))
+def _contract_streamed_mesh_finish():
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ops = _mesh_ops(mesh)
+    obj, w, _ = _contract_problem(mesh, d=6)
+    n_slots = int(mesh.devices.size)
+    parts = (jnp.zeros((n_slots,), jnp.float32),
+             jnp.zeros((n_slots, 6), jnp.float32), None)
+    return (lambda o, wv, p: ops.finish(o, wv, p)), (obj, w, parts)
+
+
+@register_contract(
+    name="streamed_mesh_trial_totals",
+    description="a line-search trial's (phi, phi') totals (psum_tree): "
+                "trials never multiply the collective count — ONE psum",
+    collectives={"psum": 1}, tags=("mesh-streamed",))
+def _contract_streamed_mesh_trial_totals():
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ops = _mesh_ops(mesh)
+    n_slots = int(mesh.devices.size)
+    parts = (jnp.zeros((n_slots,), jnp.float32),
+             jnp.zeros((n_slots,), jnp.float32))
+    return (lambda p: ops.psum_tree(p)), (parts,)

@@ -227,3 +227,59 @@ def fetch_local_rows(arr, mesh: Mesh) -> np.ndarray:
     shards = sorted(arr.addressable_shards,
                     key=lambda sh: sh.index[0].start or 0)
     return np.stack([np.asarray(sh.data) for sh in shards])
+
+
+# ----------------------------------------------------------------- contracts
+# The docstring's treeAggregate claim — ONE variadic psum per evaluation,
+# hierarchical over a hybrid mesh — as enforced law (see
+# photon_tpu/analysis; tests/test_multihost.py pins the same fact).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+def _contract_mesh_vg(mesh, axis_name):
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.ops.objective import Objective
+
+    d = 6
+    # l2 as np.float32 (make_objective's canon): a Python-float leaf is
+    # weak-typed and the retrace-hazard rule rejects it.
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.5),
+                    axis_name=axis_name)
+    rows = P(axis_name if isinstance(axis_name, tuple) else (axis_name,))
+
+    def vg(b, w):
+        return shard_map(lambda b, w: obj.value_and_grad(w, b),
+                         mesh=mesh, in_specs=(rows, P()),
+                         out_specs=(P(), P()))(b, w)
+
+    rng = np.random.RandomState(0)
+    n = 8 * int(mesh.devices.size)
+    from photon_tpu.data.dataset import make_batch
+
+    batch = make_batch(rng.randn(n, d).astype(np.float32),
+                       (rng.rand(n) < 0.5).astype(np.float32))
+    return vg, (batch, jnp.zeros((d,), jnp.float32))
+
+
+@register_contract(
+    name="mesh_value_and_grad",
+    description="shard_map value_and_grad over the data axis: value and "
+                "gradient partials ride ONE variadic psum per evaluation",
+    collectives={"psum": 1}, tags=("resident", "mesh"))
+def _contract_mesh_value_and_grad():
+    return _contract_mesh_vg(make_mesh(), "data")
+
+
+@register_contract(
+    name="hybrid_mesh_value_and_grad",
+    description="the 2-D replica(DCN) x data(ICI) mesh: the psum over BOTH "
+                "axes is still ONE equation (hierarchical lowering is the "
+                "backend's job, the contract is the single collective)",
+    collectives={"psum": 1}, tags=("resident", "mesh"))
+def _contract_hybrid_mesh_value_and_grad():
+    n_dev = len(jax.devices())
+    mesh = make_hybrid_mesh(n_replicas=2 if n_dev % 2 == 0 else 1)
+    return _contract_mesh_vg(mesh, ("replica", "data"))

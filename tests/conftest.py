@@ -26,8 +26,43 @@ jax.config.update("jax_platforms", "cpu")
 _cpu_devices = jax.devices("cpu")
 jax.config.update("jax_default_device", _cpu_devices[0])
 
+import sys  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "release_programs: drop this module's compiled XLA programs at "
+        "module teardown (jax.clear_caches + photon_tpu program caches). "
+        "Apply (pytestmark = pytest.mark.release_programs) to any module "
+        "that compiles many multi-device programs: the virtual-CPU XLA "
+        "client segfaults compiling LATER unrelated programs once too "
+        "many live executables have accumulated in the process "
+        "(~460; first seen from test_streamed_mesh's 8-device shard_map "
+        "programs breaking test_tuning's GP while_loop compile).")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs(request):
+    """Module teardown for `release_programs`-marked modules: clear the
+    photon_tpu module-level jitted-program caches that pin executables
+    alive, then jax.clear_caches() — keeping the rest of the suite inside
+    the executable-count envelope it had before the marked module ran."""
+    yield
+    if request.node.get_closest_marker("release_programs") is None:
+        return
+    streamed = sys.modules.get("photon_tpu.optim.streamed")
+    if streamed is not None:
+        streamed._MESH_OPS_CACHE.clear()
+    random_effect = sys.modules.get("photon_tpu.game.random_effect")
+    if random_effect is not None:
+        random_effect._SCAN_DISPATCH.clear()
+        random_effect._RE_SOLVERS.clear()
+        random_effect._FUSED_RE.clear()
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,71 @@
+"""Tier-1 contract enforcement: every hot path's registered ContractSpec
+must trace clean — THE test that turns the repo's implicit performance
+model (one psum per evaluation, communication-free chunk partials,
+scatter-free permuted layouts, f32 accumulation, no host exits, no retrace
+hazards) into law that fails CI on drift.
+
+Trace-only (jax.make_jaxpr): no compiles, so this module is cheap despite
+walking every solver program in the repo. The CLI face of the same
+registry is exercised end to end as a subprocess.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from photon_tpu.analysis import check_contract, trace_contract
+from photon_tpu.analysis.registry import load_registry
+
+pytestmark = pytest.mark.release_programs
+
+_REGISTRY = load_registry()
+
+
+def test_registry_is_broad_enough():
+    """≥ 8 specs spanning every workload family the repo ships."""
+    assert len(_REGISTRY) >= 8
+    tags = {t for spec in _REGISTRY.values() for t in spec.tags}
+    for family in ("resident", "streamed", "mesh-streamed", "lane", "game"):
+        assert family in tags, f"no contract covers the {family} family"
+
+
+@pytest.mark.parametrize("name", sorted(_REGISTRY))
+def test_contract_holds(name):
+    spec = _REGISTRY[name]
+    violations = check_contract(spec)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_declared_collective_budgets_are_exact():
+    """The budgets are EXACT pins, not ceilings: a spec declaring
+    {"psum": 1} must actually trace one psum (drift DOWN — a collective
+    disappearing — is also a contract change someone must look at)."""
+    from photon_tpu.analysis import collective_counts
+
+    checked = 0
+    for spec in _REGISTRY.values():
+        if spec.collectives:
+            traced = trace_contract(spec)
+            assert dict(collective_counts(traced.closed_jaxpr)) == \
+                dict(spec.collectives), spec.name
+            checked += 1
+    assert checked >= 4  # the mesh/streamed psum pins exist
+
+
+def test_cli_json_end_to_end():
+    """`python -m photon_tpu.analysis --json` — the CI entry point —
+    exits 0 with zero violations over the full registry."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the CLI must self-provision its platform
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.analysis", "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert report["n_specs"] >= 8
+    assert report["n_violations"] == 0

@@ -91,8 +91,14 @@ def test_hierarchical_psum_gradient(rng, hybrid_mesh):
 
 def test_single_all_reduce_per_evaluation(rng, mesh8):
     """Pins the communication pattern: one value_and_grad under shard_map
-    compiles to exactly ONE all-reduce (value and gradient partial sums ride
-    the same fused collective — the reference's single treeAggregate)."""
+    traces to exactly ONE psum equation (value and gradient partial sums
+    ride the same variadic collective — the reference's single
+    treeAggregate). Counted at the JAXPR level with the shared
+    photon_tpu.analysis walker: backend-independent, where the old
+    compiled-HLO `all-reduce(` text count broke on the CPU test backend's
+    missing all-reduce combiner (it legally splits the variadic psum)."""
+    from photon_tpu.analysis import collective_counts
+
     X, y = _logistic(rng, n=512, d=6)
     batch = make_batch(X, y)
     obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=0.5,
@@ -104,12 +110,10 @@ def test_single_all_reduce_per_evaluation(rng, mesh8):
             lambda b, w: obj.value_and_grad(w, b), mesh=mesh8,
             in_specs=(P("data"), P()), out_specs=(P(), P()))(batch, w)
 
-    compiled = vg.lower(
-        jax.device_put(batch, NamedSharding(mesh8, P("data"))),
-        jax.device_put(jnp.zeros(6), NamedSharding(mesh8, P()))).compile()
-    n_ar = sum(1 for line in compiled.as_text().splitlines()
-               if "= " in line and "all-reduce(" in line)
-    assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
+    counts = collective_counts(jax.make_jaxpr(vg)(batch, jnp.zeros(6)))
+    assert counts == {"psum": 1}, \
+        f"expected exactly 1 psum and no other collective, " \
+        f"traced {dict(counts)}"
 
 
 def test_padding_divides_hybrid_mesh(hybrid_mesh):
@@ -129,13 +133,15 @@ def test_bad_replica_count(rng):
 
 
 def test_sharded_hybrid_solve_collectives(rng, mesh8):
-    """The ShardedHybridRows shard_map solve: its value_and_grad compiles to
-    exactly ONE all-reduce and NO other collectives — the per-shard tail
+    """The ShardedHybridRows shard_map solve: its value_and_grad traces to
+    exactly ONE psum and NO other collective — the per-shard tail
     gather/scatter provably never crosses devices (the point of the
     per-shard-tail layout; a global segment_sum under SPMD inference gives
-    XLA no such guarantee)."""
+    XLA no such guarantee). Jaxpr-level via photon_tpu.analysis:
+    backend-independent, unlike the old HLO `all-reduce(` text count."""
     import scipy.sparse as sp
 
+    from photon_tpu.analysis import collective_counts
     from photon_tpu.data.dataset import shard_hybrid_batch
     from photon_tpu.models.training import _hybrid_specs
 
@@ -163,32 +169,30 @@ def test_sharded_hybrid_solve_collectives(rng, mesh8):
             in_specs=(_hybrid_specs(batch.X, ("data",)), P()),
             out_specs=(P(), P()))(batch, w)
 
-    compiled = vg.lower(
-        jax.device_put(batch, _hybrid_specs(
-            batch.X, ("data",),
-            wrap=lambda s: NamedSharding(mesh8, s))),
-        jax.device_put(jnp.zeros(d), NamedSharding(mesh8, P()))).compile()
-    hlo = compiled.as_text()
-    n_ar = sum(1 for line in hlo.splitlines()
-               if "= " in line and "all-reduce(" in line)
-    assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
-    for bad in ("all-to-all(", "collective-permute(", "all-gather("):
-        assert bad not in hlo, f"unexpected collective {bad} in hybrid solve"
+    counts = collective_counts(jax.make_jaxpr(vg)(batch, jnp.zeros(d)))
+    assert counts == {"psum": 1}, \
+        f"expected exactly 1 psum and no other collective in the hybrid " \
+        f"solve, traced {dict(counts)}"
 
 
 def test_sharded_permuted_solve_collectives_and_no_scatter(rng, mesh8):
     """The ShardedPermutedHybridRows shard_map solve — the multi-chip form
-    of the scatter-free layout — compiles to exactly ONE all-reduce, NO
-    other collectives, and ZERO scatter ops: the round-5 measured wall
-    (TPU scatter-adds at ~12 ns/element vs ~7 ns/gather-index,
-    docs/PERF.md) is eliminated by construction on the mesh path too,
-    where ShardedHybridRows still pays a per-shard tail segment_sum. The
-    pin covers both one value_and_grad and the FULL lane-grid solver
-    program (L-BFGS state updates are dynamic-update-slices, not
-    scatters)."""
+    of the scatter-free layout — traces to exactly ONE psum, NO other
+    collectives, and ZERO scatter ops: the round-5 measured wall (TPU
+    scatter-adds at ~12 ns/element vs ~7 ns/gather-index, docs/PERF.md) is
+    eliminated by construction on the mesh path too, where
+    ShardedHybridRows still pays a per-shard tail segment_sum. The pin
+    covers one value_and_grad (scatter-free outright) and the FULL
+    lane-grid solver program, whose only scatter eqns are `.at[i].set`
+    L-BFGS history writes — plain `scatter`, lowered to
+    dynamic-update-slice, never a combining scatter-add. Jaxpr-level via
+    photon_tpu.analysis: backend-independent, unlike the old HLO text
+    counts."""
+    from photon_tpu.analysis import (SCATTER_ADD_PRIMITIVES,
+                                     SCATTER_PRIMITIVES, collective_counts,
+                                     count_primitives)
     from photon_tpu.data.dataset import shard_permuted_batch
     from photon_tpu.models.training import (_hybrid_specs,
-                                            _train_run_grid_lanes,
                                             _train_run_sharded_grid_lanes,
                                             lane_weight_arrays,
                                             make_objective)
@@ -215,32 +219,30 @@ def test_sharded_permuted_solve_collectives_and_no_scatter(rng, mesh8):
             in_specs=(_hybrid_specs(batch.X, ("data",)), P()),
             out_specs=(P(), P()))(batch, w)
 
-    placed = jax.device_put(batch, _hybrid_specs(
-        batch.X, ("data",), wrap=lambda s: NamedSharding(mesh8, s)))
-    w_r = jax.device_put(jnp.zeros(d), NamedSharding(mesh8, P()))
-    hlo = vg.lower(placed, w_r).compile().as_text()
-    n_ar = sum(1 for line in hlo.splitlines()
-               if "= " in line and "all-reduce(" in line)
-    assert n_ar == 1, f"expected 1 all-reduce, compiled {n_ar}"
-    for bad in ("all-to-all(", "collective-permute(", "all-gather(",
-                "scatter("):
-        assert bad not in hlo, f"unexpected {bad} in sharded permuted solve"
+    jaxpr = jax.make_jaxpr(vg)(batch, jnp.zeros(d))
+    counts = collective_counts(jaxpr)
+    assert counts == {"psum": 1}, \
+        f"expected exactly 1 psum and no other collective, " \
+        f"traced {dict(counts)}"
+    scatters = count_primitives(jaxpr, SCATTER_PRIMITIVES)
+    assert not scatters, \
+        f"unexpected scatter in sharded permuted solve: {dict(scatters)}"
 
-    # The whole lane-grid solver program: still scatter-free end to end.
+    # The whole lane-grid solver program: no combining scatter anywhere.
     cfg = OC(max_iters=10, tolerance=1e-7, reg=reg.l2(), reg_weight=0.0,
              history=5)
     l2s, l1s, static_cfg = lane_weight_arrays(cfg, [0.1, 1.0])
     obj_g = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
                            axis_name="data",
                            intercept_index=batch.X.last_col_pos)
-    w0 = jax.device_put(jnp.zeros(d), NamedSharding(mesh8, P()))
-    lowered = _train_run_sharded_grid_lanes.lower(
-        placed, w0, jax.device_put(obj_g, NamedSharding(mesh8, P())),
-        jax.device_put(l2s, NamedSharding(mesh8, P())), None, static_cfg,
-        mesh8)
-    hlo_g = lowered.compile().as_text()
-    assert "scatter(" not in hlo_g, \
-        "scatter op in the sharded permuted lane-grid program"
+    jaxpr_g = jax.make_jaxpr(
+        lambda b, w, o, l2v: _train_run_sharded_grid_lanes(
+            b, w, o, l2v, None, static_cfg, mesh8))(
+        batch, jnp.zeros(d), obj_g, l2s)
+    adds = count_primitives(jaxpr_g, SCATTER_ADD_PRIMITIVES)
+    assert not adds, \
+        f"combining scatter in the sharded permuted lane-grid program: " \
+        f"{dict(adds)}"
 
 
 def test_sharded_hybrid_on_hybrid_mesh(rng, hybrid_mesh):

@@ -58,19 +58,11 @@ def hybrid_mesh():
     return make_hybrid_mesh(n_replicas=2, devices=jax.devices("cpu"))
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _release_mesh_programs():
-    """Drop this module's compiled 8-device shard_map programs when it
-    finishes: the virtual-CPU XLA client segfaults compiling LATER
-    unrelated programs (test_tuning's GP while_loop) once too many live
-    multi-device executables have accumulated in the process — clearing
-    the jit caches here keeps the rest of the suite inside the envelope
-    it had before this module existed."""
-    yield
-    from photon_tpu.optim.streamed import _MESH_OPS_CACHE
-
-    _MESH_OPS_CACHE.clear()
-    jax.clear_caches()
+# Drop this module's compiled 8-device shard_map programs at teardown —
+# without this the accumulated executables make the virtual-CPU XLA client
+# segfault compiling LATER unrelated programs (test_tuning's GP
+# while_loop). The fixture lives in conftest.py now; the marker opts in.
+pytestmark = pytest.mark.release_programs
 
 
 TASKS = [TaskType.LOGISTIC_REGRESSION, TaskType.LINEAR_REGRESSION]
@@ -284,28 +276,16 @@ class TestCollectivePattern:
         b = cb.mesh_chunk(0, mesh)
         return be, obj, w, b
 
-    @staticmethod
-    def _count_psums(jaxpr) -> int:
-        from jax.core import ClosedJaxpr, Jaxpr
-
-        n = 0
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == "psum":
-                n += 1
-            for v in eqn.params.values():
-                if isinstance(v, ClosedJaxpr):
-                    n += TestCollectivePattern._count_psums(v.jaxpr)
-                elif isinstance(v, Jaxpr):
-                    n += TestCollectivePattern._count_psums(v)
-        return n
-
     def test_chunk_program_has_no_collective(self, rng, mesh8):
         """The per-chunk partial program is communication-FREE: partials
-        stay device-local until the evaluation's single finishing psum."""
+        stay device-local until the evaluation's single finishing psum.
+        Pinned with the shared jaxpr walker (photon_tpu.analysis)."""
+        from photon_tpu.analysis import collective_counts
+
         be, obj, w, b = self._example(rng, mesh8)
         jaxpr = jax.make_jaxpr(
             lambda o, wv, bv: be.ops.chunk_init(o, wv, bv))(obj, w, b)
-        assert self._count_psums(jaxpr.jaxpr) == 0
+        assert not collective_counts(jaxpr)
         compiled = be.ops.chunk_init.lower(obj, w, b).compile()
         hlo = compiled.as_text()
         for bad in ("all-reduce(", "all-to-all(", "collective-permute(",
@@ -318,23 +298,29 @@ class TestCollectivePattern:
         the jaxpr level — whether XLA's combiner then emits the variadic
         all-reduce as one HLO op is a backend concern (the CPU test
         backend splits it; see test_multihost's pre-existing pin)."""
+        from photon_tpu.analysis import collective_counts
+
         be, obj, w, b = self._example(rng, mesh8)
         _, parts = be.ops.chunk_init(obj, w, b)
         jaxpr = jax.make_jaxpr(
             lambda o, wv, pv: be.ops.finish(o, wv, pv))(obj, w, parts)
-        n = self._count_psums(jaxpr.jaxpr)
-        assert n == 1, f"expected 1 psum per evaluation, traced {n}"
+        counts = collective_counts(jaxpr)
+        assert counts == {"psum": 1}, \
+            f"expected 1 psum per evaluation, traced {dict(counts)}"
 
     def test_trial_totals_are_one_psum(self, rng, mesh8):
         """A line-search trial's (φ, φ') totals also close with a single
         psum — trials never multiply the collective count."""
+        from photon_tpu.analysis import collective_counts
+
         be, obj, w, b = self._example(rng, mesh8)
         _, (wl, wd) = be.ops.chunk_dz_phi(obj, jnp.ones(10), b.offsets,
                                           np.float32(1.0), b)
         jaxpr = jax.make_jaxpr(
             lambda t: be.ops.psum_tree(t))((wl, wd))
-        n = self._count_psums(jaxpr.jaxpr)
-        assert n == 1, f"expected 1 psum per trial, traced {n}"
+        counts = collective_counts(jaxpr)
+        assert counts == {"psum": 1}, \
+            f"expected 1 psum per trial, traced {dict(counts)}"
 
     def test_finish_matches_resident_value_grad(self, rng, mesh8):
         """Accumulated sharded chunk partials + the single psum == the
