@@ -292,19 +292,44 @@ def check_contracts() -> int:
     return 1 if violations else 0
 
 
+def _telemetry_out_path() -> str | None:
+    """--telemetry-out PATH: also write the run's JSONL event stream."""
+    if "--telemetry-out" in sys.argv:
+        return sys.argv[sys.argv.index("--telemetry-out") + 1]
+    return None
+
+
 def main() -> None:
     if "--check-contracts" in sys.argv:
         raise SystemExit(check_contracts())
-    batch = sparse_problem()
-    grid_value = run_sparse_grid(batch)
-    single_value = run_sparse(batch)
-    dense_batch = dense_problem()
-    dense_value = run_dense(dense_batch, D_GRID)
-    dense_big_value = run_dense(dense_batch, D_GRID_BIG)
-    streamed_value = run_streamed()
-    streamed_mesh_value, streamed_mesh_chips = run_streamed_mesh()
+    # Every bench run records telemetry (photon_tpu/telemetry): the spans
+    # name the legs, and the counters put stall/eval/trial/retrace counts
+    # in BENCH_*.json next to the wall-clock numbers. --telemetry-out PATH
+    # additionally streams the full JSONL event log for offline reading
+    # (python -m photon_tpu.telemetry --report PATH).
+    from photon_tpu import telemetry
+
+    run = telemetry.start_run("bench", jsonl_path=_telemetry_out_path())
+    with telemetry.span("leg.sparse_data"):
+        batch = sparse_problem()
+    with telemetry.span("leg.sparse_grid8"):
+        grid_value = run_sparse_grid(batch)
+    with telemetry.span("leg.sparse_single"):
+        single_value = run_sparse(batch)
+    with telemetry.span("leg.dense_data"):
+        dense_batch = dense_problem()
+    with telemetry.span("leg.dense_grid16"):
+        dense_value = run_dense(dense_batch, D_GRID)
+    with telemetry.span("leg.dense_grid256"):
+        dense_big_value = run_dense(dense_batch, D_GRID_BIG)
+    with telemetry.span("leg.streamed_dense"):
+        streamed_value = run_streamed()
+    with telemetry.span("leg.streamed_mesh"):
+        streamed_mesh_value, streamed_mesh_chips = run_streamed_mesh()
+    telemetry.finish_run()
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
     print(json.dumps({
+        "telemetry": run.report_compact(),
         "metric": "sparse10m_logistic_grid8_rows_iters_per_sec_per_chip",
         "value": round(grid_value, 1),
         "unit": "rows*iters/sec/chip",

@@ -112,15 +112,31 @@ def main() -> None:
                 os.path.abspath(args.out_dir), "xla_cache"),
         )
 
+    import json
+
+    from photon_tpu import telemetry
+
     for run in range(args.runs):
+        # each driver invocation records a telemetry run: spans for the
+        # driver phases, stall/eval/retrace counters, live iteration
+        # events from any streamed solve — JSONL under the run's out dir,
+        # compact report embedded in the JSON line printed below
+        jsonl = os.path.join(args.out_dir, f"game_r{run}",
+                             "telemetry.jsonl")
+        trun = telemetry.start_run(f"flagship_r{run}", jsonl_path=jsonl)
         t0 = time.perf_counter()
         out = run_training(params(fd.COORDINATES, f"game_r{run}"),
                            mesh=mesh)
         total = time.perf_counter() - t0
+        telemetry.finish_run()
         phases = {k: round(v, 1) for k, v in sorted(out.timings.items())}
         print(f"run {run}: total {total:.0f}s  phases {phases}", flush=True)
         print(f"run {run}: validation AUC {out.best.validation_score:.4f} "
               f"({args.sweeps} sweeps, fixed + per_user + per_item)",
+              flush=True)
+        print(json.dumps({"run": run, "total_s": round(total, 1),
+                          "telemetry_jsonl": jsonl,
+                          "telemetry": trun.report_compact()}),
               flush=True)
 
     if args.fixed_only:

@@ -21,6 +21,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.game.random_effect",  # vmapped per-entity lane solves
     "photon_tpu.game.coordinate_descent",  # fused GAME coordinate update
     "photon_tpu.drivers.score",       # chunked scoring driver program
+    "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
 )
 
 

@@ -323,11 +323,15 @@ class ChunkedBatch(NamedTuple):
         whole mesh (`mesh_chunk`) instead of landing on one device.
 
         The iterator times how long it stalls waiting for each prefetched
-        chunk's transfer; when total stall exceeds total compute it logs
-        the imbalance at INFO — the signal that a deeper prefetch or a
-        bigger `objective_chunk_rows` would help."""
+        chunk's transfer; per-pass totals land in the telemetry counters
+        (`stream.chunk_uploads` / `stream.stall_seconds` /
+        `stream.compute_seconds`), and when total stall exceeds total
+        compute it logs the imbalance at INFO — the signal that a deeper
+        prefetch or a bigger `objective_chunk_rows` would help."""
         import time as _time
         from collections import deque
+
+        from photon_tpu import telemetry
 
         n = self.n_chunks
         if n == 0:
@@ -355,19 +359,29 @@ class ChunkedBatch(NamedTuple):
             stall += _time.perf_counter() - t0
             yield i, cur
         compute = (_time.perf_counter() - t_start) - stall
+        telemetry.count("stream.passes")
+        telemetry.count("stream.chunk_uploads", n)
+        telemetry.count("stream.stall_seconds", stall)
+        telemetry.count("stream.compute_seconds", max(compute, 0.0))
+        telemetry.gauge("stream.prefetch_depth", depth)
         _log_stream_stall(stall, compute, n, depth)
 
 
 def _log_stream_stall(stall: float, compute: float, n_chunks: int,
                       prefetch: int) -> None:
-    """One INFO line per streaming pass when transfer stalls exceed
-    compute — the signal that a deeper prefetch or a bigger chunk would
-    overlap the host link better (iter_device calls this at generator
-    exhaustion with its measured per-pass totals)."""
-    import logging
+    """One INFO line (plus a `stream.stalled_passes` telemetry counter)
+    per streaming pass when transfer stalls exceed compute — the signal
+    that a deeper prefetch or a bigger chunk would overlap the host link
+    better (iter_device calls this at generator exhaustion with its
+    measured per-pass totals). The log rides `photon_logger` with root
+    propagation kept ON, so capturing harnesses and a configured root
+    logger both see it."""
+    from photon_tpu import telemetry
+    from photon_tpu.utils.logging import photon_logger
 
     if n_chunks > 1 and stall > compute:
-        logging.getLogger("photon_tpu.streamed").info(
+        telemetry.count("stream.stalled_passes")
+        photon_logger("photon_tpu.streamed", propagate=True).info(
             "chunk upload outpaced compute: stalled %.3fs on transfers vs "
             "%.3fs compute over %d chunks (prefetch=%d) — a deeper "
             "prefetch or bigger chunks would overlap better",

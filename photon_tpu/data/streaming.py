@@ -94,10 +94,18 @@ def build_index_maps_streaming(
     Existing maps in `index_maps` are kept as-is. Runs through the native
     block decoder when it applies (a pure-Python pass over a 1B-row input
     would gate the fast chunk stream behind days of record decoding)."""
+    from photon_tpu import telemetry
+
     index_maps = dict(index_maps or {})
     todo = {s: cfg for s, cfg in config.shards.items() if s not in index_maps}
     if not todo:
         return index_maps
+    with telemetry.span("ingest.build_index_maps", shards=sorted(todo)):
+        return _build_index_maps_streaming(path, config, index_maps, todo)
+
+
+def _build_index_maps_streaming(path, config: GameDataConfig, index_maps,
+                                todo) -> dict:
     # Native pass over EXACTLY the shards being built: a sub-config keeps
     # only their bags and consumes nothing else — every other field
     # (including the real response/entity columns and prebuilt shards'
@@ -540,12 +548,16 @@ def stream_to_host(
         bufs = {s: alloc(s) for s in chunked_shards}
         filled = 0
 
+    from photon_tpu import telemetry
+
     stream, chunks = iter_game_chunks(path, config, index_maps,
                                       chunk_rows=chunk_rows,
                                       sparse_k=sparse_k,
                                       use_native=use_native)
     row = 0
     for chunk in chunks:
+        telemetry.count("ingest.chunks")
+        telemetry.count("ingest.rows", chunk.n)
         if chunk_hook is not None:
             chunk_hook(chunk)
         scal_parts["y"].append(np.asarray(chunk.y))
@@ -747,6 +759,7 @@ def stream_to_device(
                     mat_parts[s].append(jax.device_put(v, dev))
                 shipped.append(mat_parts[s][-1])
             in_flight.append(shipped)
+            telemetry.count("ingest.device_shards")
             if len(in_flight) > depth:
                 jax.block_until_ready(in_flight.pop(0))
         dev_i += 1
@@ -760,11 +773,15 @@ def stream_to_device(
     filled = 0  # rows filled in the current local buffer
     row = 0     # global row cursor
 
+    from photon_tpu import telemetry
+
     stream, chunks = iter_game_chunks(path, config, index_maps,
                                       chunk_rows=chunk_rows,
                                       sparse_k=sparse_k,
                                       use_native=use_native)
     for chunk in chunks:
+        telemetry.count("ingest.chunks")
+        telemetry.count("ingest.rows", chunk.n)
         if chunk_hook is not None:
             chunk_hook(chunk)
         c0 = 0

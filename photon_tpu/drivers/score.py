@@ -26,6 +26,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.data.avro_io import AvroBlockWriter
 from photon_tpu.data.feature_bags import FeatureShardConfig
 from photon_tpu.data.ingest import GameDataConfig
@@ -279,8 +280,9 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
         if need_groups and eval_entity in params.entity_fields else {})
     n_rows = 0
     n_chunks = 0
-    with AvroBlockWriter(out_path, SCORED_ITEM_SCHEMA,
-                         codec=params.output_codec) as writer:
+    with telemetry.span("score.stream"), \
+            AvroBlockWriter(out_path, SCORED_ITEM_SCHEMA,
+                            codec=params.output_codec) as writer:
         # ONE-CHUNK software pipeline: the device program for chunk i is
         # dispatched ASYNC, then chunk i+1 decodes on host while it runs —
         # the blocking readback of i happens only after i+1's decode. Over
@@ -297,6 +299,8 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
             writer.write_block(n_c, encode_scored_block(
                 uids, scores_c, np.asarray(y_host, np.float64), mask,
                 uid_present))
+            telemetry.count("score.chunks")
+            telemetry.count("score.rows", n_c)
             scores_acc.append(scores_c)
             if stream.saw_missing_response:
                 margins_acc.clear()
@@ -376,21 +380,23 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
     if has_labels:
         from photon_tpu.evaluation.evaluator import evaluate_with_entity
 
-        m = np.concatenate(margins_acc)
-        y = np.concatenate(y_acc)
-        w = np.concatenate(w_acc)
-        entity_ids = {e: np.concatenate(v) for e, v in group_cols.items()}
-        for ev in evals:
-            if ev.needs_groups:
-                try:
-                    metrics[evaluator_name(ev)] = evaluate_with_entity(
-                        ev, m, y, w, entity_ids, eval_entity)
-                except ValueError as e:
-                    log.warning("skipping %s: %s (set "
-                                "ScoringParams.evaluator_entity)",
-                                ev.kind.name, e)
-            else:
-                metrics[evaluator_name(ev)] = ev.evaluate(m, y, w)
+        with telemetry.span("score.evaluate"):
+            m = np.concatenate(margins_acc)
+            y = np.concatenate(y_acc)
+            w = np.concatenate(w_acc)
+            entity_ids = {e: np.concatenate(v)
+                          for e, v in group_cols.items()}
+            for ev in evals:
+                if ev.needs_groups:
+                    try:
+                        metrics[evaluator_name(ev)] = evaluate_with_entity(
+                            ev, m, y, w, entity_ids, eval_entity)
+                    except ValueError as e:
+                        log.warning("skipping %s: %s (set "
+                                    "ScoringParams.evaluator_entity)",
+                                    ev.kind.name, e)
+                else:
+                    metrics[evaluator_name(ev)] = ev.evaluate(m, y, w)
         # the FIRST evaluator's value, not whichever happened to compute
         metric = metrics.get(evaluator_name(evals[0]))
         log.info("metrics on scored data: %s", metrics)

@@ -44,6 +44,7 @@ from photon_tpu.models.variance import VarianceComputationType
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.optim import regularization as reg
 from photon_tpu.optim.config import OptimizerConfig, OptimizerType
+from photon_tpu import telemetry
 from photon_tpu.utils.logging import photon_logger
 from photon_tpu.utils.timing import PhaseTimers
 
@@ -302,7 +303,10 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
     """The full reference pipeline: read → validate → (down-sample) → train
     over the config grid / tuner → select best on validation → save."""
     log = photon_logger("photon_tpu.train", params.output_dir)
-    timers = PhaseTimers()
+    # phase timers double as telemetry spans ("train.<phase>") when a
+    # telemetry.Run is attached — the driver's per-phase story lands in
+    # the run report and on XProf timelines with no extra wiring
+    timers = PhaseTimers(span_prefix="train.")
     task = TaskType[params.task]
     mode = DataValidationType(params.data_validation)
 
@@ -532,6 +536,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                 data, validation=validation,
                 config_grid=_config_grid(params.coordinates),
                 initial_models=initial_models)
+    telemetry.sample_device_memory("post_train")
     best = estimator.best_model(results)
     if best.validation_score is not None:
         log.info("best validation score: %.6f", best.validation_score)
@@ -737,6 +742,8 @@ def _resolve_streamed_objective(params: TrainingParams, index_maps: dict,
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     forced = params.streamed_objective
     if forced is False:
+        telemetry.event("streamed_objective_resolution", verdict="resident",
+                        forced=True, n_devices=n_dev)
         log.info("streamed objective: OFF (forced by streamed_objective="
                  "False)")
         return False
@@ -746,6 +753,8 @@ def _resolve_streamed_objective(params: TrainingParams, index_maps: dict,
                 "streamed_objective=True needs at least one shard used "
                 "exclusively by fixed-effect coordinates (random-effect "
                 "shards must stay resident for entity bucketing)")
+        telemetry.event("streamed_objective_resolution", verdict="stream",
+                        forced=True, n_devices=n_dev)
         log.info(
             "streamed objective: ON (forced by streamed_objective=True; "
             "%d-device %s)", n_dev,
@@ -758,6 +767,12 @@ def _resolve_streamed_objective(params: TrainingParams, index_maps: dict,
     budget = per_chip * n_dev
     chunked = _streamable_shards(params)
     verdict = est > budget and bool(chunked)
+    telemetry.event("streamed_objective_resolution",
+                    verdict="stream" if verdict else "resident",
+                    forced=False, estimate_bytes=est, budget_bytes=budget,
+                    n_devices=n_dev, n_rows=n_rows)
+    telemetry.gauge("train.dataset_estimate_bytes", est)
+    telemetry.gauge("train.hbm_budget_bytes", budget)
     log.info(
         "streamed objective auto-resolution: dataset estimate %.2f GiB "
         "(%d rows), pooled HBM budget %.2f GiB (%d device(s) x %.2f GiB "

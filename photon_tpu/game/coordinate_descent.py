@@ -21,6 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from photon_tpu import telemetry
 from photon_tpu.game.fixed_effect import FixedEffectCoordinate
 from photon_tpu.game.model import GameModel
 from photon_tpu.game.random_effect import RandomEffectCoordinate
@@ -166,10 +167,14 @@ def coordinate_descent(
     )
 
     deferred_re: list = []  # (stats-list index slot fillers for fused REs)
-    for _ in range(n_sweeps):
+    update_log: list = []  # (sweep, coordinate) per objective_history entry
+    for sweep in range(n_sweeps):
+        telemetry.count("game.sweeps")
         for name in update_sequence:
             if name in locked:
                 continue
+            update_log.append((sweep, name))
+            telemetry.count("game.coordinate_updates")
             coord = coordinates[name]
             warm = models.get(name)
             prior = priors.get(name)
@@ -243,6 +248,14 @@ def coordinate_descent(
     objective_history, re_stats = jax.device_get(
         (objective_history, [st for *_, st in deferred_re]))
     objective_history = [float(v) for v in objective_history]
+    if telemetry.enabled():
+        # the GAME iteration stream: one event per coordinate update, in
+        # update order (objectives are deferred device scalars, so events
+        # emit here — after the one batched readback — not mid-sweep)
+        for i, ((sweep, name), obj_v) in enumerate(
+                zip(update_log, objective_history)):
+            telemetry.iteration("game_descent", i, obj_v,
+                                coordinate=name, sweep=sweep)
     from photon_tpu.game.random_effect import RETrainStats
 
     for (name, slot, E, _), (c, f, it) in zip(deferred_re, re_stats):

@@ -15,6 +15,7 @@ from typing import Optional
 import numpy as np
 from jax.sharding import Mesh
 
+from photon_tpu import telemetry
 from photon_tpu.evaluation.evaluator import Evaluator, default_evaluator
 from photon_tpu.game.coordinate_descent import (
     CoordinateDescentResult,
@@ -223,6 +224,7 @@ class GameEstimator:
         """
         grid = config_grid or [self.coordinate_configs]
         evaluator = self.evaluator or default_evaluator(self.task)
+        telemetry.count("game.grid_points", len(grid))
         dataset_cache, coord_cache = self._caches_for(data)
         if validation is not None:
             # One transfer for the whole grid: every grid point scores the
@@ -269,25 +271,28 @@ class GameEstimator:
                     dataset_cache[key] = self._build_dataset(data, cfg)
                 datasets[name] = dataset_cache[key]
             coords = self._build_coordinates(datasets, configs, coord_cache)
-            descent = coordinate_descent(
-                coords,
-                data.y,
-                data.weights,
-                data.offsets,
-                self.task,
-                update_sequence=self.update_sequence,
-                n_sweeps=self.n_sweeps,
-                locked=self.locked,
-                initial_models=prev_models,
-                incremental=self.incremental,
-                priors=user_priors,
-            )
+            with telemetry.span("game.fit_point", index=len(results)):
+                descent = coordinate_descent(
+                    coords,
+                    data.y,
+                    data.weights,
+                    data.offsets,
+                    self.task,
+                    update_sequence=self.update_sequence,
+                    n_sweeps=self.n_sweeps,
+                    locked=self.locked,
+                    initial_models=prev_models,
+                    incremental=self.incremental,
+                    priors=user_priors,
+                )
             result = GameFitResult(descent.model, descent, configs)
             if validation is not None:
-                scores = score_game(descent.model, validation)
-                result.validation_score = self._evaluate(
-                    evaluator, scores, validation
-                )
+                with telemetry.span("game.validate_point",
+                                    index=len(results)):
+                    scores = score_game(descent.model, validation)
+                    result.validation_score = self._evaluate(
+                        evaluator, scores, validation
+                    )
             results.append(result)
             if chain_warm:
                 prev_models = dict(descent.model.coordinates)
@@ -433,10 +438,12 @@ class GameEstimator:
                 dataset_cache[key] = self._build_dataset(data, cfg)
             datasets[name] = dataset_cache[key]
         coords = self._build_coordinates(datasets, configs, coord_cache)
-        outcome = fit_game_grid(
-            coords, lanes, data.y, data.weights, data.offsets, self.task,
-            update_sequence=self.update_sequence, n_sweeps=self.n_sweeps,
-            mesh=self.mesh)
+        with telemetry.span("game.grid_vectorized",
+                            lanes=len(next(iter(lanes.values())))):
+            outcome = fit_game_grid(
+                coords, lanes, data.y, data.weights, data.offsets,
+                self.task, update_sequence=self.update_sequence,
+                n_sweeps=self.n_sweeps, mesh=self.mesh)
 
         G = len(next(iter(lanes.values())))
         val_scores = None
@@ -517,10 +524,11 @@ class GameEstimator:
             dataset_cache[key] = self._build_dataset(data, base)
         ds = dataset_cache[key]
         norm = self._normalization_for(name, ds)
-        grid = train_glm_grid(
-            ds.batch(jnp.asarray(data.offsets)), self.task, base.optimizer,
-            weights, mesh=self.mesh, variance=self.variance,
-            normalization=norm)
+        with telemetry.span("game.grid_vectorized", lanes=len(weights)):
+            grid = train_glm_grid(
+                ds.batch(jnp.asarray(data.offsets)), self.task,
+                base.optimizer, weights, mesh=self.mesh,
+                variance=self.variance, normalization=norm)
         models = [m for m, _ in grid]
         # Per-lane total training objective (unregularized weighted loss —
         # what coordinate_descent's objective_history records), from ONE

@@ -46,6 +46,12 @@ from photon_tpu.optim.tron import minimize_tron_margin
 from photon_tpu.optim.tracker import OptResult
 from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple, replicated
 
+# Run telemetry (no-op without an attached Run): the solve dispatches
+# record their jit-cache argument signatures, so the run report counts
+# retraces (`retrace.new_signatures`) and flags weak-type drift — the
+# dynamic face of the analysis retrace-hazard rule.
+from photon_tpu import telemetry
+
 
 def make_objective(
     task: TaskType,
@@ -491,6 +497,8 @@ def train_glm_grid(
     obj = make_objective(task, config, d, axis_name=axis_name,
                          normalization=norm_obj,
                          intercept_index=intercept_index)
+    telemetry.record_signature("training._train_run_grid",
+                               (batch, w0, obj, l2s, l1s))
     # Reg sweeps without variances ride a lane-minor solver (one lock-step
     # program sharing every X pass): smooth sweeps on the margin-cached
     # L-BFGS or TRON lanes, L1/elastic-net sweeps on the OWL-QN lanes.
@@ -775,6 +783,8 @@ def train_glm(
                          fused=use_fused, intercept_index=intercept_index)
 
     if sharded_hybrid:
+        telemetry.record_signature("training._train_run_sharded",
+                                   (batch, w0, obj, _l1_lam(config)))
         res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
                                       _static_config(config), variance, mesh)
     elif mesh is not None:
@@ -791,6 +801,8 @@ def train_glm(
         batch = pad_batch(batch, pad_to_multiple(batch.n, 4096))
 
     if not sharded_hybrid:
+        telemetry.record_signature("training._train_run",
+                                   (batch, w0, obj, _l1_lam(config)))
         res, var = _train_run(batch, w0, obj, _l1_lam(config),
                               _static_config(config), variance)
     if permuted:

@@ -18,6 +18,10 @@ from jax import lax
 
 from photon_tpu.optim.linesearch import wolfe_line_search
 from photon_tpu.optim.tracker import OptResult
+# Opt-in per-iteration telemetry from inside the jitted loop: a pure
+# no-op (absent from the jaxpr) unless a Run(resident_tap=True) is
+# attached at trace time — the telemetry_off_is_free contract pins that.
+from photon_tpu.telemetry.taps import solver_tap
 
 
 class _State(NamedTuple):
@@ -163,6 +167,7 @@ def minimize_lbfgs(
         converged = _convergence(ok, s.f, f_new, gnorm, g0norm, dphi0,
                                  tolerance, dtype)
         it = s.it + 1
+        solver_tap("lbfgs", it, f_new, gnorm, jnp.where(ok, alpha, 0.0))
         return _State(
             w=w_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, sy=sy, yy=yy,
             idx=idx, count=count, it=it, done=converged | ~ok,
@@ -171,6 +176,7 @@ def minimize_lbfgs(
             ghist=s.ghist.at[it].set(gnorm),
         )
 
+    solver_tap("lbfgs", 0, f0, g0norm)
     init = _State(
         w=w0, f=f0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),
@@ -306,6 +312,8 @@ def minimize_lbfgs_margin(
         converged = _convergence(ok, s.f, f_new, gnorm, g0norm, dphi0,
                                  tolerance, dtype)
         it = s.it + 1
+        solver_tap("lbfgs_margin", it, f_new, gnorm,
+                   jnp.where(ok, alpha, 0.0))
         return _MarginState(
             w=w_new, z=z_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho,
             sy=sy, yy=yy, idx=idx,
@@ -315,6 +323,7 @@ def minimize_lbfgs_margin(
             ghist=s.ghist.at[it].set(gnorm),
         )
 
+    solver_tap("lbfgs_margin", 0, f0, g0norm)
     init = _MarginState(
         w=w0, z=z0, f=f0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),

@@ -23,6 +23,9 @@ from jax import lax
 
 from photon_tpu.optim.lbfgs import two_loop, _push
 from photon_tpu.optim.tracker import OptResult
+# Opt-in in-loop iteration telemetry; compiled out by default (see
+# optim/lbfgs.py and the telemetry_off_is_free contract).
+from photon_tpu.telemetry.taps import solver_tap
 
 
 def pseudo_gradient(w, g, l1, mask):
@@ -162,6 +165,7 @@ def minimize_owlqn(
         precision_limited = (~ok) & (jnp.abs(dphi0) <= noise)
         converged = grad_conv | f_conv | precision_limited
         it = s.it + 1
+        solver_tap("owlqn", it, F_new, pgnorm, jnp.where(ok, ls.a, 0.0))
         return _State(
             w=w_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho,
             sy=sy, yy=yy, idx=idx,
@@ -171,6 +175,7 @@ def minimize_owlqn(
             ghist=s.ghist.at[it].set(pgnorm),
         )
 
+    solver_tap("owlqn", 0, F0, pg0norm)
     init = _State(
         w=w0, f=f0, F=F0, g=g0,
         S=jnp.zeros((m, d), dtype), Y=jnp.zeros((m, d), dtype),

@@ -67,6 +67,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from photon_tpu import telemetry
 from photon_tpu.data.dataset import GLMBatch
 from photon_tpu.data.matrix import SparseRows
 from photon_tpu.optim.lbfgs import _Z_REFRESH, two_loop
@@ -497,7 +498,9 @@ def _host_wolfe(phi, f0: float, dphi0: float, a_init: float,
     `first` short-circuits the first evaluation with (f, dphi) already
     accumulated during the direction pass (the common accept-at-first-trial
     iteration then costs ZERO extra margin streams). Returns
-    (alpha, f_alpha, ok) with the resident solver's exact semantics."""
+    (alpha, f_alpha, ok, n_evals) with the resident solver's exact
+    accept/fail semantics; ``n_evals`` is the trial count (the iteration
+    stream's `trials` field)."""
     phase, i = 0, 0
     a, a_prev, f_prev, d_prev = a_init, 0.0, f0, dphi0
     a_lo, f_lo, d_lo = 0.0, f0, dphi0
@@ -544,7 +547,7 @@ def _host_wolfe(phi, f0: float, dphi0: float, a_init: float,
         a_prev, f_prev, d_prev = a, f, d
         a, phase = next_a, n_phase
 
-    return a_star, f_star, done or a_star > 0.0
+    return a_star, f_star, done or a_star > 0.0, i
 
 
 def _convergence_host(ok, f_old, f_new, gnorm, g0norm, dphi0,
@@ -586,7 +589,21 @@ def minimize_lbfgs_streamed(
     the treeAggregate-per-iteration execution regime, same math and same
     convergence criteria as `optim.lbfgs.minimize_lbfgs_margin`. With
     ``mesh=``, chunks row-shard over every mesh device and each evaluation
-    closes with one hierarchical psum (see the module docstring)."""
+    closes with one hierarchical psum (see the module docstring).
+
+    The host driver loop emits telemetry for free: one `iteration` event
+    per solver iteration (loss/grad_norm/step/trials — the live face of
+    `OptResult.loss_history`), plus feature-stream / evaluation /
+    line-search / margin-cache counters (photon_tpu.telemetry; no-ops
+    without an attached Run)."""
+    with telemetry.span("solve.lbfgs_streamed", mesh=mesh is not None,
+                        n_chunks=data.n_chunks):
+        return _lbfgs_streamed(obj, data, w0, max_iters, tolerance,
+                               history, max_ls_evals, mesh, prefetch)
+
+
+def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
+                    max_ls_evals, mesh, prefetch) -> OptResult:
     _check_streamable(obj, mesh)
     be = _backend(data, mesh, prefetch)
     w = jnp.asarray(w0, jnp.float32)
@@ -610,6 +627,9 @@ def minimize_lbfgs_streamed(
     f_dev, g = be.finish(obj, w, acc)
     f = float(f_dev)
     g0norm = float(jnp.linalg.norm(g))
+    telemetry.count("solver.feature_streams")
+    telemetry.count("solver.evaluations")
+    telemetry.iteration("lbfgs_streamed", 0, f, grad_norm=g0norm)
 
     hist = np.full(max_iters + 1, np.nan, np.float32)
     ghist = np.full(max_iters + 1, np.nan, np.float32)
@@ -638,9 +658,15 @@ def minimize_lbfgs_streamed(
         wl0, wd0 = be.totals(phis)
         rv, rd = reg_ray(a_init)
         first_eval = (wl0 + rv, wd0 + rd)
+        # feature stream 1 of 2; its piggybacked φ(a_init) is both an
+        # evaluation and the line search's first trial
+        telemetry.count("solver.feature_streams")
+        telemetry.count("solver.evaluations")
 
         def phi(a):
             """Streamed trial: 16 bytes/row of cached margins, no X."""
+            telemetry.count("solver.evaluations")
+            telemetry.count("solver.margin_cache.hits")
             phis = None
             for i in range(n_chunks):
                 wlwd = be.chunk_phi(obj, i, z_cache[i], dz_cache[i], a)
@@ -649,8 +675,10 @@ def minimize_lbfgs_streamed(
             rv, rd = reg_ray(a)
             return wl + rv, wd + rd
 
-        alpha, f_star, ok = _host_wolfe(phi, f, dphi0, a_init,
-                                        max_ls_evals, first=first_eval)
+        alpha, f_star, ok, n_trials = _host_wolfe(phi, f, dphi0, a_init,
+                                                  max_ls_evals,
+                                                  first=first_eval)
+        telemetry.count("solver.linesearch_trials", n_trials)
 
         if ok:
             w_new = _axpy(w, np.float32(alpha), p)
@@ -660,6 +688,10 @@ def minimize_lbfgs_streamed(
             refresh = (max_iters >= _Z_REFRESH
                        and (it + 1) % _Z_REFRESH == 0)
             # ---- gradient pass (feature stream 2 of 2)
+            telemetry.count("solver.feature_streams")
+            telemetry.count("solver.evaluations")
+            if refresh:
+                telemetry.count("solver.margin_cache.refreshes")
             acc = None
             for i, b in be.iter_chunks():
                 if refresh:  # re-anchor the chained margin on w (f32 drift)
@@ -680,6 +712,9 @@ def minimize_lbfgs_streamed(
         failed = failed or (not ok and not converged)
         it += 1
         hist[it], ghist[it] = f_new, gnorm
+        telemetry.count("solver.iterations")
+        telemetry.iteration("lbfgs_streamed", it, f_new, grad_norm=gnorm,
+                            step=(alpha if ok else 0.0), trials=n_trials)
         w, g, f = w_new, g_new, f_new
         done = converged or not ok
 
@@ -708,7 +743,21 @@ def minimize_owlqn_streamed(
     rung), so the common iteration costs two feature streams: the ladder
     pass and the accepted point's gradient pass. With ``mesh=``, chunks
     row-shard over every mesh device; each ladder block and each gradient
-    pass still closes with one psum (see the module docstring)."""
+    pass still closes with one psum (see the module docstring).
+
+    Telemetry mirrors the streamed L-BFGS: live `iteration` events plus
+    feature-stream / evaluation / ladder-trial counters from the host
+    driver loop (no-ops without an attached Run)."""
+    with telemetry.span("solve.owlqn_streamed", mesh=mesh is not None,
+                        n_chunks=data.n_chunks):
+        return _owlqn_streamed(obj, data, w0, l1_weight, max_iters,
+                               tolerance, history, max_ls_evals, reg_mask,
+                               ladder_lanes, mesh, prefetch)
+
+
+def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
+                    history, max_ls_evals, reg_mask, ladder_lanes, mesh,
+                    prefetch) -> OptResult:
     _check_streamable(obj, mesh)
     be = _backend(data, mesh, prefetch)
     w = jnp.asarray(w0, jnp.float32)
@@ -724,6 +773,8 @@ def minimize_owlqn_streamed(
     c1 = 1e-4  # optim.owlqn's Armijo constant
 
     def value_grad_pass(w_at):
+        telemetry.count("solver.feature_streams")
+        telemetry.count("solver.evaluations")
         acc = None
         for i, b in be.iter_chunks():
             _, parts = be.chunk_init(obj, w_at, b)
@@ -734,6 +785,7 @@ def minimize_owlqn_streamed(
     f, g = value_grad_pass(w)
     F = f + float(_l1_term(w, l1, mask))
     pg0norm = float(_pg_norm(w, g, l1, mask))
+    telemetry.iteration("owlqn_streamed", 0, F, grad_norm=pg0norm)
 
     hist = np.full(max_iters + 1, np.nan, np.float32)
     ghist = np.full(max_iters + 1, np.nan, np.float32)
@@ -757,6 +809,10 @@ def minimize_owlqn_streamed(
                 np.float32)
             W, dec, l1t, rv = _owlqn_candidates(obj, w, p, xi,
                                                 alphas, pg, l1, mask)
+            # one feature stream prices K ladder rungs at once
+            telemetry.count("solver.feature_streams")
+            telemetry.count("solver.evaluations", K)
+            telemetry.count("solver.linesearch_trials", K)
             acc = None
             for _, b in be.iter_chunks():
                 part = be.chunk_value_many(obj, W, b)
@@ -788,6 +844,9 @@ def minimize_owlqn_streamed(
         failed = failed or (not ok and not converged)
         it += 1
         hist[it], ghist[it] = F_new, pgnorm
+        telemetry.count("solver.iterations")
+        telemetry.iteration("owlqn_streamed", it, F_new, grad_norm=pgnorm,
+                            trials=evals)
         w, g, f, F = w_new, g_new, f_new, F_new
         done = converged or not ok
 

@@ -18,6 +18,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_tpu.optim.tracker import OptResult
+# Opt-in in-loop iteration telemetry; compiled out by default (see
+# optim/lbfgs.py and the telemetry_off_is_free contract).
+from photon_tpu.telemetry.taps import solver_tap
 
 ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
 SIGMA1, SIGMA2, SIGMA3 = 0.25, 0.5, 4.0
@@ -172,6 +175,7 @@ def minimize_tron(
         converged, stuck = _tr_stops(accept, actual, pred, s.f, f_new, gnorm,
                                      g0norm, delta, tolerance, dtype)
         it = s.it + 1
+        solver_tap("tron", it, f_new, gnorm, delta)
         return _State(
             w=w_new, f=f_new, g=g_new, delta=delta, it=it,
             done=converged | stuck, converged=converged,
@@ -180,6 +184,7 @@ def minimize_tron(
             ghist=s.ghist.at[it].set(gnorm),
         )
 
+    solver_tap("tron", 0, f0, g0norm)
     init = _State(
         w=w0, f=f0, g=g0, delta=jnp.maximum(g0norm, 1.0).astype(dtype),
         it=jnp.zeros((), jnp.int32),
@@ -332,6 +337,7 @@ def minimize_tron_margin(
         converged, stuck = _tr_stops(accept, actual, pred, s.f, f_new, gnorm,
                                      g0norm, delta, tolerance, dtype)
         it = s.it + 1
+        solver_tap("tron_margin", it, f_new, gnorm, delta)
         return _MarginState(
             w=w_new, z=z_new, f=f_new, g=g_new, delta=delta, it=it,
             done=converged | stuck, converged=converged,
@@ -340,6 +346,7 @@ def minimize_tron_margin(
             ghist=s.ghist.at[it].set(gnorm),
         )
 
+    solver_tap("tron_margin", 0, f0, g0norm)
     init = _MarginState(
         w=w0, z=z0, f=f0, g=g0,
         delta=jnp.maximum(g0norm, 1.0).astype(dtype),

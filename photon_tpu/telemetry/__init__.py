@@ -1,0 +1,180 @@
+"""Run telemetry spine: spans, counters/gauges, and a live per-iteration
+solver stream across the resident/streamed/mesh/GAME paths.
+
+The reference leans on Spark's UI + event log (per-stage timing, driver
+diagnostics via `PhotonLogger`, `OptimizationStatesTracker`,
+`util.Timer`); this package is the TPU port's runtime half of that story:
+one process-wide `Run` recorder the instrumented hot paths report into.
+
+::
+
+    from photon_tpu import telemetry
+
+    with telemetry.run("flagship", jsonl_path="out/run.jsonl") as r:
+        train_glm(batch, task, config)          # streamed solves emit
+    report = r.report()                          # live iteration events
+
+Three primitives (see `run.Run`): nestable host-side **spans** (also fed
+to `jax.profiler.TraceAnnotation`, so they appear on XProf timelines;
+`utils.timing.PhaseTimers` forwards the drivers' phase blocks here
+automatically), **counters/gauges** (chunk uploads, upload-stall seconds,
+prefetch depth, evaluations, line-search trials, margin-cache hits/
+refreshes, retraces via `analysis.TraceSignatureLog`, GAME sweep stats,
+HBM watermarks), and the **iteration stream** — one event per solver
+iteration, free in the streamed/mesh host loops and opt-in for the jitted
+resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`
+compiled out by default; the registered `telemetry_off_is_free`
+ContractSpec enforces exactly that).
+
+Sinks: `Run.report()` (in-memory dict), a JSONL event file
+(`sinks.read_jsonl` / `sinks.load_report`), and a human end-of-run
+summary through `photon_logger` at close.
+
+THE OFF-STATE CONTRACT: every module-level helper here starts with
+``if _CURRENT is None: return`` — a run-less process pays one global load
+and one branch per instrumentation point, and the resident solver
+programs contain no callback at all (docs/OBSERVABILITY.md).
+
+CLI: ``python -m photon_tpu.telemetry --selftest`` smoke-checks the
+spine (sink round-trip + the off-is-free contract) and exits non-zero on
+failure.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from photon_tpu.telemetry.run import Run, Span  # noqa: F401
+from photon_tpu.telemetry.sinks import load_report, read_jsonl  # noqa: F401
+from photon_tpu.telemetry.taps import (  # noqa: F401
+    set_resident_tap,
+    solver_tap,
+    tap_disabled,
+    tap_enabled,
+)
+
+__all__ = [
+    "Run", "Span", "read_jsonl", "load_report",
+    "start_run", "finish_run", "run", "current_run", "enabled",
+    "span", "count", "gauge", "iteration", "event", "record_signature",
+    "sample_device_memory",
+    "solver_tap", "tap_enabled", "set_resident_tap", "tap_disabled",
+]
+
+_CURRENT: Optional[Run] = None
+_ATTACH_LOCK = threading.Lock()
+
+
+# ------------------------------------------------------------- run lifecycle
+def start_run(name: str = "run", jsonl_path: Optional[str] = None,
+              resident_tap: bool = False, logger=None) -> Run:
+    """Create a Run and attach it as the process-wide current run. One run
+    at a time: starting while one is attached finishes the old one first
+    (runs are process-scoped, like the reference's one Spark UI per app)."""
+    global _CURRENT
+    with _ATTACH_LOCK:
+        if _CURRENT is not None:
+            _CURRENT.close()
+        r = Run(name=name, jsonl_path=jsonl_path, resident_tap=resident_tap,
+                logger=logger)
+        _CURRENT = r
+        set_resident_tap(resident_tap)
+    return r
+
+
+def finish_run() -> Optional[dict]:
+    """Close and detach the current run; returns its final report."""
+    global _CURRENT
+    with _ATTACH_LOCK:
+        r, _CURRENT = _CURRENT, None
+        set_resident_tap(False)
+    return r.close() if r is not None else None
+
+
+@contextlib.contextmanager
+def run(name: str = "run", jsonl_path: Optional[str] = None,
+        resident_tap: bool = False, logger=None):
+    """`with telemetry.run(...) as r:` — start_run/finish_run scoped."""
+    r = start_run(name, jsonl_path=jsonl_path, resident_tap=resident_tap,
+                  logger=logger)
+    try:
+        yield r
+    finally:
+        if _CURRENT is r:
+            finish_run()
+        else:  # someone else already replaced it; still close ours
+            r.close()
+
+
+def current_run() -> Optional[Run]:
+    return _CURRENT
+
+
+def enabled() -> bool:
+    return _CURRENT is not None
+
+
+# ----------------------------------------------------- hot-path entry points
+# Each of these is the ONE branch a run-less process pays. They bind the
+# run locally (the attach lock is for attach/detach; readers race benignly
+# — an event lands in whichever run was current when it fired).
+
+class _NullSpan:
+    """Shared no-op span context manager for the disabled state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **attrs):
+    r = _CURRENT
+    if r is None:
+        return _NULL_SPAN
+    return r.span(name, **attrs)
+
+
+def count(name: str, value: float = 1.0) -> None:
+    r = _CURRENT
+    if r is not None:
+        r.count(name, value)
+
+
+def gauge(name: str, value) -> None:
+    r = _CURRENT
+    if r is not None:
+        r.gauge(name, value)
+
+
+def iteration(solver: str, it: int, loss, grad_norm=None, step=None,
+              trials=None, **extra) -> None:
+    r = _CURRENT
+    if r is not None:
+        r.iteration(solver, it, loss, grad_norm=grad_norm, step=step,
+                    trials=trials, **extra)
+
+
+def event(kind: str, **fields) -> None:
+    r = _CURRENT
+    if r is not None:
+        r.event(kind, **fields)
+
+
+def record_signature(program: str, args) -> None:
+    r = _CURRENT
+    if r is not None:
+        r.record_signature(program, args)
+
+
+def sample_device_memory(tag: str = "") -> None:
+    r = _CURRENT
+    if r is not None:
+        r.sample_device_memory(tag)

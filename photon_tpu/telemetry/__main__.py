@@ -1,0 +1,161 @@
+"""CLI: smoke-check the telemetry spine.
+
+    python -m photon_tpu.telemetry --selftest          # exit 1 on failure
+    python -m photon_tpu.telemetry --selftest --json   # machine report
+    python -m photon_tpu.telemetry --report PATH       # summarize a JSONL file
+
+The selftest exercises every sink and the off-state guarantee without
+touching real data: span nesting + exception safety, cross-thread counter
+aggregation, the JSONL round-trip (written file == in-memory report), a
+live iteration stream from a tiny streamed L-BFGS solve, and the
+`telemetry_off_is_free` ContractSpec (the resident solver program traced
+with telemetry disabled must contain zero callbacks/transfers). Mirrors
+`analysis.__main__`: environment defaults are applied BEFORE jax loads,
+so it runs anywhere CI does.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _selftest(as_json: bool) -> int:
+    import json
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from photon_tpu import telemetry
+    from photon_tpu.telemetry.sinks import load_report
+
+    checks: dict[str, str] = {}  # name -> "" (ok) or failure message
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks[name] = "" if ok else (detail or "failed")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        jsonl = os.path.join(tmp, "selftest.jsonl")
+        r = telemetry.start_run("selftest", jsonl_path=jsonl)
+        try:
+            # spans: nesting + exception safety
+            with telemetry.span("outer"):
+                with telemetry.span("inner"):
+                    pass
+            try:
+                with telemetry.span("boom"):
+                    raise ValueError("expected")
+            except ValueError:
+                pass
+            spans = {s.path: s for s in r.spans}
+            check("span_nesting", "outer/inner" in spans and "outer" in spans,
+                  f"paths: {sorted(spans)}")
+            check("span_exception_safety",
+                  spans.get("boom") is not None
+                  and spans["boom"].error == "ValueError")
+
+            # counters: cross-thread aggregation
+            def bump():
+                for _ in range(1000):
+                    telemetry.count("selftest.bumps")
+
+            threads = [threading.Thread(target=bump) for _ in range(4)]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            check("counter_threads",
+                  r.counters.get("selftest.bumps") == 4000.0,
+                  f"got {r.counters.get('selftest.bumps')}")
+
+            # a real (tiny) streamed solve drives the iteration stream
+            from photon_tpu.data.dataset import chunk_batch, make_batch
+            from photon_tpu.models.training import train_glm
+            from photon_tpu.ops.losses import TaskType
+            from photon_tpu.optim.config import OptimizerConfig
+            from photon_tpu.optim.regularization import l2
+
+            rng = np.random.default_rng(0)
+            X = rng.normal(size=(96, 5)).astype(np.float32)
+            y = (rng.uniform(size=96) < 0.5).astype(np.float32)
+            cb = chunk_batch(make_batch(X, y), 32)
+            cfg = OptimizerConfig(max_iters=4, tolerance=1e-7, reg=l2(),
+                                  reg_weight=0.1, history=3)
+            _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+            events = [e for e in r.iterations
+                      if e["solver"] == "lbfgs_streamed"]
+            hist = res.history()
+            check("iteration_stream",
+                  len(events) == hist.shape[0]
+                  and np.allclose([e["loss"] for e in events], hist),
+                  f"{len(events)} events vs {hist.shape[0]} history rows")
+            check("stream_counters",
+                  r.counters.get("stream.chunk_uploads", 0) > 0
+                  and r.counters.get("solver.iterations", 0) > 0,
+                  f"counters: {sorted(r.counters)}")
+        finally:
+            report = telemetry.finish_run()
+
+        # JSONL round-trip: the file reassembles to the in-memory report
+        disk = load_report(jsonl)
+        check("jsonl_roundtrip",
+              disk["complete"]
+              and disk["counters"] == report["counters"]
+              and len(disk["spans"]) == len(report["spans"])
+              and len(disk["iterations"]) == report["n_iteration_events"],
+              "disk report does not match the in-memory one")
+
+    # the off-state guarantee, via the registered ContractSpec
+    from photon_tpu.analysis.contracts import REGISTRY, check_contract
+
+    import photon_tpu.telemetry.taps  # noqa: F401  (registers the spec)
+
+    spec = REGISTRY.get("telemetry_off_is_free")
+    if spec is None:
+        check("off_is_free_contract", False, "spec not registered")
+    else:
+        violations = check_contract(spec)
+        check("off_is_free_contract", not violations,
+              "; ".join(str(v) for v in violations))
+
+    failures = {k: v for k, v in checks.items() if v}
+    if as_json:
+        print(json.dumps({"ok": not failures, "checks": {
+            k: (v or "ok") for k, v in checks.items()}}))
+    else:
+        for k in checks:
+            print(("ok   " if not checks[k] else "FAIL ") + k
+                  + (f": {checks[k]}" if checks[k] else ""))
+        print(f"{len(checks)} check(s), {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _default_env()
+    if "--report" in argv:
+        import json
+
+        from photon_tpu.telemetry.sinks import load_report
+
+        path = argv[argv.index("--report") + 1]
+        rep = load_report(path)
+        rep["spans"] = rep["spans"][:50]
+        rep["iterations"] = rep["iterations"][:50]
+        print(json.dumps(rep, indent=2))
+        return 0
+    if "--selftest" in argv:
+        return _selftest("--json" in argv)
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

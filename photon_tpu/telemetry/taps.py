@@ -1,0 +1,144 @@
+"""The resident-solver iteration tap: opt-in `jax.debug.callback` events
+from INSIDE the jitted solver loops — compiled OUT by default.
+
+The resident solvers (optim.lbfgs / owlqn / tron) are single XLA programs:
+their per-iteration loss lives in a `lax.while_loop` carry and is only
+readable after the solve returns (the NaN-padded `OptResult.loss_history`).
+`solver_tap(...)`, called at trace time inside each solver body, emits a
+live iteration event per loop step — but ONLY in programs traced while a
+`Run(resident_tap=True)` is attached. With the tap disarmed (the default)
+it is a pure-Python no-op: nothing enters the jaxpr, so the zero-transfer
+contracts the analysis registry pins on every solver program stay intact.
+The `telemetry_off_is_free` ContractSpec below makes that compiled-out
+guarantee enforced law rather than convention.
+
+Arming/disarming calls `jax.clear_caches()`: jit's cache key knows nothing
+about the tap flag, so without the flush a solver traced in the other mode
+would keep serving its stale executable (tap events silently missing, or
+silently present after disarm). The flush happens only on an actual state
+TRANSITION — a process that never arms the tap never pays it.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["solver_tap", "tap_enabled", "set_resident_tap",
+           "tap_disabled"]
+
+_TAP_ARMED = False
+
+
+def tap_enabled() -> bool:
+    """Trace-time switch: is the resident iteration tap armed?"""
+    return _TAP_ARMED
+
+
+def set_resident_tap(on: bool) -> None:
+    """Arm/disarm the tap. A transition clears jit caches so solver
+    programs re-trace in the new mode (see module docstring)."""
+    global _TAP_ARMED
+    on = bool(on)
+    if on == _TAP_ARMED:
+        return
+    _TAP_ARMED = on
+    import jax
+
+    jax.clear_caches()
+
+
+@contextlib.contextmanager
+def tap_disabled():
+    """Force the tap off inside the block (trace-time scoping — the
+    `telemetry_off_is_free` contract builder uses it so an armed ambient
+    run cannot leak callbacks into the traced program). Flips the raw
+    flag WITHOUT the cache flush: this runs inside an active trace, where
+    `jax.clear_caches()` is not safe — the contract problem uses shapes
+    nothing else in the process traces, so a stale cached trace cannot
+    alias it."""
+    global _TAP_ARMED
+    was = _TAP_ARMED
+    _TAP_ARMED = False
+    try:
+        yield
+    finally:
+        _TAP_ARMED = was
+
+
+def _emit_event(solver: str, it, loss, grad_norm, step):
+    """Host side of the debug callback. Values may be batched (the solver
+    body under vmap — lane grids, per-entity RE solves); `Run.iteration`'s
+    scalar coercion turns those into lists."""
+    from photon_tpu.telemetry import current_run
+
+    run = current_run()
+    if run is None:
+        return
+    import numpy as np
+
+    it_a = np.asarray(it)
+    run.iteration(solver, int(it_a.ravel()[0]) if it_a.ndim else int(it_a),
+                  loss, grad_norm=grad_norm, step=step, tapped=True)
+
+
+def solver_tap(solver: str, it, loss, grad_norm=None, step=None) -> None:
+    """Per-iteration tap point for jitted solver bodies. No-op (and absent
+    from the jaxpr) unless the tap is armed at TRACE time."""
+    if not _TAP_ARMED:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    zero = jnp.zeros((), jnp.float32)
+    jax.debug.callback(
+        lambda i, f, g, a, _s=solver: _emit_event(_s, i, f, g, a),
+        it, loss,
+        grad_norm if grad_norm is not None else zero,
+        step if step is not None else zero)
+
+
+# ----------------------------------------------------------------- contracts
+# The telemetry-off guarantee as enforced law: the full resident
+# margin-cached L-BFGS solve, traced with the tap forced off, contains
+# zero callbacks/transfers (and zero collectives) — i.e. attaching no Run
+# (the default) costs the hot paths nothing. Registered into the same
+# registry as the PR-3 specs (analysis/registry.py imports this package).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import TRANSFER_PRIMITIVES  # noqa: E402
+
+
+@register_contract(
+    name="telemetry_off_is_free",
+    description="resident L-BFGS solve traced with telemetry disabled: "
+                "the iteration tap is compiled OUT — zero debug callbacks, "
+                "zero transfers, zero collectives in the whole solver "
+                "program",
+    collectives={}, forbid=TRANSFER_PRIMITIVES,
+    tags=("resident", "telemetry"))
+def _contract_telemetry_off_is_free():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.models.training import (_static_config, _train_run,
+                                            make_objective)
+    from photon_tpu.models.variance import VarianceComputationType
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    rng = np.random.default_rng(0)
+    n, d = 48, 7
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.3, history=4)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d)
+
+    def fn(b, w, o):
+        # trace-time scoping: even if a tap-armed Run is attached while
+        # the registry is checked, THIS trace sees telemetry disabled
+        with tap_disabled():
+            return _train_run(b, w, o, None, _static_config(cfg),
+                              VarianceComputationType.NONE)
+
+    return fn, (make_batch(X, y), jnp.zeros((d,), jnp.float32), obj)

@@ -1,0 +1,458 @@
+"""Telemetry spine tests: span nesting/exception safety, cross-thread
+counter aggregation, the JSONL sink round-trip, the live streamed-solver
+iteration stream (events == OptResult.loss_history, single-chip and
+mesh), the resident debug-callback tap's on/off result parity, the GAME
+descent event stream, photon_logger level semantics, and the
+telemetry-off-is-free contract.
+
+Marked `release_programs`: the tap tests arm/disarm `resident_tap`
+(which clears jit caches by design) and the mesh test compiles 8-device
+shard_map programs — both put this module in the executable-accumulation
+regime tests/conftest.py's marker exists for.
+"""
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from photon_tpu import telemetry
+from photon_tpu.data.dataset import chunk_batch, make_batch
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim import regularization as reg
+
+pytestmark = pytest.mark.release_programs
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    """No test may leak an attached run (or an armed tap) into the rest
+    of the suite."""
+    yield
+    telemetry.finish_run()
+
+
+def _problem(rng, n=240, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y
+
+
+_CFG = OptimizerConfig(max_iters=8, tolerance=1e-7, reg=reg.l2(),
+                       reg_weight=0.1, history=4)
+
+
+# ------------------------------------------------------------------- spans
+class TestSpans:
+    def test_nesting_paths_and_depths(self):
+        r = telemetry.start_run("t")
+        with telemetry.span("outer", phase="x"):
+            with telemetry.span("inner"):
+                pass
+        with telemetry.span("sibling"):
+            pass
+        by_path = {s.path: s for s in r.spans}
+        assert set(by_path) == {"outer/inner", "outer", "sibling"}
+        assert by_path["outer/inner"].depth == 1
+        assert by_path["sibling"].depth == 0
+        assert by_path["outer"].attrs == {"phase": "x"}
+        # children complete (and record) before their parents
+        assert r.spans[0].name == "inner"
+        assert all(s.seconds >= 0.0 for s in r.spans)
+
+    def test_exception_safety(self):
+        r = telemetry.start_run("t")
+        with pytest.raises(ValueError):
+            with telemetry.span("outer"):
+                with telemetry.span("boom"):
+                    raise ValueError("x")
+        by_path = {s.path: s for s in r.spans}
+        assert by_path["outer/boom"].error == "ValueError"
+        assert by_path["outer"].error == "ValueError"
+        # the stack unwound: a new span is top-level again
+        with telemetry.span("after"):
+            pass
+        assert {s.path for s in r.spans} >= {"after"}
+        assert [s for s in r.spans if s.path == "after"][0].depth == 0
+
+    def test_noop_without_run(self):
+        assert telemetry.current_run() is None
+        with telemetry.span("ignored") as rec:
+            assert rec is None
+        telemetry.count("ignored")
+        telemetry.iteration("ignored", 0, 1.0)  # must not raise
+
+    def test_phase_timers_feed_spans(self):
+        from photon_tpu.utils.timing import PhaseTimers
+
+        r = telemetry.start_run("t")
+        timers = PhaseTimers(span_prefix="train.")
+        with timers("read"):
+            pass
+        with timers("read"):
+            pass
+        assert sum(1 for s in r.spans if s.path == "train.read") == 2
+        assert timers.summary()["read"] >= 0.0
+        telemetry.finish_run()
+        with timers("read"):  # detached: pure stopwatch, no crash
+            pass
+
+
+# ---------------------------------------------------------------- counters
+class TestCounters:
+    def test_thread_aggregation(self):
+        r = telemetry.start_run("t")
+
+        def bump():
+            for _ in range(2000):
+                telemetry.count("bumps")
+                telemetry.count("weighted", 0.5)
+
+        threads = [threading.Thread(target=bump) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert r.counters["bumps"] == 16000.0
+        assert r.counters["weighted"] == pytest.approx(8000.0)
+
+    def test_gauges_keep_last(self):
+        r = telemetry.start_run("t")
+        telemetry.gauge("depth", 2)
+        telemetry.gauge("depth", 4)
+        assert r.gauges["depth"] == 4
+
+    def test_record_signature_counts_new_traces(self):
+        import jax.numpy as jnp
+
+        r = telemetry.start_run("t")
+        telemetry.record_signature("prog", (jnp.ones(3),))
+        telemetry.record_signature("prog", (jnp.ones(3),))  # same sig
+        telemetry.record_signature("prog", (jnp.ones(4),))  # new shape
+        assert r.counters["retrace.new_signatures"] == 2.0
+        # weak-type drift surfaces in the report
+        telemetry.record_signature("drift", (jnp.float32(1.0),))
+        telemetry.record_signature("drift", (1.0,))
+        assert "drift" in r.report()["retrace"]["weak_type_hazards"]
+
+
+# ------------------------------------------------------------------- JSONL
+class TestJsonlSink:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        r = telemetry.start_run("rt", jsonl_path=path)
+        with telemetry.span("a"):
+            with telemetry.span("b"):
+                pass
+        telemetry.count("c1", 3)
+        telemetry.iteration("solver", 0, 1.5, grad_norm=0.1, trials=2)
+        telemetry.event("custom_event", detail="x")
+        report = telemetry.finish_run()
+
+        disk = telemetry.load_report(path)
+        assert disk["complete"]
+        assert disk["name"] == "rt"
+        assert disk["counters"] == report["counters"]
+        assert {s["path"] for s in disk["spans"]} == {"a", "a/b"}
+        assert disk["iterations"] == report["iterations"]
+        assert disk["iterations"][0]["loss"] == 1.5
+        assert [e["type"] for e in disk["events"]] == ["custom_event"]
+        assert disk["duration_s"] == pytest.approx(report["duration_s"])
+
+    def test_truncated_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        telemetry.start_run("rt", jsonl_path=path)
+        telemetry.iteration("s", 0, 1.0)
+        telemetry.finish_run()
+        with open(path, "a") as fh:
+            fh.write('{"type": "iteration", "solver": "s", "it')  # cut off
+        disk = telemetry.load_report(path)
+        assert len(disk["iterations"]) == 1  # prefix still served
+
+    def test_every_line_is_json_with_type(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        telemetry.start_run("rt", jsonl_path=path)
+        with telemetry.span("a"):
+            pass
+        telemetry.finish_run()
+        with open(path) as fh:
+            kinds = [json.loads(line)["type"] for line in fh]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        assert "span" in kinds
+
+
+# ------------------------------------------- streamed iteration stream
+class TestStreamedIterationStream:
+    def _events(self, r, solver):
+        evs = sorted((e for e in r.iterations if e["solver"] == solver),
+                     key=lambda e: e["it"])
+        assert [e["it"] for e in evs] == list(range(len(evs)))
+        return evs
+
+    def test_lbfgs_events_match_loss_history(self, rng, tmp_path):
+        X, y = _problem(rng)
+        cb = chunk_batch(make_batch(X, y), 64)
+        path = str(tmp_path / "run.jsonl")
+        r = telemetry.start_run("t", jsonl_path=path)
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, _CFG)
+        telemetry.finish_run()
+        evs = self._events(r, "lbfgs_streamed")
+        hist = res.history()
+        assert len(evs) == hist.shape[0] == int(res.iterations) + 1
+        np.testing.assert_allclose([e["loss"] for e in evs], hist,
+                                   rtol=1e-6)
+        ghist = res.grad_history()
+        np.testing.assert_allclose([e["grad_norm"] for e in evs], ghist,
+                                   rtol=1e-5)
+        # per-iteration events carry the accepted step + trial count
+        assert all("step" in e and e["trials"] >= 1 for e in evs[1:])
+        # the same stream round-trips through the JSONL sink
+        disk = [e for e in telemetry.read_jsonl(path, kind="iteration")
+                if e["solver"] == "lbfgs_streamed"]
+        assert [e["loss"] for e in disk] == [e["loss"] for e in evs]
+
+    def test_owlqn_events_match_loss_history(self, rng):
+        X, y = _problem(rng)
+        cb = chunk_batch(make_batch(X, y), 64)
+        cfg = OptimizerConfig(max_iters=8, tolerance=1e-7, reg=reg.l1(),
+                              reg_weight=0.05, history=4)
+        r = telemetry.start_run("t")
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+        telemetry.finish_run()
+        evs = self._events(r, "owlqn_streamed")
+        hist = res.history()
+        assert len(evs) == hist.shape[0]
+        np.testing.assert_allclose([e["loss"] for e in evs], hist,
+                                   rtol=1e-6)
+
+    def test_streamed_mesh_full_report(self, rng, mesh8, tmp_path):
+        """The acceptance shape: a streamed-MESH solve with telemetry on
+        produces a JSONL report with spans, >=5 distinct counters, and one
+        iteration event per solver iteration whose losses equal
+        OptResult.loss_history."""
+        X, y = _problem(rng, n=400)
+        cb = chunk_batch(make_batch(X, y), 100)
+        path = str(tmp_path / "mesh_run.jsonl")
+        r = telemetry.start_run("mesh", jsonl_path=path)
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, _CFG,
+                           mesh=mesh8)
+        telemetry.finish_run()
+
+        evs = self._events(r, "lbfgs_streamed")
+        hist = res.history()
+        assert len(evs) == hist.shape[0]
+        np.testing.assert_allclose([e["loss"] for e in evs], hist,
+                                   rtol=1e-6)
+
+        disk = telemetry.load_report(path)
+        assert disk["complete"]
+        assert len(disk["spans"]) >= 1
+        assert any(s["path"].startswith("solve.lbfgs_streamed")
+                   for s in disk["spans"])
+        assert len(disk["counters"]) >= 5
+        for key in ("stream.chunk_uploads", "stream.stall_seconds",
+                    "solver.evaluations", "solver.linesearch_trials",
+                    "solver.iterations", "solver.feature_streams"):
+            assert key in disk["counters"], key
+        # per-pass upload accounting: every feature stream re-uploads all
+        # chunks (plus margin-only trial streams never touch features)
+        assert disk["counters"]["stream.chunk_uploads"] >= \
+            disk["counters"]["solver.feature_streams"] * cb.n_chunks
+
+    def test_counters_off_by_default(self, rng):
+        X, y = _problem(rng)
+        cb = chunk_batch(make_batch(X, y), 64)
+        assert telemetry.current_run() is None
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, _CFG)
+        assert int(res.iterations) > 0  # solve unaffected, nothing raised
+
+
+# --------------------------------------------------- resident solver tap
+class TestResidentTap:
+    def test_tap_off_then_on_parity_and_events(self, rng):
+        X, y = _problem(rng)
+        batch = make_batch(X, y)
+        # OFF (default): no run, no events — and the solve works
+        res_off = train_glm(batch, TaskType.LOGISTIC_REGRESSION, _CFG)[1]
+
+        r = telemetry.start_run("tap", resident_tap=True)
+        res_on = train_glm(batch, TaskType.LOGISTIC_REGRESSION, _CFG)[1]
+        jax.effects_barrier()  # debug callbacks drain before asserting
+        telemetry.finish_run()
+
+        # parity: the tap must not change results
+        np.testing.assert_allclose(np.asarray(res_on.w),
+                                   np.asarray(res_off.w), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(res_on.loss_history),
+                                   np.asarray(res_off.loss_history),
+                                   rtol=1e-6)
+        assert int(res_on.iterations) == int(res_off.iterations)
+
+        evs = sorted((e for e in r.iterations
+                      if e["solver"] == "lbfgs_margin"),
+                     key=lambda e: e["it"])
+        hist = res_on.history()
+        assert len(evs) == hist.shape[0]
+        np.testing.assert_allclose([e["loss"] for e in evs], hist,
+                                   rtol=1e-6)
+        assert all(e.get("tapped") for e in evs)
+
+        # OFF again: a fresh run without the tap sees no resident events
+        r2 = telemetry.start_run("tap-off")
+        res_off2 = train_glm(batch, TaskType.LOGISTIC_REGRESSION, _CFG)[1]
+        jax.effects_barrier()
+        telemetry.finish_run()
+        assert not [e for e in r2.iterations
+                    if e["solver"] == "lbfgs_margin"]
+        np.testing.assert_allclose(np.asarray(res_off2.w),
+                                   np.asarray(res_off.w), rtol=1e-6)
+
+    def test_tap_events_owlqn(self, rng):
+        X, y = _problem(rng)
+        batch = make_batch(X, y)
+        cfg = OptimizerConfig(max_iters=6, tolerance=1e-7, reg=reg.l1(),
+                              reg_weight=0.05, history=4)
+        r = telemetry.start_run("tap", resident_tap=True)
+        res = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)[1]
+        jax.effects_barrier()
+        telemetry.finish_run()
+        evs = sorted((e for e in r.iterations if e["solver"] == "owlqn"),
+                     key=lambda e: e["it"])
+        hist = res.history()
+        assert len(evs) == hist.shape[0]
+        np.testing.assert_allclose([e["loss"] for e in evs], hist,
+                                   rtol=1e-6)
+
+
+# ----------------------------------------------------------- GAME events
+class TestGameStream:
+    def test_descent_emits_one_event_per_update(self, rng):
+        from photon_tpu.game import (FixedEffectConfig, GameData,
+                                     GameEstimator, RandomEffectConfig)
+
+        n, d = 400, 4
+        ent = rng.integers(0, 12, size=n)
+        Xf = rng.normal(size=(n, d)).astype(np.float32)
+        Xr = np.ones((n, 1), np.float32)
+        yv = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        data = GameData.build(yv, shards={"fixed": Xf, "bias": Xr},
+                              entity_ids={"e": ent})
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "fixed": FixedEffectConfig("fixed", _CFG),
+                "per_e": RandomEffectConfig("e", "bias", _CFG),
+            },
+            n_sweeps=2)
+        r = telemetry.start_run("game")
+        results = est.fit(data)
+        telemetry.finish_run()
+        descent = results[0].descent
+        evs = [e for e in r.iterations if e["solver"] == "game_descent"]
+        assert len(evs) == len(descent.objective_history) == 4
+        np.testing.assert_allclose([e["loss"] for e in evs],
+                                   descent.objective_history, rtol=1e-6)
+        assert [(e["sweep"], e["coordinate"]) for e in evs] == \
+            [(0, "fixed"), (0, "per_e"), (1, "fixed"), (1, "per_e")]
+        assert r.counters["game.coordinate_updates"] == 4.0
+        assert r.counters["game.sweeps"] == 2.0
+        assert r.counters["game.grid_points"] == 1.0
+
+
+# ------------------------------------------------------- photon_logger fix
+class TestPhotonLoggerLevels:
+    def test_explicit_level_survives_reconfiguration(self):
+        from photon_tpu.utils.logging import photon_logger
+
+        log = photon_logger("t_lvl_a", level=logging.DEBUG)
+        assert log.level == logging.DEBUG
+        # a later default-level call (e.g. a second driver phase adding a
+        # file handler) must NOT silently reset the effective level
+        log = photon_logger("t_lvl_a")
+        assert log.level == logging.DEBUG
+        # an explicit new level still wins
+        log = photon_logger("t_lvl_a", level=logging.WARNING)
+        assert log.level == logging.WARNING
+
+    def test_first_call_defaults_to_info(self):
+        from photon_tpu.utils.logging import photon_logger
+
+        assert photon_logger("t_lvl_b").level == logging.INFO
+
+    def test_env_override_wins(self, monkeypatch):
+        from photon_tpu.utils.logging import photon_logger
+
+        monkeypatch.setenv("PHOTON_TPU_LOG_LEVEL", "warning")
+        assert photon_logger("t_lvl_c",
+                             level=logging.DEBUG).level == logging.WARNING
+        monkeypatch.setenv("PHOTON_TPU_LOG_LEVEL", "15")
+        assert photon_logger("t_lvl_d").level == 15
+        monkeypatch.setenv("PHOTON_TPU_LOG_LEVEL", "not-a-level")
+        assert photon_logger("t_lvl_e").level == logging.INFO
+
+    def test_handlers_stay_notset(self, tmp_path):
+        from photon_tpu.utils.logging import photon_logger
+
+        log = photon_logger("t_lvl_f", output_dir=str(tmp_path),
+                            level=logging.DEBUG)
+        assert log.handlers and all(h.level == logging.NOTSET
+                                    for h in log.handlers)
+
+    def test_stall_log_still_fires_with_stable_text(self, caplog):
+        from photon_tpu.data.dataset import _log_stream_stall
+
+        r = telemetry.start_run("t")
+        with caplog.at_level(logging.INFO, logger="photon_tpu.streamed"):
+            _log_stream_stall(stall=1.0, compute=0.2, n_chunks=4,
+                              prefetch=2)
+        telemetry.finish_run()
+        assert any("deeper prefetch or bigger chunks" in rec.message
+                   for rec in caplog.records)
+        assert r.counters["stream.stalled_passes"] == 1.0
+
+
+# ----------------------------------------------------- off-is-free contract
+class TestOffIsFreeContract:
+    def test_registered_and_clean(self):
+        from photon_tpu.analysis.contracts import check_contract
+        from photon_tpu.analysis.registry import load_registry
+
+        specs = load_registry()
+        assert "telemetry_off_is_free" in specs
+        spec = specs["telemetry_off_is_free"]
+        assert "telemetry" in spec.tags
+        violations = check_contract(spec)
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_tap_on_trace_contains_callback_off_does_not(self, rng):
+        """The mechanism itself: armed -> debug_callback in the jaxpr;
+        disarmed -> absent (what the contract pins at registry level)."""
+        import jax.numpy as jnp
+
+        from photon_tpu.analysis import count_primitives
+        from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
+        from photon_tpu.models.training import make_objective
+
+        X, y = _problem(rng, n=64, d=5)
+        batch = make_batch(X, y)
+        obj = make_objective(TaskType.LOGISTIC_REGRESSION, _CFG, 5)
+        w0 = jnp.zeros((5,), jnp.float32)
+
+        def fn(b, w):
+            return minimize_lbfgs_margin(obj, b, w, max_iters=3)
+
+        closed_off = jax.make_jaxpr(fn)(batch, w0)
+        assert count_primitives(closed_off,
+                                {"debug_callback"}) == {}
+        telemetry.set_resident_tap(True)
+        try:
+            closed_on = jax.make_jaxpr(fn)(batch, w0)
+            n_cb = count_primitives(closed_on, {"debug_callback"})
+            assert n_cb.get("debug_callback", 0) >= 2  # init + loop body
+        finally:
+            telemetry.set_resident_tap(False)
