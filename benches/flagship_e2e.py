@@ -62,6 +62,11 @@ def main() -> None:
                         "pod-scale out-of-HBM regime — and the auto-trip "
                         "budgets against the POOLED HBM (per-chip budget "
                         "x N)")
+    p.add_argument("--game-re-leg", action="store_true",
+                   help="also run bench.py's game_re leg (the pipelined + "
+                        "straggler-compacted random-effect block loop vs "
+                        "the sequential one, skewed entity sizes) and "
+                        "print its JSON line")
     args = p.parse_args()
 
     import _flagship_data as fd
@@ -145,6 +150,21 @@ def main() -> None:
                                   "fixed_only"), mesh=mesh)
         print(f"fixed-only: total {time.perf_counter() - t0:.0f}s  "
               f"AUC {out.best.validation_score:.4f}", flush=True)
+
+    if args.game_re_leg:
+        # The SAME leg bench.py's JSON line carries (one problem
+        # definition, two numbers): the random-effect block-loop rate with
+        # and without the round-8 pipeline + straggler compaction.
+        import bench
+
+        ds_gr, rows_gr = bench.game_re_problem()
+        seq = bench.run_game_re(ds_gr, rows_gr, pipelined=False)
+        pipe = bench.run_game_re(ds_gr, rows_gr, pipelined=True)
+        print(json.dumps({
+            "leg": "game_re",
+            "rows_iters_per_sec_per_chip": round(pipe, 1),
+            "sequential_rows_iters_per_sec_per_chip": round(seq, 1),
+            "speedup_vs_sequential": round(pipe / seq, 3)}), flush=True)
 
 
 if __name__ == "__main__":

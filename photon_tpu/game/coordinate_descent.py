@@ -44,7 +44,13 @@ class CoordinateDescentResult:
 # On the COMMON path (no prior/projection/normalization, single device) the
 # whole update — offsets, solve, score, objective — fuses into ONE program
 # per coordinate (see _fused_fixed_update / RandomEffectCoordinate.
-# fused_update_program), ≤1 dispatch per update.
+# fused_update_program), ≤1 dispatch per update. Every OTHER random-effect
+# update (mesh, projection, normalization, prior, straggler_budget — the
+# last returns None from fused_update_program because the compacted
+# re-solve needs a host repack between passes) goes through the PIPELINED
+# RandomEffectCoordinate.train(): bucket k+1's upload/solve dispatched
+# before bucket k's readback, so the per-coordinate wall is
+# max(device solve, host scatter) per bucket instead of their sum.
 from photon_tpu.game.scoring import _sum_scores  # noqa: E402
 
 
@@ -201,6 +207,9 @@ def coordinate_descent(
                 objective_history.append(objective)
                 continue
 
+            # fused_update_program gates itself: it returns None for mesh /
+            # projection / normalization / straggler-budget coordinates,
+            # which then train on the pipelined block loop below.
             fused = (coord.fused_update_program()
                      if isinstance(coord, RandomEffectCoordinate)
                      and prior is None else None)

@@ -382,6 +382,16 @@ class RandomEffectDataset:
         blocks = []
         for m in sorted(buckets):
             ents = np.asarray(buckets[m], np.int64)
+            # Difficulty-sorted chunk packing: lanes that share a vmapped
+            # lax.while_loop chunk all run until the SLOWEST lane converges
+            # (random_effect dispatches buckets in fixed-size lane chunks),
+            # so stack each bucket's entities in active-row-count order —
+            # neighbours in a chunk then have homogeneous cost and a big
+            # entity never holds a chunk of tiny ones hostage. Pure
+            # packing: entity_index carries the permutation, and the
+            # row_index / INDEX_MAP projection below are built in the same
+            # (sorted) order, so scatter-back and projection are unchanged.
+            ents = ents[np.argsort(active_counts[ents], kind="stable")]
             st, ct = starts[ents], active_counts[ents]
             pos = np.arange(m)
             mask = pos[None, :] < ct[:, None]  # (E_b, m)

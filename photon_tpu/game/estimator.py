@@ -51,6 +51,14 @@ class RandomEffectConfig:
     # Feature-space projection for the per-entity solves (reference:
     # projector.ProjectorType on the random-effect data configuration).
     projection: Optional[object] = None  # game.projector.ProjectionConfig
+    # Block-loop software pipeline depth (RandomEffectCoordinate.
+    # pipeline_depth): in-flight bucket solves beyond the one being
+    # retired; 0 = sequential. Bit-identical at every depth.
+    pipeline_depth: int = 1
+    # Straggler mitigation (RandomEffectCoordinate.straggler_budget):
+    # first-pass iteration cap before the compacted full-depth re-solve
+    # of unconverged lanes. None = off (also disables on the fused path).
+    straggler_budget: Optional[int] = None
 
 
 CoordinateConfig = FixedEffectConfig | RandomEffectConfig
@@ -150,7 +158,12 @@ class GameEstimator:
         one's jit-compiled (vmapped) solver instead of recompiling it."""
         coords = {}
         for name, cfg in configs.items():
-            key = (self._dataset_key(cfg), cfg.optimizer)
+            # Solve knobs that live OUTSIDE cfg.optimizer but change the
+            # compiled/driven solve must be part of the coordinate cache key
+            # (the RE pipeline/straggler knobs select different programs).
+            knobs = ((cfg.pipeline_depth, cfg.straggler_budget)
+                     if isinstance(cfg, RandomEffectConfig) else ())
+            key = (self._dataset_key(cfg), cfg.optimizer, knobs)
             if cache is not None and key in cache:
                 coords[name] = cache[key]
                 continue
@@ -166,6 +179,8 @@ class GameEstimator:
                     datasets[name], self.task, cfg.optimizer,
                     mesh=self.mesh, variance=self.variance,
                     normalization=norm,
+                    pipeline_depth=cfg.pipeline_depth,
+                    straggler_budget=cfg.straggler_budget,
                 )
             if cache is not None:
                 cache[key] = coord

@@ -38,10 +38,10 @@ from photon_tpu.game.model import (
     _padded_coeffs,
     score_rows,
 )
+from photon_tpu.data.matrix import next_pow2
 from photon_tpu.game.random_effect import (
     _MAX_SOLVE_LANES,
     RETrainStats,
-    _next_pow2_int,
     _pad_axis0,
     dispatch_chunked,
 )
@@ -129,7 +129,7 @@ def _run_block_grid(solver, obj, l2s, l1s, batch, w0, e_real: int,
     one dispatch (game.random_effect.dispatch_chunked)."""
     n_dev = mesh.devices.size if mesh is not None else 1
     cap = max(1, _MAX_SOLVE_LANES // max(n_lanes, 1))
-    chunk = min(cap, _next_pow2_int(max(e_real, 1)))
+    chunk = min(cap, next_pow2(max(e_real, 1), 1))
     chunk = pad_to_multiple(chunk, n_dev)
     e_pad = pad_to_multiple(e_real, chunk)
     args = _pad_axis0((batch, w0), e_pad)
@@ -314,7 +314,7 @@ def fit_game_grid(
             cap = max(1, _MAX_SOLVE_LANES // max(G, 1))
             blocks = []
             for block in ds.blocks:
-                chunk = min(cap, _next_pow2_int(max(block.n_entities, 1)))
+                chunk = min(cap, next_pow2(max(block.n_entities, 1), 1))
                 chunk = pad_to_multiple(chunk, n_dev)
                 e_pad = pad_to_multiple(block.n_entities, chunk)
                 fused = (None if mesh is not None

@@ -10,10 +10,28 @@ chips. vmap of `lax.while_loop` runs all lanes until every entity converges,
 freezing finished lanes — the per-entity convergence mask the reference
 tracks via per-model OptimizationTrackers comes back in the vmapped
 OptResult for free.
+
+The block loop in :meth:`RandomEffectCoordinate.train` is a SOFTWARE
+PIPELINE (docs/PERF.md "GAME random-effect cost model"): bucket *k+1*'s
+upload and solve are dispatched BEFORE bucket *k*'s results are forced to
+host, so device compute overlaps the host-side scatter/projection — JAX's
+async dispatch makes this a reordering of the loop plus a small in-flight
+ledger (``pipeline_depth``, default a depth-1 double-buffer mirroring
+``ChunkedBatch.iter_device``'s prefetch). Buckets partition the entity set,
+so every interleaving is bit-identical to the sequential loop
+(``pipeline_depth=0``). Orthogonally, ``straggler_budget`` caps the first
+vmapped pass at a budgeted iteration count and re-solves ONLY the
+unconverged lanes — compacted into one small dense block
+(`parallel.mesh.compact_rows`) — to full depth, so one ill-conditioned
+entity no longer burns ``max_iters`` worth of MXU time for its whole
+chunk: total device lane-iterations drop from ``chunks × max(lane iters)``
+toward ``Σ per-entity iters``.
 """
 from __future__ import annotations
 
 import dataclasses
+import time
+from collections import deque
 from typing import Optional
 
 import jax
@@ -21,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from photon_tpu import telemetry
+from photon_tpu.data.matrix import next_pow2
 from photon_tpu.game.dataset import RandomEffectDataset, REBlock
 from photon_tpu.game.model import RandomEffectModel
 from photon_tpu.models.training import (
@@ -32,7 +52,7 @@ from photon_tpu.models.training import (
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.optim.config import OptimizerConfig
-from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple
+from photon_tpu.parallel.mesh import compact_rows, data_sharding, pad_to_multiple
 
 
 def _pad_axis0(tree, target: int):
@@ -147,11 +167,14 @@ def dispatch_chunked(solver_fns, head: tuple, args: tuple, chunk: int,
         lambda x: x.reshape((e_pad,) + x.shape[2:]), outs)
 
 
-def _next_pow2_int(x: int) -> int:
-    m = 1
-    while m < x:
-        m <<= 1
-    return m
+def _lane_chunk(e_real: int, n_dev: int = 1) -> int:
+    """Lane-chunk size for a bucket: next power of two of the entity count
+    (floor 1 — `data.matrix.next_pow2` is the single pow2 implementation),
+    capped at _MAX_SOLVE_LANES and rounded to a mesh multiple — so every
+    block compiles at a small fixed lane count and larger blocks lax.scan
+    over their chunks in ONE dispatch (dispatch_chunked)."""
+    return pad_to_multiple(min(_MAX_SOLVE_LANES, next_pow2(max(e_real, 1), 1)),
+                           n_dev)
 
 
 @dataclasses.dataclass
@@ -162,6 +185,29 @@ class RETrainStats:
     n_converged: int
     n_failed: int
     total_iterations: int
+    # (E,) int64 solver iterations per dense entity id (first pass + any
+    # compacted straggler re-solve), the per-entity tracker detail behind
+    # the totals. None on the fused one-dispatch path, which keeps only
+    # device-scalar totals.
+    iterations_per_entity: Optional[np.ndarray] = dataclasses.field(
+        default=None, compare=False, repr=False)
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched bucket in train()'s pipeline ledger: the block, its
+    PADDED device args (kept alive so the straggler repack can gather the
+    unconverged tail without re-uploading anything), and the solver outputs
+    that have not yet been forced to host."""
+
+    block: REBlock
+    e_real: int
+    chunk: int
+    with_prior: bool
+    obj: object
+    args: tuple
+    res: object
+    var: object
 
 
 @dataclasses.dataclass(eq=False)
@@ -177,6 +223,20 @@ class RandomEffectCoordinate:
     # vmapped objective runs in normalized space and coefficients convert
     # back per entity row below.
     normalization: Optional[object] = None
+    # Software-pipeline depth of train()'s block loop: how many bucket
+    # solves may be in flight before the oldest is forced to host, so
+    # device compute overlaps host scatter/projection. 1 = double-buffer
+    # (default; mirrors ChunkedBatch.iter_device's prefetch), 0 = the
+    # strictly sequential dispatch→readback→scatter loop. Buckets
+    # partition the entity set, so every depth is bit-identical.
+    pipeline_depth: int = 1
+    # Straggler mitigation: cap the first vmapped pass at this many
+    # iterations, then compact ONLY the unconverged lanes into one dense
+    # second pass run to config.max_iters (warm-started from the capped
+    # pass). None/0/≥max_iters = off. Changes iteration history (the
+    # second pass restarts L-BFGS curvature state) but not the optimum —
+    # per-entity problems are solved to the same tolerance.
+    straggler_budget: Optional[int] = None
 
     def __post_init__(self):
         ds = self.dataset
@@ -213,22 +273,57 @@ class RandomEffectCoordinate:
         return make_objective(self.task, self.config, dim,
                               normalization=norm)
 
-    def _run_block(self, solver, obj, lam, batch, w0, pm, pp, e_real):
-        """Dispatch one bucket's vmapped solve in lane chunks.
+    def _effective_budget(self) -> Optional[int]:
+        """The straggler first-pass iteration cap, or None when compaction
+        is off (unset, non-positive, or no smaller than max_iters)."""
+        b = self.straggler_budget
+        if b is None or b <= 0 or b >= self.config.max_iters:
+            return None
+        return int(b)
 
-        Chunk size: next power of two of the entity count, capped at
-        _MAX_SOLVE_LANES (and rounded to a mesh multiple) — so every block
-        compiles at a small fixed lane count; larger blocks lax.scan over
-        their chunks in ONE dispatch (dispatch_chunked).
-        """
+    def _resolve_stragglers(self, fl, idx, w_out, conv, fail, iters, var_h,
+                            lam):
+        """Compacted second pass: gather ONLY the unconverged lanes of a
+        capped first pass (typically a small tail) into one dense block and
+        run it to full max_iters, warm-started from the capped solution.
+        Mutates the host result arrays in place; returns nothing."""
+        n2 = int(idx.size)
         n_dev = self.mesh.devices.size if self.mesh is not None else 1
-        chunk = min(_MAX_SOLVE_LANES, _next_pow2_int(max(e_real, 1)))
-        chunk = pad_to_multiple(chunk, n_dev)
-        e_pad = pad_to_multiple(e_real, chunk)
-        args = (batch, w0) + ((pm, pp) if pm is not None else ())
-        args = _pad_axis0(args, e_pad)
-        return dispatch_chunked(solver, (obj, lam), args, chunk, e_pad,
-                                self.mesh)
+        chunk2 = _lane_chunk(n2, n_dev)
+        e_pad2 = pad_to_multiple(n2, chunk2)
+        # Device-side repack from the still-alive padded first-pass args:
+        # batch rows + priors gathered as-is, w0 replaced by the capped
+        # pass's coefficients (the warm start). No feature block crosses
+        # the host; dispatch_chunked re-shards onto the mesh as usual.
+        tail_args = compact_rows((fl.args[0], fl.res.w) + tuple(fl.args[2:]),
+                                 idx, pad_rows=e_pad2)
+        solver = self._solver_for(fl.with_prior)  # full-depth program
+        with telemetry.span("game_re.tail_solve", entities=n2):
+            res2, var2 = dispatch_chunked(solver, (fl.obj, lam), tail_args,
+                                          chunk2, e_pad2, self.mesh)
+            w2, conv2, fail2, it2, var2h = jax.device_get(
+                (res2.w, res2.converged, res2.failed, res2.iterations,
+                 var2 if var_h is not None else None))
+        it2 = np.asarray(it2, np.int64)[:n2]
+        first = iters.copy()
+        w_out[idx] = np.asarray(w2)[:n2]
+        conv[idx] = np.asarray(conv2, bool)[:n2]
+        fail[idx] = np.asarray(fail2, bool)[:n2]
+        iters[idx] += it2
+        if var_h is not None:
+            var_h[idx] = np.asarray(var2h)[:n2]
+        telemetry.count("game_re.straggler_entities", n2)
+        telemetry.count("game_re.tail_resolves")
+        # Iterations-saved estimate: uncapped, every first-pass chunk runs
+        # ALL its lanes to the chunk's slowest total (vmapped while_loop);
+        # compacted, chunks stop at the cap and the tail pays its own
+        # (dense) cost once. Device lane-iterations, clipped at 0.
+        chunk, e_pad = fl.chunk, first.shape[0]
+        k = e_pad // chunk
+        baseline = int(chunk * iters.reshape(k, chunk).max(axis=1).sum())
+        actual = (int(chunk * first.reshape(k, chunk).max(axis=1).sum())
+                  + e_pad2 * int(it2.max(initial=0)))
+        telemetry.count("game_re.iters_saved", max(baseline - actual, 0))
 
     def train(
         self,
@@ -286,55 +381,126 @@ class RandomEffectCoordinate:
             if self.variance is not VarianceComputationType.NONE
             else None
         )
-        n_conv = n_fail = total_iters = 0
-        for block in ds.blocks:
-            batch = ds.block_batch(block, offsets_full)
-            w0_full = coeffs[block.entity_index]
-            # Project warm starts / priors into this bucket's solve space
-            # (reference: ProjectionMatrix.projectCoefficients).
-            if block.proj is not None:  # INDEX_MAP
-                from photon_tpu.game.projector import gather_rows
+        n_conv = n_fail = 0
+        iters_per_entity = np.zeros((E,), np.int64)
+        lam = _l1_lam(self.config)
+        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        # One upload of the shared offsets; block_batch gathers per bucket.
+        offsets_dev = jnp.asarray(offsets_full, jnp.float32)
+        budget = self._effective_budget()
+        capped = (None if budget is None else
+                  dataclasses.replace(_static_config(self.config),
+                                      max_iters=budget))
 
-                w0 = jnp.asarray(gather_rows(w0_full, block.proj))
-                pm = pp = None
-                if prior_means is not None:
-                    pm = jnp.asarray(
-                        gather_rows(prior_means[block.entity_index], block.proj))
-                    pp = jnp.asarray(
-                        gather_rows(prior_precs[block.entity_index], block.proj))
-            elif ds.projector is not None:  # RANDOM
-                w0 = jnp.asarray(ds.projector.project_coeffs(w0_full))
-                pm = pp = None
-            else:
-                w0 = jnp.asarray(w0_full)
-                pm = pp = None
-                if prior_means is not None:
-                    pm = jnp.asarray(prior_means[block.entity_index])
-                    pp = jnp.asarray(prior_precs[block.entity_index])
+        def dispatch(block: REBlock) -> _InFlight:
+            """Pipeline stage 1: host prep + non-blocking upload + solve
+            dispatch for one bucket. Nothing here waits on the device."""
+            with telemetry.span("game_re.upload", m=block.m,
+                                entities=block.n_entities):
+                batch = ds.block_batch(block, offsets_dev)
+                w0_full = coeffs[block.entity_index]
+                # Project warm starts / priors into this bucket's solve
+                # space (reference: ProjectionMatrix.projectCoefficients).
+                if block.proj is not None:  # INDEX_MAP
+                    from photon_tpu.game.projector import gather_rows
+
+                    w0 = jnp.asarray(gather_rows(w0_full, block.proj))
+                    pm = pp = None
+                    if prior_means is not None:
+                        pm = jnp.asarray(gather_rows(
+                            prior_means[block.entity_index], block.proj))
+                        pp = jnp.asarray(gather_rows(
+                            prior_precs[block.entity_index], block.proj))
+                elif ds.projector is not None:  # RANDOM
+                    w0 = jnp.asarray(ds.projector.project_coeffs(w0_full))
+                    pm = pp = None
+                else:
+                    w0 = jnp.asarray(w0_full)
+                    pm = pp = None
+                    if prior_means is not None:
+                        pm = jnp.asarray(prior_means[block.entity_index])
+                        pp = jnp.asarray(prior_precs[block.entity_index])
             e_real = block.n_entities
-            d_solve = block.dim if block.dim is not None else d
-            solver = self._solver_for(pm is not None)
-            obj = self._block_objective(d_solve)
-            res, var = self._run_block(solver, obj, _l1_lam(self.config),
-                                       batch, w0, pm, pp, e_real)
-            w_out = np.asarray(res.w)[:e_real]
+            with_prior = pm is not None
+            obj = self._block_objective(
+                block.dim if block.dim is not None else d)
+            # Straggler mode runs the budget-capped variant of the SAME
+            # cached solver family; the full-depth program only ever sees
+            # the compacted tail.
+            solver = (_re_solver(with_prior, capped, self.variance)
+                      if capped is not None else self._solver_for(with_prior))
+            chunk = _lane_chunk(e_real, n_dev)
+            e_pad = pad_to_multiple(e_real, chunk)
+            args = _pad_axis0((batch, w0) + ((pm, pp) if with_prior else ()),
+                              e_pad)
+            with telemetry.span("game_re.solve", m=block.m, entities=e_real):
+                res, var = dispatch_chunked(solver, (obj, lam), args, chunk,
+                                            e_pad, self.mesh)
+            telemetry.count("game_re.blocks")
+            return _InFlight(block, e_real, chunk, with_prior, obj, args,
+                             res, var)
+
+        def retire(fl: _InFlight) -> None:
+            """Pipeline stage 2: force the OLDEST in-flight bucket's outputs
+            to host and scatter/project them back — while any younger
+            bucket's solve still runs on device."""
+            nonlocal n_conv, n_fail
+            block, e_real = fl.block, fl.e_real
+            t0 = time.perf_counter_ns()
+            with telemetry.span("game_re.readback", m=block.m):
+                w_out, conv, fail, iters, var_h = jax.device_get(
+                    (fl.res.w, fl.res.converged, fl.res.failed,
+                     fl.res.iterations,
+                     fl.var if variances is not None else None))
+            telemetry.count("game_re.readback_wait_ns",
+                            time.perf_counter_ns() - t0)
+            # device_get buffers may be read-only; the straggler pass (and
+            # nothing else) writes into them.
+            w_out = np.asarray(w_out)
+            conv = np.array(conv, bool)
+            fail = np.array(fail, bool)
+            iters = np.asarray(iters).astype(np.int64)
+            if var_h is not None:
+                var_h = np.array(var_h)
+            if capped is not None:
+                strag = np.nonzero(~conv[:e_real] & ~fail[:e_real])[0]
+                if strag.size:
+                    w_out = np.array(w_out)
+                    self._resolve_stragglers(fl, strag, w_out, conv, fail,
+                                             iters, var_h, lam)
+            w_out = w_out[:e_real]
             if block.proj is not None:
                 from photon_tpu.game.projector import scatter_rows_into
 
-                scatter_rows_into(coeffs, w_out, block.entity_index, block.proj)
+                scatter_rows_into(coeffs, w_out, block.entity_index,
+                                  block.proj)
                 if variances is not None:
-                    scatter_rows_into(
-                        variances, np.asarray(var)[:e_real],
-                        block.entity_index, block.proj)
+                    scatter_rows_into(variances, var_h[:e_real],
+                                      block.entity_index, block.proj)
             elif ds.projector is not None:
                 coeffs[block.entity_index] = ds.projector.back_project(w_out)
             else:
                 coeffs[block.entity_index] = w_out
                 if variances is not None:
-                    variances[block.entity_index] = np.asarray(var)[:e_real]
-            n_conv += int(np.asarray(res.converged)[:e_real].sum())
-            n_fail += int(np.asarray(res.failed)[:e_real].sum())
-            total_iters += int(np.asarray(res.iterations)[:e_real].sum())
+                    variances[block.entity_index] = var_h[:e_real]
+            n_conv += int(conv[:e_real].sum())
+            n_fail += int(fail[:e_real].sum())
+            iters_per_entity[block.entity_index] = iters[:e_real]
+
+        # The pipeline: dispatch runs ahead of retire by up to
+        # `pipeline_depth` buckets. Buckets partition the entity set, so
+        # dispatch(k+1)'s warm-start gather never reads rows retire(k)
+        # writes — any depth is bit-identical to depth 0.
+        pending: deque = deque()
+        depth = max(int(self.pipeline_depth), 0)
+        for block in ds.blocks:
+            pending.append(dispatch(block))
+            telemetry.gauge("game_re.blocks_in_flight", len(pending))
+            while len(pending) > depth:
+                retire(pending.popleft())
+        while pending:
+            retire(pending.popleft())
+        total_iters = int(iters_per_entity.sum())
         if norm is not None:
             coeffs = norm.rows_to_original_space(coeffs)
             if variances is not None:
@@ -348,7 +514,8 @@ class RandomEffectCoordinate:
             key_to_index=ds.key_to_index,
             variances=None if variances is None else jnp.asarray(variances),
         )
-        return model, RETrainStats(E, n_conv, n_fail, total_iters)
+        return model, RETrainStats(E, n_conv, n_fail, total_iters,
+                                   iters_per_entity)
 
     def score(self, model: RandomEffectModel) -> jax.Array:
         """Per-row margin for ALL rows — active and passive — via one gather
@@ -375,6 +542,10 @@ class RandomEffectCoordinate:
             return cached
         ds = self.dataset
         if (ds.projection is not None or self.mesh is not None
+                # the compacted straggler re-solve needs the host repack
+                # between passes — it cannot live inside one jit program,
+                # so a budgeted coordinate takes the pipelined train() path
+                or self._effective_budget() is not None
                 or (self.normalization is not None
                     and not self.normalization.is_identity)):
             return None
@@ -383,8 +554,7 @@ class RandomEffectCoordinate:
         blocks_args = []  # (row_index, ents, batch_base) per block — arrays
         n = int(ds.entity_dense.shape[0])
         for block in ds.blocks:
-            chunk = min(_MAX_SOLVE_LANES,
-                        _next_pow2_int(max(block.n_entities, 1)))
+            chunk = _lane_chunk(block.n_entities)
             e_pad = pad_to_multiple(block.n_entities, chunk)
             meta.append((chunk, e_pad, block.n_entities))
             base_batch = ds.block_batch(block, np.zeros((n,), np.float32))
@@ -455,18 +625,13 @@ def _fused_re_fn(solver_fns, meta: tuple, task, variance):
 from photon_tpu.analysis.contracts import register_contract  # noqa: E402
 
 
-@register_contract(
-    name="game_re_vmapped_solve",
-    description="one random-effect bucket's vmapped per-entity L-BFGS "
-                "solves: E lanes, zero communication, no transfers inside "
-                "the vmapped while_loop",
-    collectives={}, tags=("game", "lane"))
-def _contract_re_vmapped_solve():
+def _re_contract_fixture(max_iters: int = 5):
+    """Shared (raw solver, obj, batch, w0) fixture for the game_re specs."""
     from photon_tpu.data.dataset import GLMBatch
     from photon_tpu.optim.regularization import l2
 
     E, m, d = 4, 16, 5
-    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+    cfg = OptimizerConfig(max_iters=max_iters, tolerance=1e-7, reg=l2(),
                           reg_weight=0.3, history=3)
     raw = _re_solver(False, _static_config(cfg),
                      VarianceComputationType.NONE)[1]
@@ -476,4 +641,48 @@ def _contract_re_vmapped_solve():
                      weights=jnp.ones((E, m), jnp.float32),
                      offsets=jnp.zeros((E, m), jnp.float32))
     w0 = jnp.zeros((E, d), jnp.float32)
+    return raw, obj, batch, w0
+
+
+@register_contract(
+    name="game_re_vmapped_solve",
+    description="one random-effect bucket's vmapped per-entity L-BFGS "
+                "solves: E lanes, zero communication, no transfers inside "
+                "the vmapped while_loop",
+    collectives={}, tags=("game", "lane"))
+def _contract_re_vmapped_solve():
+    raw, obj, batch, w0 = _re_contract_fixture()
     return (lambda o, b, w: raw(o, None, b, w)), (obj, batch, w0)
+
+
+@register_contract(
+    name="game_re_budgeted_first_pass",
+    description="the straggler-capped first pass: the SAME vmapped lane "
+                "program at a budgeted max_iters — capping iterations must "
+                "not change the zero-collective / no-transfer story the "
+                "pipelined block loop rests on",
+    collectives={}, tags=("game", "lane"))
+def _contract_re_budgeted_first_pass():
+    # max_iters=2 stands in for dataclasses.replace(cfg, max_iters=budget):
+    # the capped solver is the same cached family at a smaller static bound.
+    raw, obj, batch, w0 = _re_contract_fixture(max_iters=2)
+    return (lambda o, b, w: raw(o, None, b, w)), (obj, batch, w0)
+
+
+@register_contract(
+    name="game_re_straggler_resolve",
+    description="the compacted straggler re-solve: device-side gather of "
+                "the unconverged tail (parallel.mesh.compact_rows) + the "
+                "dense full-depth second pass — zero collectives off-mesh, "
+                "no transfer/callback primitives inside the vmapped "
+                "while_loop",
+    collectives={}, tags=("game", "lane"))
+def _contract_re_straggler_resolve():
+    raw, obj, batch, w0 = _re_contract_fixture()
+
+    def fn(o, b, w, idx):
+        tail_b, tail_w = compact_rows((b, w), idx, pad_rows=4)
+        return raw(o, None, tail_b, tail_w)
+
+    idx = jnp.asarray(np.asarray([1, 3]), jnp.int32)
+    return fn, (obj, batch, w0, idx)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -227,6 +228,41 @@ def fetch_local_rows(arr, mesh: Mesh) -> np.ndarray:
     shards = sorted(arr.addressable_shards,
                     key=lambda sh: sh.index[0].start or 0)
     return np.stack([np.asarray(sh.data) for sh in shards])
+
+
+def compact_rows(tree, idx, pad_rows: int | None = None,
+                 mesh: Mesh | None = None):
+    """Gather leading-axis rows ``idx`` from every leaf of a device tree
+    into a dense zero-padded ``(pad_rows, ...)`` block — the straggler
+    repack of the random-effect pipeline (game/random_effect.py): the
+    unconverged tail of a capped vmapped pass is compacted into one small
+    dense block and re-solved to full depth.
+
+    The gather runs ON DEVICE (one fancy-index program per leaf shape; no
+    host round-trip of the feature blocks), so ``idx`` may index a
+    mesh-sharded entity axis on any single-slice/addressable mesh. With
+    ``mesh`` given the compacted block is re-sharded across all mesh axes
+    (``data_sharding``) so the tail pass runs sharded exactly like the
+    first pass; callers routing through ``dispatch_chunked`` pass
+    ``mesh=None`` and let the dispatcher place the block. Zero-padded rows
+    carry weight 0 in every GLMBatch, so no reduction sees them.
+    """
+    idx = idx if isinstance(idx, jax.Array) else jnp.asarray(
+        np.asarray(idx), jnp.int32)
+    n = int(idx.shape[0])
+    target = n if pad_rows is None else int(pad_rows)
+
+    def take(x):
+        g = jnp.take(x, idx, axis=0)
+        if target != n:
+            widths = [(0, target - n)] + [(0, 0)] * (g.ndim - 1)
+            g = jnp.pad(g, widths)
+        return g
+
+    out = jax.tree_util.tree_map(take, tree)
+    if mesh is not None:
+        out = jax.device_put(out, data_sharding(mesh))
+    return out
 
 
 # ----------------------------------------------------------------- contracts

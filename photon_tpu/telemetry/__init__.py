@@ -20,7 +20,11 @@ to `jax.profiler.TraceAnnotation`, so they appear on XProf timelines;
 automatically), **counters/gauges** (chunk uploads, upload-stall seconds,
 prefetch depth, evaluations, line-search trials, margin-cache hits/
 refreshes, retraces via `analysis.TraceSignatureLog`, GAME sweep stats,
-HBM watermarks), and the **iteration stream** — one event per solver
+the random-effect block pipeline's `game_re.*` family —
+blocks/blocks_in_flight/readback_wait_ns plus the straggler compaction's
+straggler_entities/tail_resolves/iters_saved, with per-block
+upload/solve/readback/tail_solve spans — and HBM watermarks), and the
+**iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted
 resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`
 compiled out by default; the registered `telemetry_off_is_free`

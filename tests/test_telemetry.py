@@ -363,6 +363,43 @@ class TestGameStream:
         assert r.counters["game.sweeps"] == 2.0
         assert r.counters["game.grid_points"] == 1.0
 
+    def test_re_pipeline_counters_and_spans(self, rng):
+        """The round-8 game_re.* spine: per-block upload/solve/readback
+        spans + the pipeline/straggler counters, surfaced by
+        report_compact() (the piece BENCH_*.json embeds)."""
+        from photon_tpu.game import GameData, RandomEffectCoordinate, \
+            RandomEffectDataset
+
+        n_entities, d = 10, 3
+        ent = np.repeat(np.arange(n_entities), 20)
+        n = ent.shape[0]
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        yv = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        data = GameData.build(yv, {"s": X}, {"e": ent})
+        ds = RandomEffectDataset.build(data, "e", "s")
+        cfg = OptimizerConfig(max_iters=30, tolerance=1e-7, reg=reg.l2(),
+                              reg_weight=1e-2, history=4)
+        coord = RandomEffectCoordinate(
+            ds, TaskType.LOGISTIC_REGRESSION, cfg,
+            pipeline_depth=1, straggler_budget=1)
+        r = telemetry.start_run("game_re")
+        coord.train(np.zeros(n, np.float32))
+        telemetry.finish_run()
+        assert r.counters["game_re.blocks"] >= 1.0
+        assert "game_re.readback_wait_ns" in r.counters
+        assert r.gauges["game_re.blocks_in_flight"] >= 1
+        # budget=1 guarantees a straggler tail on this problem
+        assert r.counters["game_re.straggler_entities"] >= 1.0
+        assert r.counters["game_re.tail_resolves"] >= 1.0
+        assert "game_re.iters_saved" in r.counters
+        totals = r.span_totals()
+        for name in ("game_re.upload", "game_re.solve",
+                     "game_re.readback", "game_re.tail_solve"):
+            assert name in totals, name
+        compact = r.report_compact()
+        assert "game_re.blocks" in compact["counters"]
+        assert "game_re.readback_wait_ns" in compact["counters"]
+
 
 # ------------------------------------------------------- photon_logger fix
 class TestPhotonLoggerLevels:
