@@ -67,6 +67,13 @@ def main() -> None:
                         "straggler-compacted random-effect block loop vs "
                         "the sequential one, skewed entity sizes) and "
                         "print its JSON line")
+    p.add_argument("--serving-leg", action="store_true",
+                   help="also run bench.py's serving_qps leg (closed-loop "
+                        "online scoring over a zipf entity mix through "
+                        "the photon_tpu/serving micro-batching "
+                        "dispatcher; QPS + p50/p95/p99 latency, with the "
+                        "never-retraces assertion) and print its JSON "
+                        "line")
     args = p.parse_args()
 
     import _flagship_data as fd
@@ -165,6 +172,22 @@ def main() -> None:
             "rows_iters_per_sec_per_chip": round(pipe, 1),
             "sequential_rows_iters_per_sec_per_chip": round(seq, 1),
             "speedup_vs_sequential": round(pipe / seq, 3)}), flush=True)
+
+    if args.serving_leg:
+        # bench.py's serving_qps leg verbatim: the online-scoring regime
+        # (many tiny micro-batched requests) measured and retrace-checked
+        # beside the training flagship it serves.
+        import bench
+
+        sv_ladder, sv_pool = bench.serving_problem()
+        stats = bench.run_serving(sv_ladder, sv_pool)
+        print(json.dumps({
+            "leg": "serving_qps",
+            "qps": round(stats["qps"], 1),
+            "p50_ms": round(stats["p50_ms"], 3),
+            "p95_ms": round(stats["p95_ms"], 3),
+            "p99_ms": round(stats["p99_ms"], 3),
+            "n_requests": stats["n_requests"]}), flush=True)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.game.coordinate_descent",  # fused GAME coordinate update
     "photon_tpu.drivers.score",       # chunked scoring driver program
     "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
+    "photon_tpu.serving.programs",    # online per-request scoring ladder
 )
 
 

@@ -1133,6 +1133,17 @@ def next_pow2(x: int, floor: int = 2) -> int:
     return m
 
 
+def quantize_rows(n: int, quantum: int) -> int:
+    """Smallest multiple of ``quantum`` ≥ n (≥ quantum) — the linear rung of
+    the static-shape height ladder. Chunked paths whose heights cluster
+    around a known chunk size (the scoring driver's streamed blocks)
+    quantize linearly so XLA compiles a handful of shapes without pow2's
+    up-to-2× pad waste; open-ended heights (serving request batches,
+    entity lane counts) bucket by `next_pow2` instead."""
+    q = int(quantum)
+    return max((max(int(n), 1) + q - 1) // q * q, q)
+
+
 def last_column_is_intercept(X: Matrix) -> bool:
     """True when the design matrix's last column is constant 1 — the
     data.feature_bags intercept-last convention."""

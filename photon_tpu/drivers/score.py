@@ -30,7 +30,7 @@ from photon_tpu import telemetry
 from photon_tpu.data.avro_io import AvroBlockWriter
 from photon_tpu.data.feature_bags import FeatureShardConfig
 from photon_tpu.data.ingest import GameDataConfig
-from photon_tpu.data.matrix import SparseRows
+from photon_tpu.data.matrix import SparseRows, quantize_rows
 from photon_tpu.data.model_io import load_game_model
 from photon_tpu.data.streaming import iter_game_chunks
 from photon_tpu.evaluation.evaluator import default_evaluator
@@ -203,10 +203,9 @@ def _pad_chunk(chunk: GameData, H: int) -> GameData:
                     shards, ids)
 
 
-def _quantize(n: int) -> int:
-    from photon_tpu.parallel.mesh import pad_to_multiple
-
-    return pad_to_multiple(max(n, 1), _PAD_QUANTUM)
+# Chunk heights quantize through the shared data.matrix height-ladder
+# helper (quantize_rows — the linear rung; the serving tier's request
+# ladder is the pow2 rung, next_pow2).
 
 
 # --------------------------------------------------------------------------
@@ -331,7 +330,12 @@ def run_scoring(params: ScoringParams) -> ScoringOutput:
                     params.uid_field)
                 if uid_present is None:
                     uid_present = np.ones(n_c, bool)
-                padded = _pad_chunk(chunk, _quantize(n_c))
+                H = quantize_rows(n_c, _PAD_QUANTUM)
+                # pad-waste rides the serving counter family: offline
+                # chunked scoring and the online dispatcher report the
+                # same ladder overhead under one name.
+                telemetry.count("serving.pad_waste", H - n_c)
+                padded = _pad_chunk(chunk, H)
                 margin_dev = score_game(model, padded.to_device())
                 out_dev = model.mean(margin_dev) if params.output_mean \
                     else margin_dev

@@ -1,0 +1,71 @@
+"""Online serving tier: low-latency per-request GAME scoring.
+
+The reference serves its trained GAME models online — fixed-effect
+coefficients plus a PalDB-backed per-entity random-effect store behind a
+request-scoring service. This package is that tier for the TPU port,
+built for the regime the ROADMAP's "millions of users" north star
+implies: many TINY requests, where launch overhead, retraces, and tail
+latency — not MXU utilization — are the cost model (docs/SERVING.md).
+
+Three planes:
+
+- **Program plane** (`programs.ProgramLadder`): AOT-exported scoring
+  executables at a pow2 batch-size ladder, one program per
+  (model, rung), replayed through `utils/aot.py::AotStore` so a warm
+  serving process NEVER traces. Registered ContractSpecs
+  (`serving_request_program`, `serving_request_margin`) pin the
+  per-request program to zero collectives / zero host exits / no f64;
+  a live `TraceSignatureLog` proves at most one executable per rung.
+- **Model plane** (`store.CoefficientStore`): flat mmap-able coefficient
+  blocks — fixed-effect vectors plus per-entity random-effect matrices
+  with an all-zero cold-miss row — keyed by the existing
+  `data/index_map.py` machinery (`IndexMap` / `PalDBIndexMap`) as the
+  entity→row directory. Unseen entities degrade gracefully to the
+  fixed-effect-only score and are counted (`serving.cold_misses`).
+- **Request plane** (`dispatcher.MicroBatchDispatcher`): bounded queue,
+  deadline-based flush (``max_batch`` / ``max_delay_us``), padded
+  dispatch into the nearest rung, asynchronous device_get, `serving.*`
+  telemetry spans/counters and p50/p95/p99 request latency.
+
+Parity: dispatcher-batched scores are bit-identical to the offline
+`drivers/score.py` path for the same model and rows (tests/test_serving.py).
+
+::
+
+    from photon_tpu import serving
+
+    store = serving.CoefficientStore.from_game_model(model)
+    ladder = serving.ProgramLadder(store, max_batch=256,
+                                   aot_dir="/models/ads/aot")
+    ladder.warmup()                       # startup: no traces after this
+    d = serving.MicroBatchDispatcher(ladder, max_delay_us=500)
+    score = d.score(serving.ScoreRequest(
+        features={"global": x_row, "member": (idx, val)},
+        entities={"memberId": "m123"}))
+
+CLI: ``python -m photon_tpu.serving --selftest`` spins up the store +
+dispatcher in-process, scores a canned request mix, and exits non-zero
+on any parity / contract / retrace / latency-accounting failure.
+"""
+from __future__ import annotations
+
+from photon_tpu.serving.dispatcher import (  # noqa: F401
+    MicroBatchDispatcher,
+    ScoreRequest,
+)
+from photon_tpu.serving.programs import (  # noqa: F401
+    LADDER_SCHEMA,
+    ProgramLadder,
+    ShardSpec,
+)
+from photon_tpu.serving.store import (  # noqa: F401
+    CoefficientStore,
+    FixedBlock,
+    RandomBlock,
+)
+
+__all__ = [
+    "CoefficientStore", "FixedBlock", "RandomBlock",
+    "ProgramLadder", "ShardSpec", "LADDER_SCHEMA",
+    "MicroBatchDispatcher", "ScoreRequest",
+]

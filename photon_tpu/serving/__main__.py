@@ -1,0 +1,196 @@
+"""CLI: smoke-check the serving tier in-process.
+
+    python -m photon_tpu.serving --selftest          # exit 1 on failure
+    python -m photon_tpu.serving --selftest --json   # machine report
+
+Mirrors `analysis.__main__` / `telemetry.__main__`: environment defaults
+are applied BEFORE jax loads so it runs anywhere CI does. The selftest
+builds a tiny GameModel, freezes it into a `CoefficientStore`, spins up
+the `ProgramLadder` + `MicroBatchDispatcher`, scores a canned request
+mix (mixed batch sizes, seen + unseen entities), and checks:
+
+- **parity**: dispatcher scores bit-identical to the offline
+  `score_game` program on the same rows (including the cold-miss
+  fixed-effect-only fallback);
+- **no retrace**: the `TraceSignatureLog` saw at most one signature per
+  ladder rung and zero weak-type drift;
+- **contracts**: the registered `serving_request_*` ContractSpecs trace
+  clean (zero collectives / host exits / f64);
+- **latency accounting**: every request produced exactly one recorded
+  latency, percentiles are ordered, and the `serving.*` counters add up.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def build_demo_model(seed: int = 0, n_entities: int = 16,
+                     d_fixed: int = 6, d_re: int = 4):
+    """A tiny two-coordinate GAME model (dense fixed shard + sparse
+    random-effect shard) with real coefficients — shared by the selftest
+    and tests/test_serving.py."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.game.model import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.ops.losses import TaskType
+
+    rng = np.random.default_rng(seed)
+    task = TaskType.LOGISTIC_REGRESSION
+    w_fixed = rng.normal(size=d_fixed).astype(np.float32)
+    keys = np.asarray(sorted(f"e{i:03d}" for i in range(n_entities)))
+    C = rng.normal(size=(n_entities, d_re)).astype(np.float32)
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(jnp.asarray(w_fixed)), task),
+            "global"),
+        "perEntity": RandomEffectModel(
+            entity_name="memberId", feature_shard="member", task=task,
+            coefficients=jnp.asarray(C), entity_keys=keys,
+            key_to_index={k: i for i, k in enumerate(keys.tolist())}),
+    }, task)
+    return model, rng
+
+
+def _selftest(as_json: bool) -> int:
+    import numpy as np
+
+    from photon_tpu import serving, telemetry
+
+    checks: dict[str, str] = {}
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks[name] = "" if ok else (detail or "failed")
+
+    model, rng = build_demo_model()
+    d_fixed = int(model["fixed"].model.coefficients.dim)
+    d_re = model["perEntity"].dim
+    sparse_k = 3
+
+    store = serving.CoefficientStore.from_game_model(model)
+    # rungs ≥ 8: the bit-parity-safe ladder (see ProgramLadder docstring)
+    ladder = serving.ProgramLadder(store, ladder=(8, 16),
+                                   sparse_k={"member": sparse_k},
+                                   output_mean=True)
+    ladder.warmup()
+
+    # canned request mix: ragged sizes across every rung, ~20% unseen
+    # entities (the cold-miss fallback), offsets exercised
+    n_req = 37
+    xg = rng.normal(size=(n_req, d_fixed)).astype(np.float32)
+    ind = rng.integers(0, d_re, size=(n_req, sparse_k)).astype(np.int32)
+    val = rng.normal(size=(n_req, sparse_k)).astype(np.float32)
+    offs = rng.normal(size=n_req).astype(np.float32)
+    ents = [f"e{i % 20:03d}" for i in range(n_req)]  # e016..e019 unseen
+    reqs = [serving.ScoreRequest(
+        features={"global": xg[i], "member": (ind[i], val[i])},
+        entities={"memberId": ents[i]}, offset=float(offs[i]))
+        for i in range(n_req)]
+
+    r = telemetry.start_run("serving_selftest")
+    d = serving.MicroBatchDispatcher(ladder, max_batch=16, max_delay_us=2000)
+    try:
+        futs = [d.submit(q) for q in reqs]
+        got = np.asarray([f.result(timeout=30) for f in futs], np.float32)
+    finally:
+        d.close()
+        telemetry.finish_run()
+
+    # parity vs the offline chunk program (score_game on the same rows)
+    from photon_tpu.data.matrix import SparseRows
+    from photon_tpu.game.dataset import GameData
+    from photon_tpu.game.scoring import score_game
+
+    data = GameData.build(
+        np.zeros(n_req, np.float32),
+        {"global": xg, "member": SparseRows(ind, val, d_re)},
+        {"memberId": np.asarray(ents)}, offsets=offs)
+    want = np.asarray(model.mean(score_game(model, data)), np.float32)
+    check("offline_parity_bitwise",
+          got.tobytes() == want.tobytes(),
+          f"max |Δ| = {np.abs(got - want).max()}")
+
+    # the cold-miss rows really fell back to fixed-effect-only
+    miss = np.asarray([int(e[1:]) >= 16 for e in ents])
+    data_f = GameData.build(
+        np.zeros(n_req, np.float32),
+        {"global": xg, "member": SparseRows(ind, val, d_re)},
+        {"memberId": np.asarray(["zz"] * n_req)}, offsets=offs)
+    fixed_only = np.asarray(model.mean(score_game(model, data_f)), np.float32)
+    check("cold_miss_fallback",
+          bool((got[miss] == fixed_only[miss]).all()) and int(miss.sum()) > 0,
+          "cold-miss rows differ from the fixed-effect-only score")
+
+    # no retrace: at most one signature per rung, no weak-type drift
+    try:
+        n_sigs = ladder.assert_no_retrace()
+        check("no_retrace", True)
+        check("ladder_bounded", n_sigs <= len(ladder.ladder),
+              f"{n_sigs} sigs > {len(ladder.ladder)} rungs")
+    except AssertionError as e:
+        check("no_retrace", False, str(e))
+
+    # registered serving contracts trace clean
+    from photon_tpu.analysis.contracts import REGISTRY, check_contract
+
+    for name in ("serving_request_program", "serving_request_margin"):
+        spec = REGISTRY.get(name)
+        if spec is None:
+            check(f"contract_{name}", False, "spec not registered")
+        else:
+            vs = check_contract(spec)
+            check(f"contract_{name}", not vs,
+                  "; ".join(str(v) for v in vs))
+
+    # latency + counter accounting
+    stats = d.latency_stats()
+    check("latency_accounting",
+          stats["n"] == n_req
+          and stats["p50_ms"] <= stats["p95_ms"] <= stats["p99_ms"],
+          f"stats: {stats}")
+    counters = r.counters
+    check("counter_accounting",
+          counters.get("serving.requests") == float(n_req)
+          and counters.get("serving.batches", 0) >= 1
+          and counters.get("serving.cold_misses") == float(miss.sum()),
+          f"counters: { {k: v for k, v in sorted(counters.items())} }")
+
+    failures = {k: v for k, v in checks.items() if v}
+    if as_json:
+        import json as _json
+
+        print(_json.dumps({"ok": not failures, "checks": {
+            k: (v or "ok") for k, v in checks.items()},
+            "latency": stats}))
+    else:
+        for k in checks:
+            print(("ok   " if not checks[k] else "FAIL ") + k
+                  + (f": {checks[k]}" if checks[k] else ""))
+        print(f"{len(checks)} check(s), {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _default_env()
+    if "--selftest" in argv:
+        return _selftest("--json" in argv)
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Micro-batching dispatcher: the serving tier's request plane.
+
+Per-request device dispatch on an accelerator is ruinous at tiny sizes —
+launch overhead dwarfs the math (docs/SERVING.md cost model) — so
+requests coalesce: a bounded queue feeds a dispatch thread that collects
+up to ``max_batch`` requests or until the OLDEST queued request has
+waited ``max_delay_us``, pads the batch into the nearest
+`ProgramLadder` rung (zero rows — exactly the offline driver's pad
+convention, so pad rows never perturb real ones), resolves entity keys
+through the `CoefficientStore` (cold misses degrade to the zero
+coefficient row and are counted), and dispatches ONE program. A
+separate retire thread performs the blocking ``device_get`` — dispatch
+of batch i+1 overlaps the readback of batch i, the same one-deep
+software pipeline the offline scorer uses.
+
+Telemetry (`serving.*` family, names listed in
+``photon_tpu/telemetry/__init__``): requests/batches/batch_rows/
+pad_waste/cold_misses counters, queue-depth and batch-fill gauges, one
+``serving_batch`` event per flush, and per-request wall latency recorded
+request-enqueue → score-delivered, summarized as p50/p95/p99 by
+`latency_stats` (gauged at `close`).
+
+Thread-safety: `submit`/`score` are safe from any number of client
+threads; results arrive on `concurrent.futures.Future`s.
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import telemetry
+from photon_tpu.data.matrix import SparseRows
+from photon_tpu.serving.programs import ProgramLadder
+from photon_tpu.serving.store import CoefficientStore
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One scoring request: per-shard feature rows + entity keys.
+
+    features: shard name → dense ``(d,)`` vector, or ``(indices, values)``
+        arrays of length ≤ the shard's ``sparse_k`` (padded-COO row).
+    entities: entity-type name → raw key (e.g. ``{"memberId": "m123"}``).
+        A missing or unseen key scores the fixed-effect-only fallback.
+    offset: base margin offset (the reference's per-record offset column).
+    """
+
+    features: dict
+    entities: dict = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+
+
+class _Pending:
+    __slots__ = ("req", "future", "t_enqueue")
+
+    def __init__(self, req: ScoreRequest):
+        self.req = req
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter_ns()
+
+
+class MicroBatchDispatcher:
+    """Bounded-queue, deadline-flushed micro-batcher over a ProgramLadder.
+
+    max_batch: flush size cap; defaults to (and may not exceed) the
+        ladder's top rung.
+    max_delay_us: oldest-request deadline — the latency the thinnest
+        traffic pays to fill batches.
+    queue_depth: bound on queued requests; `submit` blocks when full
+        (backpressure, never unbounded memory).
+    """
+
+    def __init__(self, ladder: ProgramLadder, *,
+                 max_batch: Optional[int] = None,
+                 max_delay_us: int = 500,
+                 queue_depth: int = 4096):
+        self.ladder = ladder
+        self.store: CoefficientStore = ladder.store
+        self.max_batch = int(max_batch or ladder.max_batch)
+        if self.max_batch > ladder.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the ladder top rung "
+                f"{ladder.max_batch}")
+        self.max_delay_us = int(max_delay_us)
+        self._q: queue.Queue = queue.Queue(maxsize=int(queue_depth))
+        self._retire_q: queue.Queue = queue.Queue(maxsize=4)
+        self._latencies_ns: list = []
+        self._lat_lock = threading.Lock()
+        self._closed = False
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="serving-dispatch", daemon=True)
+        self._retire_thread = threading.Thread(
+            target=self._retire_loop, name="serving-retire", daemon=True)
+        self._dispatch_thread.start()
+        self._retire_thread.start()
+
+    # ------------------------------------------------------------- client API
+    def submit(self, req: ScoreRequest) -> Future:
+        """Enqueue one request; the Future resolves to its float score."""
+        if self._closed:
+            raise RuntimeError("dispatcher is closed")
+        p = _Pending(req)
+        self._q.put(p)  # blocks when the bounded queue is full
+        return p.future
+
+    def score(self, req: ScoreRequest, timeout: Optional[float] = None):
+        """Synchronous scoring: submit + wait (closed-loop clients)."""
+        return self.submit(req).result(timeout=timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Flush every queued request, stop both threads, gauge the final
+        latency percentiles into telemetry. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)  # dispatch sentinel; drains the queue first
+        self._dispatch_thread.join(timeout=timeout)
+        self._retire_q.put(None)
+        self._retire_thread.join(timeout=timeout)
+        stats = self.latency_stats()
+        if stats["n"]:
+            for k in ("p50_ms", "p95_ms", "p99_ms"):
+                telemetry.gauge(f"serving.latency_{k}", stats[k])
+
+    # ---------------------------------------------------------------- stats
+    def latency_stats(self) -> dict:
+        """Request-latency percentiles (ms) over every retired request."""
+        with self._lat_lock:
+            lat = np.asarray(self._latencies_ns, np.float64)
+        if lat.size == 0:
+            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None,
+                    "mean_ms": None}
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99]) / 1e6
+        return {"n": int(lat.size), "p50_ms": float(p50),
+                "p95_ms": float(p95), "p99_ms": float(p99),
+                "mean_ms": float(lat.mean() / 1e6)}
+
+    # ------------------------------------------------------------- internals
+    def _dispatch_loop(self) -> None:
+        done = False
+        while not done:
+            first = self._q.get()
+            if first is None:
+                done = True
+                # drain without waiting: everything already queued still
+                # scores (close() promises a flush, not an abort)
+                batch = []
+                while True:
+                    try:
+                        p = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if p is not None:
+                        batch.append(p)
+                while batch:
+                    self._flush(batch[:self.max_batch])
+                    batch = batch[self.max_batch:]
+                break
+            batch = [first]
+            deadline = first.t_enqueue + self.max_delay_us * 1000
+            while len(batch) < self.max_batch:
+                # greedy first: a backlogged queue must fill the batch
+                # immediately — the deadline (measured from the OLDEST
+                # request's enqueue) only governs how long to wait for
+                # traffic that has not arrived yet, else a deep queue
+                # degenerates into stale-deadline batches of one.
+                try:
+                    p = self._q.get_nowait()
+                except queue.Empty:
+                    wait_s = (deadline - time.perf_counter_ns()) / 1e9
+                    if wait_s <= 0:
+                        break
+                    try:
+                        p = self._q.get(timeout=wait_s)
+                    except queue.Empty:
+                        break
+                if p is None:
+                    done = True
+                    break
+                batch.append(p)
+            telemetry.gauge("serving.queue_depth", self._q.qsize())
+            self._flush(batch)
+        self._retire_q.put(None)
+
+    def _collate(self, batch: list, bucket: int) -> tuple:
+        """Stack + pad B requests into one full-rung argument set. Pad
+        rows are all-zero (features, offsets) with entity id = the zero
+        row — the offline driver's exact pad convention."""
+        B, n = bucket, len(batch)
+        offsets = np.zeros(B, np.float32)
+        for i, p in enumerate(batch):
+            offsets[i] = p.req.offset
+        shards = {}
+        for s, spec in self.ladder.shard_specs.items():
+            if spec.sparse_k is None:
+                X = np.zeros((B, spec.d), np.float32)
+                for i, p in enumerate(batch):
+                    X[i] = np.asarray(p.req.features[s], np.float32)
+                shards[s] = X
+            else:
+                k = spec.sparse_k
+                ind = np.zeros((B, k), np.int32)
+                val = np.zeros((B, k), np.float32)
+                for i, p in enumerate(batch):
+                    ri, rv = p.req.features[s]
+                    ri = np.asarray(ri, np.int32)
+                    if ri.shape[0] > k:
+                        raise ValueError(
+                            f"request row has {ri.shape[0]} nnz > shard "
+                            f"{s!r} sparse_k={k}")
+                    ind[i, :ri.shape[0]] = ri
+                    val[i, :ri.shape[0]] = np.asarray(rv, np.float32)
+                shards[s] = SparseRows(ind, val, spec.d)
+        ids = {}
+        misses = 0
+        for name, blk in self.store.random.items():
+            raw = [p.req.entities.get(blk.entity_name) for p in batch]
+            # absent key == unseen entity: both resolve to the zero row
+            keys = ["\x00missing\x00" if r is None else r for r in raw]
+            dense, n_miss = blk.lookup(keys)
+            col = np.full(B, blk.n_entities, np.int32)
+            col[:n] = dense
+            ids[name] = col
+            misses += n_miss
+        return offsets, shards, ids, misses
+
+    def _flush(self, batch: list) -> None:
+        n = len(batch)
+        if n == 0:
+            return
+        try:
+            with telemetry.span("serving.flush", rows=n):
+                bucket = self.ladder.bucket_for(n)
+                offsets, shards, ids, misses = self._collate(batch, bucket)
+                out_dev = self.ladder.score_padded(offsets, shards, ids)
+            telemetry.count("serving.requests", n)
+            telemetry.count("serving.batches")
+            telemetry.count("serving.batch_rows", n)
+            telemetry.count("serving.pad_waste", bucket - n)
+            if misses:
+                telemetry.count("serving.cold_misses", misses)
+            telemetry.gauge("serving.batch_fill", n / bucket)
+            telemetry.event("serving_batch", rows=n, bucket=bucket,
+                            cold_misses=misses)
+            self._retire_q.put((batch, out_dev))  # readback off this thread
+        except BaseException as e:  # noqa: BLE001 — delivered, not lost
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(e)
+
+    def _retire_loop(self) -> None:
+        import jax
+
+        while True:
+            item = self._retire_q.get()
+            if item is None:
+                break
+            batch, out_dev = item
+            try:
+                scores = np.asarray(jax.device_get(out_dev))  # blocks here
+            except BaseException as e:  # noqa: BLE001
+                for p in batch:
+                    p.future.set_exception(e)
+                continue
+            t_now = time.perf_counter_ns()
+            lats = []
+            for i, p in enumerate(batch):
+                lats.append(t_now - p.t_enqueue)
+                p.future.set_result(float(scores[i]))
+            with self._lat_lock:
+                self._latencies_ns.extend(lats)

@@ -1,0 +1,249 @@
+"""Coefficient store: the serving tier's model plane.
+
+Reference parity: the reference ships trained GAME coefficients to an
+online store — the fixed effect as one vector, random effects as a
+per-entity key→model index backed by PalDB — that request-time scorers
+mmap and gather from. Here the same roles are:
+
+- the fixed-effect coefficient vector(s), one flat ``(d,)`` float32 array
+  per fixed coordinate;
+- per-entity random-effect coefficient BLOCKS, one flat C-contiguous
+  ``(E + 1, d)`` float32 array per random coordinate whose LAST row is
+  all-zero — the cold-miss row. The entity→row directory is the existing
+  ``data/index_map.py`` machinery (`IndexMap` in memory, `PalDBIndexMap`
+  over the native mmap hash store for huge entity spaces), so an unseen
+  entity resolves to ``NULL_ID`` → row ``E`` → a zero random-effect
+  contribution: the request degrades gracefully to the fixed-effect-only
+  score instead of erroring, and the dispatcher counts it
+  (``serving.cold_misses``).
+
+``save``/``open`` persist the blocks as ``.npy`` files; ``open(...,
+mmap=True)`` maps them read-only (np.load mmap_mode) so N serving
+processes on one host share one page-cache copy of a multi-GB store.
+Scoring programs take the blocks as ARGUMENTS (never closure constants):
+a coefficient hot-swap (`reload_coefficients`) swaps the device arrays
+without retracing anything — the program ladder's signatures only see
+shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+from photon_tpu.data.index_map import IndexMap, PalDBIndexMap
+from photon_tpu.game.model import (FixedEffectModel, GameModel,
+                                   RandomEffectModel)
+from photon_tpu.ops.losses import TaskType
+
+_META_NAME = "serving_store.json"
+_FORMAT = "photon_tpu-serving-store-v1"
+
+
+@dataclasses.dataclass
+class FixedBlock:
+    """One fixed-effect coordinate: a flat (d,) coefficient vector."""
+
+    feature_shard: str
+    weights: np.ndarray  # (d,) float32 (possibly a read-only memmap)
+
+
+@dataclasses.dataclass
+class RandomBlock:
+    """One random-effect coordinate: flat (E+1, d) coefficients + the
+    entity→row directory. Row E is the all-zero cold-miss row."""
+
+    feature_shard: str
+    entity_name: str
+    coefficients: np.ndarray  # (E + 1, d) float32, last row zero
+    directory: object  # IndexMap | PalDBIndexMap (frozen)
+
+    @property
+    def n_entities(self) -> int:
+        return int(self.coefficients.shape[0]) - 1
+
+    @property
+    def dim(self) -> int:
+        return int(self.coefficients.shape[1])
+
+    def lookup(self, raw_ids) -> tuple:
+        """Raw entity keys → dense coefficient rows, vectorized.
+
+        Returns ``(rows int32 (n,), n_miss)``; unseen keys land on the
+        zero row ``E`` (the graceful-degradation row), never raise."""
+        keys = [k if isinstance(k, str) else str(k) for k in raw_ids]
+        d = self.directory
+        if hasattr(d, "lookup_batch"):  # PalDB: one native batch call
+            ids = np.asarray(d.lookup_batch(keys), np.int64)
+        else:
+            g = d.key_to_id.get
+            ids = np.fromiter((g(k, -1) for k in keys), np.int64,
+                              count=len(keys))
+        miss = ids < 0
+        return (np.where(miss, self.n_entities, ids).astype(np.int32),
+                int(miss.sum()))
+
+
+class CoefficientStore:
+    """The model plane: every coordinate's coefficients, gather-ready.
+
+    ``order`` preserves the GameModel's coordinate order — the scoring
+    program sums contributions in exactly that order, which is what makes
+    serving scores bit-identical to the offline driver's."""
+
+    def __init__(self, task: TaskType, order: tuple,
+                 fixed: dict, random: dict):
+        self.task = task
+        self.order = tuple(order)
+        self.fixed = fixed    # name -> FixedBlock
+        self.random = random  # name -> RandomBlock
+        self._device = None   # lazily uploaded (and hot-swappable) blocks
+
+    # ----------------------------------------------------------- construction
+    @classmethod
+    def from_game_model(cls, model: GameModel,
+                        paldb: bool = False) -> "CoefficientStore":
+        """Build from an in-memory GameModel (e.g. straight out of
+        `run_training` or `load_game_model`). ``paldb=True`` freezes each
+        entity directory into the native mmap store (requires
+        `photon_tpu.native`)."""
+        fixed: dict = {}
+        random: dict = {}
+        for name, cm in model.coordinates.items():
+            if isinstance(cm, FixedEffectModel):
+                fixed[name] = FixedBlock(
+                    cm.feature_shard,
+                    np.ascontiguousarray(
+                        np.asarray(cm.model.coefficients.means), np.float32))
+            elif isinstance(cm, RandomEffectModel):
+                C = np.asarray(cm.coefficients, np.float32)
+                flat = np.zeros((C.shape[0] + 1, C.shape[1]), np.float32)
+                flat[:-1] = C
+                imap = IndexMap(
+                    {str(k): i
+                     for i, k in enumerate(np.asarray(cm.entity_keys))},
+                    frozen=True)
+                directory = PalDBIndexMap.build(imap) if paldb else imap
+                random[name] = RandomBlock(cm.feature_shard, cm.entity_name,
+                                           flat, directory)
+            else:
+                raise TypeError(f"unknown coordinate model: {type(cm)}")
+        return cls(model.task, tuple(model.coordinates), fixed, random)
+
+    # ------------------------------------------------------------------ IO
+    def save(self, out_dir) -> None:
+        """Persist the store: one .npy per coefficient block (flat,
+        mmap-able) + the entity directories + a JSON manifest."""
+        os.makedirs(out_dir, exist_ok=True)
+        meta: dict = {"format": _FORMAT, "task": self.task.name,
+                      "coordinates": []}
+        for name in self.order:
+            if name in self.fixed:
+                blk = self.fixed[name]
+                np.save(os.path.join(out_dir, f"{name}.fixed.npy"),
+                        np.asarray(blk.weights, np.float32))
+                meta["coordinates"].append(
+                    {"name": name, "type": "fixed",
+                     "feature_shard": blk.feature_shard})
+            else:
+                blk = self.random[name]
+                np.save(os.path.join(out_dir, f"{name}.coeffs.npy"),
+                        np.asarray(blk.coefficients, np.float32))
+                paldb = isinstance(blk.directory, PalDBIndexMap)
+                dpath = os.path.join(
+                    out_dir, f"{name}.entities" + (".paldb" if paldb
+                                                   else ".tsv"))
+                blk.directory.save(dpath)
+                meta["coordinates"].append(
+                    {"name": name, "type": "random",
+                     "feature_shard": blk.feature_shard,
+                     "entity_name": blk.entity_name,
+                     "directory": "paldb" if paldb else "tsv"})
+        with open(os.path.join(out_dir, _META_NAME), "w") as f:
+            json.dump(meta, f, indent=2)
+
+    @classmethod
+    def open(cls, out_dir, mmap: bool = True) -> "CoefficientStore":
+        """Open a saved store; ``mmap=True`` maps every coefficient block
+        read-only instead of copying it into the heap."""
+        with open(os.path.join(out_dir, _META_NAME)) as f:
+            meta = json.load(f)
+        if meta.get("format") != _FORMAT:
+            raise ValueError(f"{out_dir}: not a {_FORMAT} store")
+        mode = "r" if mmap else None
+        fixed: dict = {}
+        random: dict = {}
+        order = []
+        for c in meta["coordinates"]:
+            name = c["name"]
+            order.append(name)
+            if c["type"] == "fixed":
+                w = np.load(os.path.join(out_dir, f"{name}.fixed.npy"),
+                            mmap_mode=mode)
+                fixed[name] = FixedBlock(c["feature_shard"], w)
+            else:
+                C = np.load(os.path.join(out_dir, f"{name}.coeffs.npy"),
+                            mmap_mode=mode)
+                if c["directory"] == "paldb":
+                    directory = PalDBIndexMap.open(
+                        os.path.join(out_dir, f"{name}.entities.paldb"))
+                else:
+                    directory = IndexMap.load(
+                        os.path.join(out_dir, f"{name}.entities.tsv"))
+                random[name] = RandomBlock(c["feature_shard"],
+                                           c["entity_name"], C, directory)
+        return cls(TaskType[meta["task"]], tuple(order), fixed, random)
+
+    # ------------------------------------------------------------- device side
+    def device_blocks(self) -> tuple:
+        """(fixed_ws, re_cs): name-keyed dicts of device-resident blocks,
+        uploaded once and reused by every dispatch (the program takes them
+        as arguments, so a swap never retraces)."""
+        if self._device is None:
+            import jax
+
+            self._device = (
+                {n: jax.device_put(np.asarray(b.weights, np.float32))
+                 for n, b in self.fixed.items()},
+                {n: jax.device_put(np.asarray(b.coefficients, np.float32))
+                 for n, b in self.random.items()})
+        return self._device
+
+    def reload_coefficients(self, other: "CoefficientStore") -> None:
+        """Hot-swap coefficient VALUES from another store with identical
+        structure (same coordinates, dims, entity spaces) — the online
+        model-push path. Shapes must match: the program ladder's AOT
+        signatures are part of the serving contract."""
+        if (other.order != self.order
+                or any(other.fixed[n].weights.shape
+                       != self.fixed[n].weights.shape for n in self.fixed)
+                or any(other.random[n].coefficients.shape
+                       != self.random[n].coefficients.shape
+                       for n in self.random)):
+            raise ValueError(
+                "coefficient reload requires an identically-shaped store "
+                "(new entities or features need a new program ladder)")
+        self.fixed = other.fixed
+        self.random = other.random
+        self._device = None
+
+    # ---------------------------------------------------------------- lookups
+    def lookup(self, name: str, raw_ids) -> tuple:
+        """Vectorized entity→row resolution for one random coordinate;
+        see RandomBlock.lookup."""
+        return self.random[name].lookup(raw_ids)
+
+    def n_entities(self, name: str) -> int:
+        return self.random[name].n_entities
+
+    def shard_dims(self) -> dict:
+        """Feature-shard name → column count, from the blocks themselves
+        (what the program ladder sizes its padded request batches to)."""
+        dims: dict = {}
+        for b in self.fixed.values():
+            dims[b.feature_shard] = int(np.asarray(b.weights).shape[0])
+        for b in self.random.values():
+            dims.setdefault(b.feature_shard, b.dim)
+        return dims

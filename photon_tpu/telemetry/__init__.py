@@ -23,7 +23,12 @@ refreshes, retraces via `analysis.TraceSignatureLog`, GAME sweep stats,
 the random-effect block pipeline's `game_re.*` family —
 blocks/blocks_in_flight/readback_wait_ns plus the straggler compaction's
 straggler_entities/tail_resolves/iters_saved, with per-block
-upload/solve/readback/tail_solve spans — and HBM watermarks), and the
+upload/solve/readback/tail_solve spans; the online serving tier's
+`serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses
+counters (pad_waste is shared with the offline chunked scorer),
+queue_depth/batch_fill/latency_p50_ms/latency_p95_ms/latency_p99_ms
+gauges, per-flush `serving.flush` spans, and one `serving_batch` event
+per dispatched micro-batch — and HBM watermarks), and the
 **iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted
 resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`

@@ -29,6 +29,11 @@ Pieces:
 - ``AotStore(cache_dir)`` — a keyed on-disk store.
   ``store.call(key, fn, *args)`` replays a previous export when the key
   AND the arguments' avals match, else exports (and persists) fresh.
+  File identity also covers the running jax version and an optional
+  caller ``schema`` tag (a jax upgrade or a program-layout redesign
+  re-exports instead of failing at replay), and ``store.warmup(entries)``
+  pre-loads + compiles a list of entries — serving startup runs the whole
+  program ladder through it before the first live request.
 
 Scope: single-controller programs (anything photon-tpu jits on one
 device, including everything ``train_glm``/``train_glm_grid``/
@@ -199,19 +204,42 @@ class AotStore:
     """
 
     def __init__(self, cache_dir: str,
-                 platforms: Optional[Sequence[str]] = None):
+                 platforms: Optional[Sequence[str]] = None,
+                 schema: str = ""):
         self.cache_dir = cache_dir
         self.platforms = platforms
+        # Caller-owned layout tag (e.g. the serving program-ladder schema):
+        # bumping it invalidates every export whose calling convention the
+        # caller redesigned, without touching unrelated keys.
+        self.schema = schema
         self._loaded: dict = {}
         os.makedirs(cache_dir, exist_ok=True)
 
     def _path(self, key: str, fp: str) -> str:
         # The export's platform set is part of its calling convention, so
         # it is part of the file identity (a store populated for "cpu"
-        # must not shadow one for ("tpu", "cpu")).
+        # must not shadow one for ("tpu", "cpu")). The jax version is too:
+        # jax.export blobs carry a serialization version a different jax
+        # may refuse to (or worse, subtly mis-) replay — a jax upgrade
+        # must MISS and re-export, not fail at replay time. Same for the
+        # caller's schema tag.
         plat = ",".join(self.platforms) if self.platforms else "default"
-        safe = hashlib.sha256(f"{key}|{plat}".encode()).hexdigest()[:16]
+        ident = f"{key}|{plat}|jax={jax.__version__}|schema={self.schema}"
+        safe = hashlib.sha256(ident.encode()).hexdigest()[:16]
         return os.path.join(self.cache_dir, f"{safe}-{fp}.jaxexp")
+
+    def warmup(self, entries) -> int:
+        """Pre-trace/compile a list of ``(key, fn, example_args)`` entries.
+
+        Each entry replays (or exports fresh) and RUNS once on its example
+        arguments — zeros of the right shape are fine — so a serving
+        process pays every deserialize + compile at startup instead of on
+        the first live request of each shape. Returns the number warmed."""
+        n = 0
+        for key, fn, args in entries:
+            self.call(key, fn, *args)
+            n += 1
+        return n
 
     def call(self, key: str, fn: Callable, *args):
         """Run ``fn(*args)``, replaying a stored export when available.

@@ -24,12 +24,22 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 20 specs (round 8 added the game_re budgeted-pass and compacted
-    straggler-resolve pins) spanning every workload family."""
-    assert len(_REGISTRY) >= 20
+    """≥ 22 specs (round 9 added the serving request-program pins)
+    spanning every workload family, now including online serving."""
+    assert len(_REGISTRY) >= 22
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
-    for family in ("resident", "streamed", "mesh-streamed", "lane", "game"):
+    for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
+                   "serving"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_serving_request_specs_are_registered():
+    """The serving tier's per-request program is pinned: both heads
+    (mean + margin), both strict — zero collectives, zero host exits."""
+    for name in ("serving_request_program", "serving_request_margin"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}
+        assert not spec.allow_transfers and not spec.allow_f64
 
 
 @pytest.mark.parametrize("name", sorted(_REGISTRY))

@@ -125,3 +125,48 @@ def test_auxdata_is_json_not_pickle(rng, tmp_path):
 
     with pytest.raises(TypeError, match="auxdata"):
         _serialize_auxdata(object())
+
+
+def test_store_key_covers_jax_version_and_schema(tmp_path, monkeypatch):
+    """A jax upgrade or a ladder-schema bump must MISS (re-export fresh),
+    never attempt to replay a blob a different jax serialized."""
+    store = AotStore(str(tmp_path))
+    fp = "ab" * 8
+    p_now = store._path("k", fp)
+    monkeypatch.setattr(jax, "__version__", "999.999.999")
+    assert store._path("k", fp) != p_now
+    monkeypatch.undo()
+    assert store._path("k", fp) == p_now  # deterministic within a version
+    assert AotStore(str(tmp_path), schema="v2")._path("k", fp) != p_now
+
+
+def test_store_warmup_preloads_entries(tmp_path, monkeypatch):
+    """warmup(entries) exports+compiles everything up front: afterwards a
+    call must replay from the warmed store, never export again."""
+    import photon_tpu.utils.aot as aot_mod
+
+    @jax.jit
+    def double(x):
+        return x * 2.0 + 1.0
+
+    @jax.jit
+    def triple(x):
+        return x * 3.0
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    store = AotStore(str(tmp_path))
+    entries = [("double", double, (x,)), ("triple", triple, (x,))]
+    assert store.warmup(entries) == 2
+    assert len([f for f in os.listdir(tmp_path)
+                if f.endswith(".jaxexp")]) == 2
+
+    def boom(*a, **kw):
+        raise AssertionError("warm store re-exported")
+
+    monkeypatch.setattr(aot_mod, "export_program", boom)
+    np.testing.assert_array_equal(store.call("double", double, x),
+                                  np.arange(8, dtype=np.float32) * 2 + 1)
+    # a COLD process (fresh store over the same dir) also replays
+    fresh = AotStore(str(tmp_path))
+    np.testing.assert_array_equal(fresh.call("triple", triple, x),
+                                  np.arange(8, dtype=np.float32) * 3)

@@ -1,0 +1,108 @@
+"""data/index_map.py persistence + lookup semantics: the serving
+coefficient store keys its entity→row directories on this machinery, so
+the save/load round-trip (ordering, intercept id, unknown-key behavior,
+delimiter escaping) and the PalDBIndexMap.build equivalence are tier-1
+law, not incidental behavior."""
+import numpy as np
+import pytest
+
+from photon_tpu.data.index_map import (DELIMITER, INTERCEPT_KEY, IndexMap,
+                                       PalDBIndexMap, feature_key)
+
+
+class TestIndexMap:
+    def test_build_assigns_in_first_sight_order(self):
+        m = IndexMap().build(["b", "a", "c", "a"])
+        assert [m.index_of(k) for k in ("b", "a", "c")] == [0, 1, 2]
+        assert len(m) == 3 and m.intercept_id is None
+
+    def test_frozen_unknown_returns_null_id(self):
+        m = IndexMap().build(["x"]).freeze()
+        assert m.index_of("y") == IndexMap.NULL_ID
+        assert m.get("y") == IndexMap.NULL_ID
+        assert m.index_of("x") == 0  # frozen lookups still resolve
+
+    def test_intercept_is_always_last(self):
+        m = IndexMap().build(["a", INTERCEPT_KEY, "b"])
+        assert m.has_intercept and m.intercept_id == len(m) - 1 == 2
+        assert m.keys_in_order() == ["a", "b", INTERCEPT_KEY]
+        # an unfrozen map re-asks: intercept stays last as keys grow
+        m.index_of("z")
+        assert m.intercept_id == 3 and m.index_of(INTERCEPT_KEY) == 3
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = IndexMap().build(
+            [feature_key("age", "decade"), "plain", INTERCEPT_KEY])
+        p = tmp_path / "imap.tsv"
+        m.save(p)
+        back = IndexMap.load(p)
+        assert back.frozen and back.has_intercept
+        assert back.keys_in_order() == m.keys_in_order()
+        for k in m.keys_in_order():
+            assert back.get(k) == m.get(k)
+        assert back.get("unseen") == IndexMap.NULL_ID
+        assert back.intercept_id == m.intercept_id
+
+    def test_roundtrip_escapes_delimiter(self, tmp_path):
+        key = feature_key("name", "term")  # embeds \x01
+        assert DELIMITER in key
+        m = IndexMap().build([key, "other"])
+        m.save(tmp_path / "d.tsv")
+        back = IndexMap.load(tmp_path / "d.tsv")
+        assert back.get(key) == 0
+        assert back.keys_in_order()[0] == key
+
+    def test_roundtrip_without_intercept(self, tmp_path):
+        m = IndexMap().build(["only"])
+        m.save(tmp_path / "n.tsv")
+        back = IndexMap.load(tmp_path / "n.tsv")
+        assert not back.has_intercept and back.intercept_id is None
+        assert back.get(INTERCEPT_KEY) == IndexMap.NULL_ID
+
+    def test_load_rejects_foreign_file(self, tmp_path):
+        (tmp_path / "x.tsv").write_text("not\tan\tindexmap\n")
+        with pytest.raises(ValueError, match="not a photon_tpu index map"):
+            IndexMap.load(tmp_path / "x.tsv")
+
+    def test_key_of_reverse_lookup(self):
+        m = IndexMap().build(["a", "b", INTERCEPT_KEY])
+        assert m.key_of(0) == "a" and m.key_of(2) == INTERCEPT_KEY
+        with pytest.raises(KeyError):
+            m.key_of(99)
+
+
+class TestPalDBIndexMap:
+    @pytest.fixture(autouse=True)
+    def _native(self):
+        from photon_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+
+    def test_build_equivalence_with_index_map(self):
+        keys = [feature_key("f", str(i)) for i in range(40)]
+        m = IndexMap().build(keys + [INTERCEPT_KEY]).freeze()
+        p = PalDBIndexMap.build(m)
+        assert len(p) == len(m) and p.intercept_id == m.intercept_id
+        assert p.keys_in_order() == m.keys_in_order()
+        for k in keys + [INTERCEPT_KEY, "unseen"]:
+            assert p.get(k) == m.get(k)
+        np.testing.assert_array_equal(
+            p.lookup_batch(keys + ["unseen", INTERCEPT_KEY]),
+            np.asarray([m.get(k)
+                        for k in keys + ["unseen", INTERCEPT_KEY]]))
+
+    def test_save_open_roundtrip(self, tmp_path):
+        m = IndexMap().build(["a", "b", INTERCEPT_KEY]).freeze()
+        p = PalDBIndexMap.build(m)
+        path = str(tmp_path / "store.paldb")
+        p.save(path)
+        back = PalDBIndexMap.open(path)
+        assert back.has_intercept and back.keys_in_order() == \
+            m.keys_in_order()
+        assert back.get("b") == 1 and back.get("zz") == IndexMap.NULL_ID
+
+    def test_to_index_map_inverse(self):
+        m = IndexMap().build(["x", "y"]).freeze()
+        assert PalDBIndexMap.build(m).to_index_map().key_to_id == \
+            m.key_to_id

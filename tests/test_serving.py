@@ -1,0 +1,394 @@
+"""Online serving tier (photon_tpu/serving): coefficient-store lookups +
+mmap persistence, the pow2 AOT program ladder's never-retrace guarantee,
+micro-batching dispatcher semantics, and THE acceptance parity —
+dispatcher-batched scores bit-identical to the offline drivers/score.py
+path for the same model and rows, including the cold-miss
+fixed-effect-only fallback.
+
+Marked `release_programs`: the ladder compiles one program per rung per
+configuration; teardown drops them (tests/conftest.py).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu import serving, telemetry
+from photon_tpu.data.matrix import SparseRows
+from photon_tpu.game.dataset import GameData
+from photon_tpu.game.model import (FixedEffectModel, GameModel,
+                                   RandomEffectModel)
+from photon_tpu.game.scoring import score_game
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.serving.__main__ import build_demo_model
+
+pytestmark = pytest.mark.release_programs
+
+SPARSE_K = 3
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    yield
+    telemetry.finish_run()
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(model, store, ladder): one ladder for the whole module — shared
+    shapes keep the compile count at one program per rung."""
+    model, _ = build_demo_model(seed=7)
+    store = serving.CoefficientStore.from_game_model(model)
+    ladder = serving.ProgramLadder(store, ladder=(4, 8),
+                                   sparse_k={"member": SPARSE_K},
+                                   output_mean=True)
+    return model, store, ladder
+
+
+def _requests(rng, model, n, unseen_every=5):
+    """n ragged requests over the demo model's shards; every
+    ``unseen_every``-th entity key is unknown to the store."""
+    d_f = int(model["fixed"].model.coefficients.dim)
+    d_r = model["perEntity"].dim
+    E = model["perEntity"].n_entities
+    xg = rng.normal(size=(n, d_f)).astype(np.float32)
+    ind = rng.integers(0, d_r, size=(n, SPARSE_K)).astype(np.int32)
+    val = rng.normal(size=(n, SPARSE_K)).astype(np.float32)
+    offs = rng.normal(size=n).astype(np.float32)
+    ents = [f"zz{i}" if i % unseen_every == 0 else f"e{i % E:03d}"
+            for i in range(n)]
+    reqs = [serving.ScoreRequest(
+        features={"global": xg[i], "member": (ind[i], val[i])},
+        entities={"memberId": ents[i]}, offset=float(offs[i]))
+        for i in range(n)]
+    data = GameData.build(np.zeros(n, np.float32),
+                          {"global": xg, "member": SparseRows(ind, val, d_r)},
+                          {"memberId": np.asarray(ents)}, offsets=offs)
+    return reqs, data, ents
+
+
+# ----------------------------------------------------------------- the store
+class TestCoefficientStore:
+    def test_lookup_seen_unseen_and_zero_row(self, demo):
+        model, store, _ = demo
+        re = model["perEntity"]
+        ids, miss = store.lookup("perEntity", ["e003", "nope", "e000"])
+        assert miss == 1
+        assert ids.tolist() == [3, re.n_entities, 0]
+        # the cold-miss row is all-zero: the graceful-degradation row
+        assert (store.random["perEntity"].coefficients[-1] == 0).all()
+        # matches the offline model's own unseen-entity convention
+        np.testing.assert_array_equal(
+            ids, re.dense_ids(np.asarray(["e003", "nope", "e000"])))
+
+    def test_save_open_roundtrip_mmap(self, demo, tmp_path):
+        _, store, _ = demo
+        store.save(tmp_path / "s")
+        back = serving.CoefficientStore.open(tmp_path / "s", mmap=True)
+        assert back.order == store.order and back.task == store.task
+        np.testing.assert_array_equal(back.fixed["fixed"].weights,
+                                      store.fixed["fixed"].weights)
+        np.testing.assert_array_equal(
+            back.random["perEntity"].coefficients,
+            store.random["perEntity"].coefficients)
+        # mmap=True really maps (no heap copy of a multi-GB store)
+        assert isinstance(back.random["perEntity"].coefficients, np.memmap)
+        ids_a, _ = store.lookup("perEntity", ["e001", "x"])
+        ids_b, _ = back.lookup("perEntity", ["e001", "x"])
+        np.testing.assert_array_equal(ids_a, ids_b)
+
+    def test_open_rejects_foreign_dir(self, tmp_path):
+        (tmp_path / "serving_store.json").write_text('{"format": "nope"}')
+        with pytest.raises(ValueError, match="not a"):
+            serving.CoefficientStore.open(tmp_path)
+
+    def test_reload_requires_identical_shapes(self, demo):
+        model, store, _ = demo
+        other = serving.CoefficientStore.from_game_model(model)
+        store.reload_coefficients(other)  # identical shapes: fine
+        small, _ = build_demo_model(seed=1, n_entities=4)
+        with pytest.raises(ValueError, match="identically-shaped"):
+            store.reload_coefficients(
+                serving.CoefficientStore.from_game_model(small))
+
+    def test_paldb_directory_equivalence(self, demo, tmp_path):
+        from photon_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
+        model, store, _ = demo
+        pstore = serving.CoefficientStore.from_game_model(model, paldb=True)
+        keys = ["e000", "e007", "absent", "e015"]
+        np.testing.assert_array_equal(store.lookup("perEntity", keys)[0],
+                                      pstore.lookup("perEntity", keys)[0])
+        pstore.save(tmp_path / "p")
+        back = serving.CoefficientStore.open(tmp_path / "p")
+        np.testing.assert_array_equal(back.lookup("perEntity", keys)[0],
+                                      store.lookup("perEntity", keys)[0])
+
+
+# -------------------------------------------------------------- the programs
+class TestProgramLadder:
+    def test_bucket_selection(self, demo):
+        _, _, ladder = demo
+        assert [ladder.bucket_for(n) for n in (1, 4, 5, 8)] == [4, 4, 8, 8]
+        with pytest.raises(ValueError, match="exceeds ladder top"):
+            ladder.bucket_for(9)
+
+    def test_non_pow2_ladder_rejected(self, demo):
+        _, store, _ = demo
+        with pytest.raises(ValueError, match="pow2"):
+            serving.ProgramLadder(store, ladder=(4, 6))
+
+    def test_mixed_sizes_never_retrace(self, demo):
+        """THE steady-state law: any mix of request sizes compiles at
+        most one program per rung (TraceSignatureLog-asserted)."""
+        _, _, ladder = demo
+        before = len(ladder.signature_log.signatures("serving.score"))
+        for B in (4, 8, 4, 8, 4):
+            args = ladder.example_args(B)
+            ladder.score_padded(args[0], args[1], args[2])
+        n_sigs = ladder.assert_no_retrace()
+        assert n_sigs <= len(ladder.ladder)
+        assert n_sigs >= max(before, 2)  # both rungs actually dispatched
+
+    def test_aot_export_replay_bitwise(self, demo, tmp_path):
+        """The AOT plane: warmup exports one program per rung; a FRESH
+        ladder over the same store replays (no export) bit-identically."""
+        model, store, _ = demo
+        aot = str(tmp_path / "aot")
+        ladder = serving.ProgramLadder(store, ladder=(4,),
+                                       sparse_k={"member": SPARSE_K},
+                                       aot_dir=aot, model_tag="demo")
+        assert ladder.warmup() == 1
+        files = [f for f in os.listdir(aot) if f.endswith(".jaxexp")]
+        assert len(files) == 1  # one export per (model, rung)
+        rng = np.random.default_rng(3)
+        reqs, data, _ = _requests(rng, model, 4)
+        replay = serving.ProgramLadder(store, ladder=(4,),
+                                       sparse_k={"member": SPARSE_K},
+                                       aot_dir=aot, model_tag="demo")
+        d = serving.MicroBatchDispatcher(replay, max_batch=4,
+                                         max_delay_us=100)
+        try:
+            got = np.asarray([f.result(timeout=30)
+                              for f in [d.submit(q) for q in reqs]],
+                             np.float32)
+        finally:
+            d.close()
+        want = np.asarray(model.mean(score_game(model, data)), np.float32)
+        assert got.tobytes() == want.tobytes()
+        # the replay ladder REPLAYED — it exported nothing new
+        assert sorted(os.listdir(aot)) == sorted(files)
+
+    def test_schema_tag_isolates_exports(self, demo, tmp_path):
+        """A ladder-schema redesign (different AotStore schema tag) must
+        MISS the old files, never replay them."""
+        from photon_tpu.utils.aot import AotStore
+
+        store_a = AotStore(str(tmp_path), schema="serving-ladder-v1")
+        store_b = AotStore(str(tmp_path), schema="serving-ladder-v2")
+        fp = "00" * 8
+        assert store_a._path("k", fp) != store_b._path("k", fp)
+
+
+# ---------------------------------------------------- dispatcher + acceptance
+class TestDispatcherParity:
+    def test_bitwise_parity_with_offline_driver(self, demo, tmp_path):
+        """ACCEPTANCE: the full offline path — save_game_model → Avro
+        scoring data → drivers/score.py run_scoring — against the same
+        rows dispatched through the micro-batcher: bit-identical scores,
+        including the cold-miss fixed-effect-only rows."""
+        from photon_tpu.data.avro_io import write_avro
+        from photon_tpu.data.index_map import INTERCEPT_KEY, IndexMap
+        from photon_tpu.data.ingest import training_example_schema
+        from photon_tpu.data.model_io import load_game_model, save_game_model
+        from photon_tpu.drivers.score import ScoringParams, run_scoring
+
+        rng = np.random.default_rng(11)
+        n, E = 53, 7
+        task = TaskType.LOGISTIC_REGRESSION
+        # feature shards: "fs" = bag g features a, c + intercept (d=3);
+        # "us" = bag pu feature b, no intercept (d=1)
+        imap_f = IndexMap().build(["a", "c", INTERCEPT_KEY]).freeze()
+        imap_u = IndexMap().build(["b"]).freeze()
+        keys = np.asarray(sorted(f"u{i}" for i in range(E)))
+        model = GameModel({
+            "fixed": FixedEffectModel(GeneralizedLinearModel(
+                Coefficients(jnp.asarray(
+                    rng.normal(size=3).astype(np.float32))), task), "fs"),
+            "perUser": RandomEffectModel(
+                entity_name="userId", feature_shard="us", task=task,
+                coefficients=jnp.asarray(
+                    rng.normal(size=(E, 1)).astype(np.float32)),
+                entity_keys=keys,
+                key_to_index={k: i for i, k in enumerate(keys.tolist())}),
+        }, task)
+        model_dir = tmp_path / "model"
+        save_game_model(str(model_dir), model,
+                        {"fixed": imap_f, "perUser": imap_u})
+
+        a = rng.normal(size=n).astype(np.float32)
+        c = rng.normal(size=n).astype(np.float32)
+        b = rng.normal(size=n).astype(np.float32)
+        offs = rng.normal(size=n).astype(np.float32)
+        # u7/u8 never trained: the driver maps them to the zero row, the
+        # dispatcher counts them as cold misses — SAME score either way
+        users = [f"u{i % (E + 2)}" for i in range(n)]
+        schema = training_example_schema(feature_bags=("g", "pu"),
+                                         entity_fields=("userId",))
+        recs = [{"response": float(i % 2), "offset": float(offs[i]),
+                 "weight": None, "uid": f"r{i}", "userId": users[i],
+                 "g": [{"name": "a", "term": "", "value": float(a[i])},
+                       {"name": "c", "term": "", "value": float(c[i])}],
+                 "pu": [{"name": "b", "term": "", "value": float(b[i])}]}
+                for i in range(n)]
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        write_avro(data_dir / "part-0.avro", recs, schema, block_records=16)
+
+        out = run_scoring(ScoringParams(
+            model_dir=str(model_dir), data_path=str(data_dir),
+            output_dir=str(tmp_path / "out"),
+            feature_shards={"fs": {"bags": ["g"], "has_intercept": True},
+                            "us": {"bags": ["pu"], "has_intercept": False}},
+            entity_fields=["userId"]))
+        assert out.scores.shape == (n,)
+
+        # the serving side, built from the SAME saved artifacts
+        loaded, _ = load_game_model(str(model_dir))
+        store = serving.CoefficientStore.from_game_model(loaded)
+        # rungs ≥ 8: bit-parity-safe vs the driver's 4096-row chunks
+        # (sub-8 CPU matvec kernels drift ULPs — ProgramLadder docstring)
+        ladder = serving.ProgramLadder(store, ladder=(8, 16),
+                                       output_mean=True)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=16,
+                                         max_delay_us=500)
+        r = telemetry.start_run("parity")
+        try:
+            futs = [d.submit(serving.ScoreRequest(
+                features={"fs": np.asarray([a[i], c[i], 1.0], np.float32),
+                          "us": np.asarray([b[i]], np.float32)},
+                entities={"userId": users[i]}, offset=float(offs[i])))
+                for i in range(n)]
+            got = np.asarray([f.result(timeout=30) for f in futs])
+        finally:
+            d.close()
+            telemetry.finish_run()
+        # driver scores are the f32 device result widened to f64 — exact,
+        # so bitwise f64 comparison is the honest equality
+        np.testing.assert_array_equal(got.astype(np.float64), out.scores)
+        ladder.assert_no_retrace()
+        n_cold = sum(1 for u in users if u in ("u7", "u8"))
+        assert r.counters["serving.cold_misses"] == float(n_cold) > 0
+
+    def test_margin_head_matches_score_game(self, demo):
+        """output_mean=False serves the raw margin — score_game verbatim."""
+        model, store, _ = demo
+        ladder = serving.ProgramLadder(store, ladder=(8,),
+                                       sparse_k={"member": SPARSE_K},
+                                       output_mean=False)
+        rng = np.random.default_rng(5)
+        reqs, data, _ = _requests(rng, model, 8)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=200)
+        try:
+            got = np.asarray([f.result(timeout=30)
+                              for f in [d.submit(q) for q in reqs]],
+                             np.float32)
+        finally:
+            d.close()
+        want = np.asarray(score_game(model, data), np.float32)
+        assert got.tobytes() == want.tobytes()
+
+
+class TestDispatcherBehavior:
+    def test_single_request_flushes_on_deadline(self, demo):
+        _, _, ladder = demo
+        d = serving.MicroBatchDispatcher(ladder, max_delay_us=1000)
+        rng = np.random.default_rng(0)
+        model = demo[0]
+        reqs, _, _ = _requests(rng, model, 1)
+        try:
+            assert isinstance(d.score(reqs[0], timeout=30), float)
+        finally:
+            d.close()
+
+    def test_counters_events_and_latency(self, demo, tmp_path):
+        model, _, ladder = demo
+        rng = np.random.default_rng(2)
+        n = 11
+        reqs, _, ents = _requests(rng, model, n)
+        jsonl = str(tmp_path / "serving.jsonl")
+        r = telemetry.start_run("disp", jsonl_path=jsonl)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=2000)
+        try:
+            futs = [d.submit(q) for q in reqs]
+            [f.result(timeout=30) for f in futs]
+        finally:
+            d.close()
+            telemetry.finish_run()
+        assert r.counters["serving.requests"] == float(n)
+        assert r.counters["serving.batches"] >= 2  # 11 > max_batch=8
+        n_unseen = sum(1 for e in ents if e.startswith("zz"))
+        assert r.counters["serving.cold_misses"] == float(n_unseen)
+        assert "serving.pad_waste" in r.counters
+        assert "serving.batch_fill" in r.gauges
+        batches = list(telemetry.read_jsonl(jsonl, kind="serving_batch"))
+        assert sum(e["rows"] for e in batches) == n
+        assert all(e["bucket"] in ladder.ladder for e in batches)
+        # close() gauged the percentile summary into the run
+        assert r.gauges["serving.latency_p50_ms"] <= \
+            r.gauges["serving.latency_p99_ms"]
+        st = d.latency_stats()
+        assert st["n"] == n and st["p50_ms"] <= st["p95_ms"] <= st["p99_ms"]
+
+    def test_close_flushes_queue_and_rejects_after(self, demo):
+        model, _, ladder = demo
+        rng = np.random.default_rng(4)
+        reqs, _, _ = _requests(rng, model, 6)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=10_000_000)
+        futs = [d.submit(q) for q in reqs[:3]]
+        d.close()  # must flush the queued 3, not abort them
+        assert all(isinstance(f.result(timeout=5), float) for f in futs)
+        with pytest.raises(RuntimeError, match="closed"):
+            d.submit(reqs[3])
+
+    def test_bad_request_fails_its_future_only(self, demo):
+        model, _, ladder = demo
+        rng = np.random.default_rng(6)
+        reqs, _, _ = _requests(rng, model, 2)
+        d = serving.MicroBatchDispatcher(ladder, max_delay_us=500)
+        try:
+            bad = serving.ScoreRequest(features={}, entities={})
+            fb = d.submit(bad)
+            with pytest.raises(Exception):
+                fb.result(timeout=30)
+            # the dispatcher survives and serves the next request
+            assert isinstance(d.score(reqs[0], timeout=30), float)
+        finally:
+            d.close()
+
+
+def test_selftest_cli_end_to_end():
+    """`python -m photon_tpu.serving --selftest --json` — the CI smoke
+    face of this whole module — exits 0 with every check ok."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the CLI must self-provision its platform
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.serving", "--selftest", "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    assert all(v == "ok" for v in report["checks"].values())

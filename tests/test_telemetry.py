@@ -493,3 +493,80 @@ class TestOffIsFreeContract:
             assert n_cb.get("debug_callback", 0) >= 2  # init + loop body
         finally:
             telemetry.set_resident_tap(False)
+
+
+# ------------------------------------------------------- serving stream
+class TestServingStream:
+    def test_dispatcher_emits_serving_events_and_counters(self, rng,
+                                                          tmp_path):
+        """The round-9 serving.* spine: per-flush spans, request/batch/
+        cold-miss counters, the serving_batch JSONL event stream, and
+        the close-time latency gauges."""
+        from photon_tpu import serving
+        from photon_tpu.serving.__main__ import build_demo_model
+
+        model, _ = build_demo_model(seed=3)
+        store = serving.CoefficientStore.from_game_model(model)
+        ladder = serving.ProgramLadder(store, ladder=(4,),
+                                       sparse_k={"member": 3})
+        d_f = int(model["fixed"].model.coefficients.dim)
+        jsonl = str(tmp_path / "serve.jsonl")
+        r = telemetry.start_run("serve", jsonl_path=jsonl)
+        disp = serving.MicroBatchDispatcher(ladder, max_batch=4,
+                                            max_delay_us=1000)
+        try:
+            futs = [disp.submit(serving.ScoreRequest(
+                features={"global": rng.normal(size=d_f).astype(np.float32),
+                          "member": (np.asarray([0, 1], np.int32),
+                                     np.asarray([1.0, -1.0], np.float32))},
+                entities={"memberId": "e000" if i % 2 else "cold"}))
+                for i in range(6)]
+            [f.result(timeout=30) for f in futs]
+        finally:
+            disp.close()
+            telemetry.finish_run()
+        assert r.counters["serving.requests"] == 6.0
+        assert r.counters["serving.batches"] >= 2.0
+        assert r.counters["serving.cold_misses"] == 3.0
+        assert "serving.batch_fill" in r.gauges
+        assert r.gauges["serving.latency_p50_ms"] <= \
+            r.gauges["serving.latency_p99_ms"]
+        assert any(s.name == "serving.flush" for s in r.spans)
+        batches = list(telemetry.read_jsonl(jsonl, kind="serving_batch"))
+        assert sum(e["rows"] for e in batches) == 6
+        assert all(e["bucket"] == 4 for e in batches)
+
+    def test_docstring_is_single_source_of_truth_for_names(self, rng):
+        """Every serving.* counter/gauge a live dispatcher emits must be
+        listed in photon_tpu/telemetry/__init__'s docstring — the
+        documented registry of counter names."""
+        import photon_tpu.telemetry as t
+        from photon_tpu import serving
+        from photon_tpu.serving.__main__ import build_demo_model
+
+        model, _ = build_demo_model(seed=4)
+        store = serving.CoefficientStore.from_game_model(model)
+        ladder = serving.ProgramLadder(store, ladder=(4,),
+                                       sparse_k={"member": 3})
+        d_f = int(model["fixed"].model.coefficients.dim)
+        r = telemetry.start_run("doc")
+        disp = serving.MicroBatchDispatcher(ladder, max_batch=4,
+                                            max_delay_us=500)
+        try:
+            disp.score(serving.ScoreRequest(
+                features={"global": rng.normal(size=d_f).astype(np.float32),
+                          "member": (np.asarray([0], np.int32),
+                                     np.asarray([1.0], np.float32))},
+                entities={"memberId": "nope"}), timeout=30)
+        finally:
+            disp.close()
+            telemetry.finish_run()
+        doc = t.__doc__
+        emitted = [k for k in list(r.counters) + list(r.gauges)
+                   if k.startswith("serving.")]
+        assert emitted, "dispatcher emitted no serving.* telemetry"
+        for name in emitted:
+            short = name.split(".", 1)[1]
+            assert short in doc, (
+                f"{name} is not listed in telemetry/__init__'s docstring "
+                "— the single source of truth for counter names")
