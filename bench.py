@@ -444,6 +444,81 @@ def run_serving(ladder, pool) -> dict:
     }
 
 
+# --- checkpoint-overhead leg (round 10) -----------------------------------
+# The elasticity tax: the SAME streamed-dense problem as `streamed_dense`,
+# solved with crash-consistent snapshots every CK_EVERY_EVALS objective
+# evaluations (photon_tpu/checkpoint — async writer thread, so the solver
+# only pays state packing) vs. none. Reported as the rows·iters/s delta
+# plus snapshot volume; the acceptance bound is ≤5% overhead at this
+# default cadence (docs/ELASTICITY.md / PERF.md).
+CK_EVERY_EVALS = 16
+
+
+def run_checkpoint_overhead(chunk_rows: int = 1 << 16,
+                            baseline_rate: float | None = None,
+                            reps: int = REPS) -> dict:
+    import shutil
+    import tempfile
+
+    from photon_tpu import checkpoint
+    from photon_tpu import telemetry as _tm
+
+    cb, cfg = _streamed_problem(chunk_rows)
+    rows = cb.n
+
+    def once_plain():
+        _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+        return int(res.iterations)
+
+    if baseline_rate is None:
+        best, iters = _best_of(once_plain)
+        baseline_rate = rows * iters / best
+
+    ck_dir = tempfile.mkdtemp(prefix="photon_ckpt_bench_")
+
+    def once_ck():
+        # fresh store per rep: a leftover snapshot would resume (and
+        # shortcut) the solve instead of measuring it
+        shutil.rmtree(ck_dir, ignore_errors=True)
+        with checkpoint.session(ck_dir, every_evals=CK_EVERY_EVALS,
+                                every_s=None, async_writer=True):
+            _, res = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+        return int(res.iterations)
+
+    run = _tm.current_run()
+    c0 = dict(run.counters) if run is not None else {}
+    t0 = time.perf_counter()
+    global REPS
+    saved, REPS = REPS, reps
+    try:
+        best_ck, iters_ck = _best_of(once_ck)
+    finally:
+        REPS = saved
+    wall = time.perf_counter() - t0
+    if run is not None:
+        c1 = run.counters
+        n_snaps = c1.get("checkpoint.snapshots", 0) - \
+            c0.get("checkpoint.snapshots", 0)
+        n_bytes = c1.get("checkpoint.bytes", 0) - \
+            c0.get("checkpoint.bytes", 0)
+    else:  # no telemetry attached: estimate from the retained snapshots
+        store = checkpoint.SnapshotStore(ck_dir)
+        n_snaps = store.latest_seq() + 1
+        n_bytes = sum(os.path.getsize(os.path.join(dp, f))
+                      for dp, _, fs in os.walk(ck_dir) for f in fs)
+    shutil.rmtree(ck_dir, ignore_errors=True)
+    rate_ck = rows * iters_ck / best_ck
+    return {
+        "rows_iters_per_sec": rate_ck,
+        "baseline_rows_iters_per_sec": baseline_rate,
+        "overhead_pct": 100.0 * max(1.0 - rate_ck / baseline_rate, 0.0),
+        "cadence_evals": CK_EVERY_EVALS,
+        "snapshots": int(n_snaps),
+        "snapshot_bytes": int(n_bytes),
+        "snapshot_bytes_per_sec": (n_bytes / wall if wall > 0 else 0.0),
+    }
+
+
 def run_dense(batch, grid_weights) -> float:
     cfg = OptimizerConfig(max_iters=D_ITERS, tolerance=0.0, reg=l2(),
                           reg_weight=0.0)
@@ -505,6 +580,8 @@ def main() -> None:
         dense_big_value = run_dense(dense_batch, D_GRID_BIG)
     with telemetry.span("leg.streamed_dense"):
         streamed_value = run_streamed()
+    with telemetry.span("leg.checkpoint_overhead"):
+        ck_stats = run_checkpoint_overhead(baseline_rate=streamed_value)
     with telemetry.span("leg.streamed_mesh"):
         streamed_mesh_value, streamed_mesh_chips = run_streamed_mesh()
     with telemetry.span("leg.game_re_data"):
@@ -540,6 +617,15 @@ def main() -> None:
             "streamed_dense_rows_iters_per_sec_per_chip":
                 round(streamed_value, 1),
             "streamed_dense_vs_baseline": round(streamed_value / base, 3),
+            # elasticity tax (round 10): the same streamed problem with
+            # async crash-consistent snapshots every CK_EVERY_EVALS
+            # evaluations (photon_tpu/checkpoint); acceptance bound ≤5%
+            "checkpoint_overhead_rows_iters_per_sec":
+                round(ck_stats["rows_iters_per_sec"], 1),
+            "checkpoint_overhead_pct": round(ck_stats["overhead_pct"], 2),
+            "checkpoint_snapshots": ck_stats["snapshots"],
+            "checkpoint_snapshot_bytes_per_sec":
+                round(ck_stats["snapshot_bytes_per_sec"], 1),
             # streamed MESH regime (round 7): the same host-chunked problem
             # row-sharded over every visible chip, one psum per evaluation;
             # per-chip vs streamed_dense bounds the sharding overhead

@@ -67,6 +67,23 @@ def main() -> None:
                         "straggler-compacted random-effect block loop vs "
                         "the sequential one, skewed entity sizes) and "
                         "print its JSON line")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable crash-consistent snapshots of the run's "
+                        "solver state in this directory "
+                        "(photon_tpu/checkpoint; relative paths land "
+                        "under the run's out dir). A killed run rerun "
+                        "with --resume restores the last committed "
+                        "snapshot and finishes bit-identically")
+    p.add_argument("--resume", action="store_true",
+                   help="restore from --checkpoint-dir's last committed "
+                        "snapshot (also appends to the run's existing "
+                        "telemetry JSONL instead of truncating it)")
+    p.add_argument("--checkpoint-leg", action="store_true",
+                   help="also run bench.py's checkpoint_overhead leg "
+                        "(streamed-dense solve with async snapshots "
+                        "every K evaluations vs none; rows·iters/s "
+                        "delta + snapshot bytes/s) and print its JSON "
+                        "line")
     p.add_argument("--serving-leg", action="store_true",
                    help="also run bench.py's serving_qps leg (closed-loop "
                         "online scoring over a zipf entity mix through "
@@ -107,6 +124,8 @@ def main() -> None:
             train_path=train_path,
             validation_path=val_path,
             output_dir=os.path.join(args.out_dir, tag),
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_resume=args.resume,
             feature_shards=fd.FEATURE_SHARDS,
             coordinates=coords,
             entity_fields=["userId", "itemId"],
@@ -135,7 +154,10 @@ def main() -> None:
         # compact report embedded in the JSON line printed below
         jsonl = os.path.join(args.out_dir, f"game_r{run}",
                              "telemetry.jsonl")
-        trun = telemetry.start_run(f"flagship_r{run}", jsonl_path=jsonl)
+        # a --resume rerun APPENDS to the dead run's event log (the sink
+        # repairs a crash-torn tail record first) instead of truncating
+        trun = telemetry.start_run(f"flagship_r{run}", jsonl_path=jsonl,
+                                   append=args.resume)
         t0 = time.perf_counter()
         out = run_training(params(fd.COORDINATES, f"game_r{run}"),
                            mesh=mesh)
@@ -172,6 +194,24 @@ def main() -> None:
             "rows_iters_per_sec_per_chip": round(pipe, 1),
             "sequential_rows_iters_per_sec_per_chip": round(seq, 1),
             "speedup_vs_sequential": round(pipe / seq, 3)}), flush=True)
+
+    if args.checkpoint_leg:
+        # bench.py's checkpoint_overhead leg verbatim: the elasticity tax
+        # of async snapshots on the streamed-dense solve, beside the
+        # flagship run they protect.
+        import bench
+
+        ck = bench.run_checkpoint_overhead()
+        print(json.dumps({
+            "leg": "checkpoint_overhead",
+            "rows_iters_per_sec": round(ck["rows_iters_per_sec"], 1),
+            "baseline_rows_iters_per_sec":
+                round(ck["baseline_rows_iters_per_sec"], 1),
+            "overhead_pct": round(ck["overhead_pct"], 2),
+            "cadence_evals": ck["cadence_evals"],
+            "snapshots": ck["snapshots"],
+            "snapshot_bytes_per_sec":
+                round(ck["snapshot_bytes_per_sec"], 1)}), flush=True)
 
     if args.serving_leg:
         # bench.py's serving_qps leg verbatim: the online-scoring regime

@@ -1,0 +1,140 @@
+"""Elastic runs: crash-consistent checkpoint/restore + fault tolerance.
+
+The reference survives executor loss through Spark's lineage-based
+recomputation and rerun-against-HDFS habits; photon-tpu's host-driven
+regimes (streamed/mesh-streamed solves, the GAME block pipeline) have no
+lineage, so this package makes long runs restartable explicitly:
+
+- `state.py` — the process-wide :class:`CheckpointSession`: versioned,
+  schema-tagged snapshots of full solver state (L-BFGS/OWL-QN curvature
+  history + iterate + streamed margin caches and chunk cursor, GAME
+  coordinate/bucket progress, TRON trust radius via the resident tap).
+- `store.py` — crash-consistent storage: temp+fsync+rename commits
+  (shared with `utils/aot.py` and `serving/store.py`), manifest-pointer
+  snapshot directories with retention/GC, an async writer thread, and
+  barrier-stamped multi-host commits.
+- `faults.py` — deterministic kill-point injection + retry-with-backoff
+  for host IO (Avro ingest, snapshot reads/writes).
+- `taps.py` — the opt-in resident-solver last-iterate tap, compiled out
+  when disarmed (the ``checkpoint_off_*`` ContractSpecs pin that).
+
+::
+
+    from photon_tpu import checkpoint
+
+    with checkpoint.session("ckpt_dir", every_s=60):
+        train_glm(chunked, task, cfg)        # snapshots ride the solve
+    # ...process dies, restarts...
+    with checkpoint.session("ckpt_dir"):     # resume=True by default
+        train_glm(chunked, task, cfg)        # finishes bit-identically
+
+THE OFF-STATE CONTRACT (same as telemetry's): every hot-path touch point
+starts with ``if checkpoint.current() is None: return``-shaped guards,
+and jitted solver programs contain no checkpoint code at all unless the
+resident tap is armed at trace time.
+
+CLI: ``python -m photon_tpu.checkpoint --selftest [--json]`` runs an
+in-process snapshot → kill → restore → bit-parity proof and exits 1 on
+drift.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from photon_tpu.checkpoint.faults import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    TransientIOError,
+    arm_faults,
+    current_plan,
+    disarm_faults,
+    fault_plan,
+    kill_point,
+    record_sites,
+    retry_io,
+)
+from photon_tpu.checkpoint.state import (  # noqa: F401
+    SCHEMA_VERSION,
+    CheckpointSession,
+    SnapshotSchemaError,
+    SnapshotStateError,
+    pack_rows,
+    unpack_rows,
+)
+from photon_tpu.checkpoint.store import (  # noqa: F401
+    AsyncSnapshotWriter,
+    SnapshotStore,
+    commit_bytes,
+    replace_committed,
+)
+from photon_tpu.checkpoint.taps import (  # noqa: F401
+    resident_restore,
+    set_snapshot_tap,
+    snapshot_tap,
+    snapshot_tap_disabled,
+    snapshot_tap_enabled,
+)
+
+__all__ = [
+    "SCHEMA_VERSION", "CheckpointSession", "SnapshotStore",
+    "SnapshotSchemaError", "SnapshotStateError", "AsyncSnapshotWriter",
+    "commit_bytes", "replace_committed", "pack_rows", "unpack_rows",
+    "FaultPlan", "InjectedFault", "TransientIOError", "arm_faults",
+    "disarm_faults", "fault_plan", "current_plan", "kill_point",
+    "record_sites", "retry_io",
+    "start_session", "finish_session", "session", "current", "enabled",
+    "snapshot_tap", "snapshot_tap_enabled", "set_snapshot_tap",
+    "snapshot_tap_disabled", "resident_restore",
+]
+
+_CURRENT: Optional[CheckpointSession] = None
+_ATTACH_LOCK = threading.Lock()
+
+
+def start_session(store, **kwargs) -> CheckpointSession:
+    """Create a CheckpointSession (``store``: a SnapshotStore or a
+    directory path) and attach it process-wide. One session at a time —
+    starting a new one closes the old (same lifecycle as
+    telemetry.start_run)."""
+    global _CURRENT
+    with _ATTACH_LOCK:
+        if _CURRENT is not None:
+            _CURRENT.close()
+        s = CheckpointSession(store, **kwargs)
+        _CURRENT = s
+        set_snapshot_tap(s.resident_tap)
+    return s
+
+
+def finish_session(final_snapshot: bool = False) -> None:
+    """Close and detach the current session (draining the async writer)."""
+    global _CURRENT
+    with _ATTACH_LOCK:
+        s, _CURRENT = _CURRENT, None
+        set_snapshot_tap(False)
+    if s is not None:
+        s.close(final_snapshot=final_snapshot)
+
+
+@contextlib.contextmanager
+def session(store, **kwargs):
+    """``with checkpoint.session(dir, every_s=60) as s:`` — scoped
+    start_session/finish_session."""
+    s = start_session(store, **kwargs)
+    try:
+        yield s
+    finally:
+        if _CURRENT is s:
+            finish_session()
+        else:
+            s.close()
+
+
+def current() -> Optional[CheckpointSession]:
+    return _CURRENT
+
+
+def enabled() -> bool:
+    return _CURRENT is not None

@@ -1,0 +1,179 @@
+"""CLI: in-process snapshot → kill → restore → bit-parity smoke check.
+
+    python -m photon_tpu.checkpoint --selftest           # human, exit 1 on drift
+    python -m photon_tpu.checkpoint --selftest --json    # machine report
+
+The selftest runs the whole elastic-run story on a small streamed solve,
+entirely in this process (mirroring `analysis`/`telemetry`/`serving`
+``__main__`` idiom — self-provisioned CPU platform, a few seconds):
+
+1. an uninterrupted streamed L-BFGS solve (the reference answer);
+2. the same solve killed by an injected fault at an evaluation site,
+   then restored from the last committed snapshot and finished — the
+   final coefficients must be BIT-identical (f64-compared);
+3. a kill injected DURING a snapshot write (payloads durable, manifest
+   not yet swung) — restore must fall back to the previous committed
+   manifest and still finish bit-identically;
+4. the host-IO retry path: injected transient errors must be absorbed by
+   `faults.retry_io`'s backoff;
+5. the two ``checkpoint_off_*`` ContractSpecs must trace clean (the
+   snapshot tap is compiled out of jitted solver programs when disarmed).
+
+Exit 1 on any drift or failure.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _problem():
+    import numpy as np
+
+    from photon_tpu.data.dataset import chunk_batch, make_batch
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    rng = np.random.default_rng(7)
+    n, d = 96, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-(X @ w_true)))
+         ).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=10, tolerance=0.0, reg=l2(),
+                          reg_weight=1e-2, history=4)
+    return chunk_batch(make_batch(X, y), 32), cfg
+
+
+def selftest() -> dict:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu import checkpoint
+    from photon_tpu.models.training import train_glm
+    from photon_tpu.ops.losses import TaskType
+
+    cb, cfg = _problem()
+    task = TaskType.LOGISTIC_REGRESSION
+    report: dict = {"checks": {}}
+    ok = True
+
+    def check(name: str, passed: bool, detail: str = "") -> None:
+        nonlocal ok
+        report["checks"][name] = {"ok": bool(passed),
+                                  **({"detail": detail} if detail else {})}
+        ok = ok and bool(passed)
+
+    _, r_ref = train_glm(cb, task, cfg)
+    w_ref = np.asarray(r_ref.w, np.float64)
+
+    # ---- kill at an evaluation, restore, finish: bit parity
+    tmp = tempfile.mkdtemp(prefix="photon_ckpt_selftest_")
+    try:
+        killed = False
+        try:
+            with checkpoint.session(tmp, every_evals=1, every_s=None,
+                                    async_writer=False):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at("evaluation", 7)):
+                    train_glm(cb, task, cfg)
+        except checkpoint.InjectedFault:
+            killed = True
+        check("kill_injected", killed)
+        with checkpoint.session(tmp, every_evals=1, every_s=None,
+                                async_writer=False):
+            _, r2 = train_glm(cb, task, cfg)
+        same = bool(np.array_equal(w_ref, np.asarray(r2.w, np.float64)))
+        check("resume_bit_identical", same,
+              "" if same else "coefficients drifted after restore")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- kill DURING a snapshot write: previous manifest must serve
+    tmp2 = tempfile.mkdtemp(prefix="photon_ckpt_selftest_")
+    try:
+        try:
+            with checkpoint.session(tmp2, every_evals=1, every_s=None,
+                                    async_writer=False):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at("snapshot_write", 4)):
+                    train_glm(cb, task, cfg)
+        except checkpoint.InjectedFault:
+            pass
+        store = checkpoint.SnapshotStore(tmp2)
+        seq = store.latest_seq()
+        check("mid_write_fallback_manifest", seq >= 0,
+              f"latest committed seq={seq}")
+        with checkpoint.session(tmp2, every_evals=1, every_s=None,
+                                async_writer=False):
+            _, r3 = train_glm(cb, task, cfg)
+        check("mid_write_resume_bit_identical",
+              bool(np.array_equal(w_ref, np.asarray(r3.w, np.float64))))
+    finally:
+        shutil.rmtree(tmp2, ignore_errors=True)
+
+    # ---- transient-IO retry/backoff
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        return "ok"
+
+    with checkpoint.fault_plan(checkpoint.FaultPlan(
+            errors={"selftest_io": 2})):
+        out = checkpoint.retry_io(flaky, site="selftest_io",
+                                  base_delay=0.001, sleep=lambda _s: None)
+    check("io_retry_backoff", out == "ok" and calls["n"] == 1,
+          f"fn called {calls['n']}x after 2 injected errors")
+
+    # ---- the compiled-out contracts
+    from photon_tpu.analysis.contracts import check_contract
+    from photon_tpu.analysis.registry import load_registry
+
+    registry = load_registry()
+    for name in ("checkpoint_off_is_free", "checkpoint_off_tron_free"):
+        spec = registry.get(name)
+        if spec is None:
+            check(name, False, "spec not registered")
+            continue
+        violations = check_contract(spec)
+        check(name, not violations,
+              "; ".join(str(v) for v in violations) if violations else "")
+
+    report["ok"] = ok
+    return report
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" not in argv:
+        print(__doc__)
+        return 2
+    _default_env()
+    import json
+
+    report = selftest()
+    if "--json" in argv:
+        print(json.dumps(report))
+    else:
+        for name, entry in report["checks"].items():
+            status = "ok" if entry["ok"] else "FAIL"
+            detail = f"  ({entry['detail']})" if entry.get("detail") else ""
+            print(f"  {name}: {status}{detail}")
+        print("checkpoint selftest:", "ok" if report["ok"] else "FAILED")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,199 @@
+"""Deterministic fault injection + host-IO retry/backoff.
+
+Reference parity: the reference inherits its failure story from Spark —
+executor loss replays lineage, HDFS clients retry transient IO — and its
+tests trust that machinery. photon-tpu's host loops (streamed solves, the
+GAME block pipeline, snapshot writers) have no lineage to replay, so this
+module supplies the two halves explicitly:
+
+- **kill points** — named sites on the hot paths (``chunk_upload``,
+  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``) where
+  an armed :class:`FaultPlan` raises :class:`InjectedFault` at a chosen
+  occurrence, simulating a preemption at exactly that moment. Sites are
+  DETERMINISTIC: the n-th hit of a site is the same program point on every
+  run, so the checkpoint parity tests can kill a run at every site and
+  prove bit-identical resume. Disarmed (the default), a kill point is one
+  module-global load and one branch — the same off-state contract as
+  `photon_tpu.telemetry`.
+- **transient errors + retry** — :func:`retry_io` wraps host IO (Avro
+  container opens, snapshot reads/writes) in bounded retry with
+  exponential backoff; an armed plan can inject ``OSError`` a fixed number
+  of times at a site to prove the retry path end to end. Backoff is
+  deterministic (no jitter): these are host-side file systems, not a
+  thundering-herd RPC fleet, and determinism keeps tests exact.
+
+Counters (no-ops without a telemetry Run): ``faults.injected_kills``,
+``faults.injected_errors``, ``faults.io_retries``,
+``faults.backoff_seconds``.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Callable, Optional
+
+from photon_tpu import telemetry
+
+__all__ = [
+    "InjectedFault", "TransientIOError", "FaultPlan", "arm_faults",
+    "disarm_faults", "fault_plan", "current_plan", "kill_point",
+    "record_sites", "retry_io",
+]
+
+
+class InjectedFault(RuntimeError):
+    """An injected kill: the simulated preemption. Deliberately an
+    exception (not os._exit) so in-process tests observe the exact state a
+    real SIGKILL would leave on disk, while the dead run's Python state is
+    simply abandoned."""
+
+    def __init__(self, site: str, occurrence: int):
+        super().__init__(f"injected fault at {site!r} occurrence "
+                         f"{occurrence}")
+        self.site = site
+        self.occurrence = occurrence
+
+
+class TransientIOError(OSError):
+    """The injected transient host-IO failure (an OSError subclass, so the
+    default ``retry_io`` policy retries it)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """What to inject where.
+
+    kills: site -> 1-based occurrence at which to raise InjectedFault.
+    errors: site -> number of leading occurrences that raise
+        TransientIOError before the site starts succeeding (exercises the
+        retry/backoff path).
+    """
+
+    kills: dict = dataclasses.field(default_factory=dict)
+    errors: dict = dataclasses.field(default_factory=dict)
+    # live occurrence counters per site (site -> hits so far)
+    hits: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def kill_at(cls, site: str, occurrence: int) -> "FaultPlan":
+        return cls(kills={site: int(occurrence)})
+
+    @classmethod
+    def seeded(cls, seed: int, site_counts: dict) -> "FaultPlan":
+        """A deterministic seeded kill: pick one (site, occurrence) from
+        the observed ``site -> hit count`` map of a dry run
+        (:func:`record_sites`). Same seed + same counts = same kill."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        sites = sorted(s for s, c in site_counts.items() if c > 0)
+        if not sites:
+            raise ValueError("no fault sites were hit in the dry run")
+        site = sites[int(rng.integers(len(sites)))]
+        occ = 1 + int(rng.integers(site_counts[site]))
+        return cls.kill_at(site, occ)
+
+    def hit(self, site: str) -> int:
+        n = self.hits.get(site, 0) + 1
+        self.hits[site] = n
+        return n
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def arm_faults(plan: FaultPlan) -> FaultPlan:
+    """Arm a plan process-wide (occurrence counters start fresh)."""
+    global _PLAN
+    plan.hits = {}
+    _PLAN = plan
+    return plan
+
+
+def disarm_faults() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+@contextlib.contextmanager
+def fault_plan(plan: FaultPlan):
+    """``with fault_plan(FaultPlan.kill_at("bucket_retire", 2)): ...``"""
+    arm_faults(plan)
+    try:
+        yield plan
+    finally:
+        disarm_faults()
+
+
+def kill_point(site: str) -> None:
+    """A named preemption site. Disarmed: one global load + one branch."""
+    plan = _PLAN
+    if plan is None:
+        return
+    n = plan.hit(site)
+    if plan.kills.get(site) == n:
+        telemetry.count("faults.injected_kills")
+        raise InjectedFault(site, n)
+
+
+def _maybe_io_error(site: str) -> None:
+    """Transient-error half of a site: raise TransientIOError for the
+    first ``errors[site]`` occurrences (each retry attempt is its own
+    occurrence, so ``errors={"s": 2}`` fails twice then succeeds)."""
+    plan = _PLAN
+    if plan is None:
+        return
+    n = plan.hit(site)
+    if n <= plan.errors.get(site, 0):
+        telemetry.count("faults.injected_errors")
+        raise TransientIOError(f"injected transient IO failure at "
+                               f"{site!r} occurrence {n}")
+
+
+class _Recorder(FaultPlan):
+    pass
+
+
+@contextlib.contextmanager
+def record_sites():
+    """Dry-run recorder: arms a plan that injects NOTHING but counts site
+    hits — the fault matrix a test enumerates kills over.
+
+    >>> with record_sites() as rec: run()
+    >>> rec.hits  # {"evaluation": 42, "chunk_upload": 126, ...}
+    """
+    rec = _Recorder()
+    arm_faults(rec)
+    try:
+        yield rec
+    finally:
+        disarm_faults()
+
+
+def retry_io(fn: Callable, *, site: str, retries: int = 4,
+             base_delay: float = 0.05, max_delay: float = 2.0,
+             retry_on: tuple = (OSError,), sleep=time.sleep):
+    """Run ``fn()`` with bounded exponential-backoff retry on transient
+    host-IO errors (delays ``base_delay * 2**attempt`` capped at
+    ``max_delay``; deterministic, no jitter). The armed fault plan's
+    ``errors[site]`` budget injects failures here, so the retry path is
+    provable end to end. The final failure re-raises unmodified."""
+    attempt = 0
+    while True:
+        try:
+            _maybe_io_error(site)
+            return fn()
+        except retry_on:
+            if attempt >= retries:
+                raise
+            delay = min(base_delay * (2.0 ** attempt), max_delay)
+            telemetry.count("faults.io_retries")
+            telemetry.count(f"faults.io_retries.{site}")
+            telemetry.count("faults.backoff_seconds", delay)
+            sleep(delay)
+            attempt += 1

@@ -1,0 +1,298 @@
+"""Versioned solver-state snapshots: the process-wide checkpoint session.
+
+The design mirrors `photon_tpu.telemetry`'s spine: one process-wide
+:class:`CheckpointSession` the instrumented host loops report into, armed
+by the driver (``telemetry``-style ``checkpoint.session(...)`` /
+``start_session``), with every hot-path touch point guarded by a single
+``checkpoint.current() is None`` branch — a session-less process pays one
+global load per call site and the jitted solver programs contain nothing
+at all (the ``checkpoint_off_*`` ContractSpecs in `taps.py` pin that).
+
+What a snapshot holds — the full solver state of every live scope, at the
+last consistent cut each contributor reported:
+
+- streamed L-BFGS / OWL-QN (`optim/streamed.py`): the iterate ``w``, the
+  gradient, the circular (S, Y, rho) curvature history with its cursor,
+  the per-chunk cached margins (``z``) with their refresh generation and
+  chunk cursor, the loss/grad histories, and the convergence flags — the
+  complete iteration-boundary state, so a resumed run replays the next
+  iteration bit-identically.
+- GAME (`game/coordinate_descent.py` + `game/random_effect.py`): the
+  models/scores/objective history after each completed coordinate update,
+  plus — inside a live random-effect update — the coefficient array,
+  per-entity iteration counts and the retired-bucket cursor (the
+  pipeline's `_InFlight` ledger is NOT snapshotted: retire order equals
+  dispatch order, so "buckets 0..k retired" is a consistent cut and the
+  un-retired tail simply re-dispatches on resume).
+- resident solvers (`checkpoint/taps.py`): a best-effort last-iterate
+  (w, f, |g|, TRON trust radius) via an opt-in jax.debug.callback tap —
+  a warm start for the next attempt, not a bit-identical mid-program
+  resume (a resident solve is ONE XLA program; there is no host cut
+  inside it).
+
+Snapshots are taken at iteration/bucket/update boundaries only, so
+cadence (wall clock or evaluation count) never affects the numbers a
+resumed run produces — restore rewinds to the last committed boundary and
+recomputes forward deterministically. Mesh state is packed in GLOBAL row
+order (`pack_rows`/`unpack_rows` ride `parallel.mesh.local_row_slots`),
+so a snapshot from an 8-way mesh restores onto a 4-way mesh or a single
+device — same solution, with the usual cross-topology f32 reduction-order
+caveat (bit-identical resume is a same-topology guarantee).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import telemetry
+from photon_tpu.checkpoint.store import (AsyncSnapshotWriter, SnapshotStore,
+                                         SnapshotSchemaError)
+
+__all__ = ["SCHEMA_VERSION", "CheckpointSession", "SnapshotStateError",
+           "SnapshotSchemaError", "pack_rows", "unpack_rows"]
+
+# Bump on ANY layout change to the per-scope payloads below. Restore
+# refuses schemas NEWER than this with a clear error (store.load_latest);
+# older schemas are read forward-compatibly or refused per field.
+SCHEMA_VERSION = 1
+
+
+class SnapshotStateError(ValueError):
+    """Restored state that does not fit the resuming program (wrong
+    solver, problem shape, chunking, or iteration budget) — refused with
+    the mismatch spelled out instead of resuming into silent drift."""
+
+
+# ----------------------------------------------------- row-shard re-layout
+def pack_rows(local, mesh, n_rows: int) -> np.ndarray:
+    """Canonical GLOBAL row vector of a (possibly mesh-sharded) per-row
+    cache. ``local`` is the backend's host layout: a flat ``(rows,)``
+    array single-device, or the ``(n_local_slots, s)`` local-slot stack of
+    `parallel.mesh.fetch_local_rows` under a mesh. Returns the first
+    ``n_rows`` rows in global order (slot-major), copied."""
+    if mesh is None:
+        return np.array(np.asarray(local)[:n_rows], dtype=np.float32)
+    from photon_tpu.parallel.mesh import flat_mesh_devices, local_row_slots
+
+    local = np.asarray(local)
+    n_slots = len(flat_mesh_devices(mesh))
+    slots = local_row_slots(mesh)
+    s = local.shape[1]
+    out = np.zeros((n_slots * s,), np.float32)
+    for k, j in enumerate(slots):
+        out[j * s:(j + 1) * s] = local[k]
+    return np.array(out[:n_rows])
+
+
+def unpack_rows(z_global: np.ndarray, mesh, pad_rows: int):
+    """Inverse of :func:`pack_rows` onto a (possibly DIFFERENT) topology:
+    zero-pad the canonical global rows to ``pad_rows`` (the new layout's
+    padded chunk height — pad rows carry weight 0 in every GLMBatch, so
+    their values never enter a reduction) and re-slice into the target
+    backend's host layout."""
+    z_global = np.asarray(z_global, np.float32)
+    n = z_global.shape[0]
+    buf = np.zeros((int(pad_rows),), np.float32)
+    buf[:n] = z_global
+    if mesh is None:
+        return buf
+    from photon_tpu.parallel.mesh import flat_mesh_devices, local_row_slots
+
+    n_slots = len(flat_mesh_devices(mesh))
+    s = int(pad_rows) // n_slots
+    stack = buf.reshape(n_slots, s)
+    return np.array(stack[local_row_slots(mesh)])
+
+
+def _copy_value(v):
+    """Payload values snapshot by VALUE at update() time: device arrays
+    are fetched, numpy is copied (live buffers keep mutating), scalars and
+    json-ables pass through."""
+    if isinstance(v, np.ndarray):
+        return np.array(v, copy=True)
+    if hasattr(v, "shape") and hasattr(v, "dtype"):  # jax array
+        return np.array(np.asarray(v), copy=True)
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    return v
+
+
+class CheckpointSession:
+    """One run's crash-consistency state: live per-scope payloads, the
+    restore image, cadence, and the (async) writer.
+
+    - ``every_s`` / ``every_evals``: snapshot cadence by wall clock and/or
+      evaluation count (whichever fires first; None disables that axis).
+      ``maybe_snapshot()`` is called by contributors at their consistent
+      cuts, so cadence only chooses WHICH boundary commits — never the
+      numbers a resume produces.
+    - ``resume=True`` loads the store's last committed snapshot (if any)
+      as the restore image; contributors claim their piece via
+      ``restore(leaf)`` exactly once each.
+    - ``async_writer=True`` commits on a daemon thread (packing — host
+      copies — stays synchronous; that is the consistency point).
+    - ``resident_tap=True`` arms the jitted-solver snapshot tap
+      (`taps.snapshot_tap`), which otherwise compiles out entirely.
+    """
+
+    def __init__(self, store, *, every_s: Optional[float] = 30.0,
+                 every_evals: Optional[int] = None, resume: bool = True,
+                 async_writer: bool = True, keep: int = 2,
+                 resident_tap: bool = False):
+        if not isinstance(store, SnapshotStore):
+            store = SnapshotStore(store, keep=keep)
+        self.store = store
+        self.every_s = every_s
+        self.every_evals = every_evals
+        self._lock = threading.Lock()
+        self._state: dict = {}
+        self._scope: list = []
+        self._invocations: dict = {}
+        self._restored: Optional[dict] = None
+        self._restored_manifest: Optional[dict] = None
+        self._closed = False
+        self.resident_tap = bool(resident_tap)
+        if resume:
+            loaded = self.store.load_latest()
+            if loaded is not None:
+                self._restored, self._restored_manifest = loaded
+                # seed the live state so an early snapshot after resume
+                # still carries the outer scopes' progress
+                self._state = {p: dict(v)
+                               for p, v in self._restored.items()}
+                telemetry.count("checkpoint.restores")
+        self._seq = self.store.latest_seq() + 1
+        self._writer = AsyncSnapshotWriter(self.store) if async_writer \
+            else None
+        self._last_snap_t = time.perf_counter()
+        self._evals = 0
+
+    # --------------------------------------------------------------- scoping
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        """Nest subsequent update/restore paths under ``name`` (the GAME
+        driver scopes each coordinate update so concurrent state never
+        collides across updates, sweeps, or grid points)."""
+        self._scope.append(str(name))
+        try:
+            yield self
+        finally:
+            self._scope.pop()
+
+    def path(self, leaf: str) -> str:
+        return "/".join(self._scope + [str(leaf)])
+
+    def invocation(self, tag: str) -> int:
+        """Deterministic per-tag call counter (scoping repeated identical
+        invocations, e.g. duplicate grid points)."""
+        n = self._invocations.get(tag, 0)
+        self._invocations[tag] = n + 1
+        return n
+
+    # ----------------------------------------------------------- state edits
+    def update(self, leaf: str, payload: dict) -> None:
+        """Report a scope's state at a consistent cut (copied by value)."""
+        packed = {k: _copy_value(v) for k, v in payload.items()}
+        with self._lock:
+            self._state[self.path(leaf)] = packed
+
+    def update_absolute(self, path: str, payload: dict) -> None:
+        """`update` at an absolute path (the resident tap's callback runs
+        outside any scope stack)."""
+        packed = {k: _copy_value(v) for k, v in payload.items()}
+        with self._lock:
+            self._state[str(path)] = packed
+
+    def clear(self, leaf: Optional[str] = None, prefix: bool = False) -> None:
+        """Drop a completed scope's state (``prefix=True`` drops every
+        path under it) from live state AND the restore image — a finished
+        unit must never be restored again."""
+        base = self.path(leaf) if leaf is not None else "/".join(self._scope)
+        with self._lock:
+            for d in (self._state, self._restored):
+                if d is None:
+                    continue
+                if prefix:
+                    for k in [k for k in d
+                              if k == base or k.startswith(base + "/")]:
+                        del d[k]
+                else:
+                    d.pop(base, None)
+
+    # -------------------------------------------------------------- restore
+    def restore(self, leaf: str) -> Optional[dict]:
+        """The restore image's payload for this scope path (or None).
+        Consumed once: a second call returns None, so re-entered loops
+        after completion start fresh."""
+        if self._restored is None:
+            return None
+        path = self.path(leaf)
+        with self._lock:
+            payload = self._restored.pop(path, None)
+        if payload is not None:
+            telemetry.count("checkpoint.scope_restores")
+        return payload
+
+    def restored_any(self) -> bool:
+        return self._restored_manifest is not None
+
+    # -------------------------------------------------------------- cadence
+    def note_evaluations(self, n: int = 1) -> None:
+        self._evals += int(n)
+
+    def due(self) -> bool:
+        if self.every_evals is not None and self._evals >= self.every_evals:
+            return True
+        if self.every_s is not None and \
+                time.perf_counter() - self._last_snap_t >= self.every_s:
+            return True
+        return False
+
+    def maybe_snapshot(self) -> bool:
+        """Snapshot iff the cadence says so. Contributors call this at
+        every consistent cut; the commit itself rides the writer thread
+        when async."""
+        if not self.due():
+            return False
+        self.snapshot()
+        return True
+
+    def snapshot(self, block: bool = False) -> int:
+        """Commit the current state as the next snapshot. Packing (host
+        copies) happens synchronously here — the consistency point; the
+        fsync/rename latency rides the writer thread unless ``block`` or
+        the session is synchronous."""
+        with telemetry.span("checkpoint.pack"):
+            with self._lock:
+                state = {p: dict(v) for p, v in self._state.items()}
+                seq = self._seq
+                self._seq += 1
+        meta = {"created_unix": time.time()}
+        if self._writer is not None:
+            self._writer.submit(state, seq, meta)
+            if block:
+                self._writer.drain()
+        else:
+            self.store.commit(state, seq, meta)
+        self._last_snap_t = time.perf_counter()
+        self._evals = 0
+        return seq
+
+    # ----------------------------------------------------------------- close
+    def close(self, final_snapshot: bool = False) -> None:
+        """Drain the writer (optionally committing one final snapshot).
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if final_snapshot:
+                self.snapshot(block=True)
+            if self._writer is not None:
+                self._writer.close()
+        finally:
+            self._writer = None

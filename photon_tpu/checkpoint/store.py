@@ -1,0 +1,364 @@
+"""Crash-consistent snapshot storage: temp + fsync + rename commits, a
+manifest-pointer snapshot layout, an async writer thread, and retention.
+
+The durability protocol, smallest piece first:
+
+- :func:`commit_bytes` — THE one commit primitive in the repo: write to a
+  same-directory temp name, flush + fsync the file, ``os.replace`` onto
+  the final name, fsync the directory. Readers see the old bytes or the
+  new bytes, never a torn write. ``utils/aot.py`` (program exports) and
+  ``serving/store.py`` (coefficient stores) route their persistence
+  through it; the ``commit`` fault site sits between the temp write and
+  the rename so kill-mid-write is a tested path, not a hope.
+- :class:`SnapshotStore` — numbered snapshot directories
+  (``snap_00000007/`` holding one ``.npy`` per state array + a
+  ``meta.json``) committed by atomically replacing the store-level
+  ``MANIFEST.json`` pointer LAST. A kill anywhere before the manifest
+  replace leaves the previous manifest intact, so restore always falls
+  back to the last fully-committed snapshot — the ``snapshot_write``
+  fault site sits exactly in that window. Retention deletes old snapshot
+  dirs only AFTER the new manifest commits (a crash between the two
+  leaves unreferenced orphans, never a dangling pointer; orphans are
+  swept on the next commit).
+- :class:`AsyncSnapshotWriter` — a daemon writer thread draining a FIFO
+  queue, so packing (host array copies) is the only synchronous cost a
+  solver iteration pays and the fsync/rename latency overlaps the next
+  chunk stream (the ``checkpoint_overhead`` bench leg measures the
+  residual).
+
+Multi-host: every process writes its payload under a ``p<process>_``
+prefix into the same snapshot directory (shared storage, the HDFS role);
+process 0 alone replaces the manifest, after a best-effort
+``sync_global_devices`` barrier — one barrier-stamped manifest commits
+all processes' shards or none of them. Restore merges every process
+prefix it finds, so a restore onto a different process/mesh layout sees
+the full global state (`state.py` re-shards row-sharded entries via the
+``parallel/mesh.py`` slot helpers).
+
+Snapshot reads/writes ride :func:`faults.retry_io` (site
+``snapshot_io``): transient storage hiccups back off and retry instead of
+killing an N-hour run.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import queue
+import shutil
+import threading
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import telemetry
+from photon_tpu.checkpoint import faults
+
+__all__ = ["commit_bytes", "fsync_dir", "replace_committed",
+           "SnapshotStore", "AsyncSnapshotWriter", "SnapshotSchemaError"]
+
+_MANIFEST = "MANIFEST.json"
+_FORMAT = "photon_tpu-snapshot-store-v1"
+
+
+class SnapshotSchemaError(ValueError):
+    """A snapshot this build cannot read (e.g. written by a NEWER
+    photon-tpu) — a clear refusal, never a pickle/shape explosion."""
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (best-effort on filesystems without directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def commit_bytes(path: str, data: bytes) -> None:
+    """Atomically commit ``data`` at ``path``: same-dir temp file, flush +
+    fsync, rename, directory fsync. A kill at any point leaves either the
+    old file or the new file — never a truncated one. (The ``commit``
+    fault site sits in the widest window, after the temp write.)"""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    faults.kill_point("commit")
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def replace_committed(tmp: str, path: str) -> None:
+    """Commit an already-written temp FILE (fsync it first, then rename +
+    dir fsync) — for writers that must stream to their own path (index
+    maps, native stores) before the atomic publish."""
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    faults.kill_point("commit")
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _barrier(tag: str) -> None:
+    """Best-effort multi-process barrier before the manifest commit (a
+    no-op single-process, which is also the fallback when the distributed
+    runtime is not initialized)."""
+    try:
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+    except Exception:
+        pass
+
+
+class SnapshotStore:
+    """Numbered, manifest-committed snapshots of a state dict.
+
+    State shape: ``{path: {key: np.ndarray | json-able scalar/list}}`` —
+    the flat face of `state.CheckpointSession`'s live registry. Arrays
+    land one ``.npy`` per (path, key); everything else inlines into
+    ``meta.json``.
+    """
+
+    def __init__(self, root: str, keep: int = 2):
+        self.root = os.fspath(root)
+        self.keep = max(int(keep), 1)
+        os.makedirs(self.root, exist_ok=True)
+
+    # ------------------------------------------------------------ manifest
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, _MANIFEST)
+
+    def read_manifest(self) -> Optional[dict]:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return None
+
+        def _read():
+            with open(path) as f:
+                return json.load(f)
+
+        return faults.retry_io(_read, site="snapshot_io")
+
+    def latest_seq(self) -> int:
+        """Sequence number of the last committed snapshot (-1 if none)."""
+        m = self.read_manifest()
+        return -1 if m is None else int(m["seq"])
+
+    # -------------------------------------------------------------- commit
+    def commit(self, state: dict, seq: int, meta: Optional[dict] = None,
+               schema: Optional[int] = None) -> str:
+        """Write snapshot ``seq`` and commit it via the manifest pointer.
+
+        Multi-host: all processes write their payloads, process 0 commits
+        the manifest after the barrier. Returns the snapshot dir name."""
+        from photon_tpu.checkpoint.state import SCHEMA_VERSION
+
+        schema = SCHEMA_VERSION if schema is None else int(schema)
+        name = f"snap_{seq:08d}"
+        snap_dir = os.path.join(self.root, name)
+        proc = _process_index()
+        if proc == 0 and os.path.isdir(snap_dir):
+            # leftovers of a dead uncommitted attempt at this seq
+            shutil.rmtree(snap_dir, ignore_errors=True)
+        os.makedirs(snap_dir, exist_ok=True)
+
+        entries: dict = {}
+        n_bytes = 0
+        idx = 0
+        with telemetry.span("checkpoint.write", seq=seq):
+            for path in sorted(state):
+                payload = state[path]
+                entry: dict = {}
+                for key in sorted(payload):
+                    v = payload[key]
+                    if isinstance(v, np.ndarray):
+                        fname = f"p{proc}_{idx:05d}.npy"
+                        idx += 1
+                        data = _npy_bytes(v)
+                        n_bytes += len(data)
+                        fpath = os.path.join(snap_dir, fname)
+                        faults.retry_io(
+                            lambda d=data, p=fpath: _write_fsync(p, d),
+                            site="snapshot_io")
+                        entry[key] = {"file": fname}
+                    else:
+                        entry[key] = {"json": v}
+                entries[path] = entry
+            meta_obj = {"format": _FORMAT, "schema": schema, "seq": seq,
+                        "process": proc, "entries": entries}
+            if meta:
+                meta_obj["meta"] = meta
+            meta_bytes = json.dumps(meta_obj).encode()
+            n_bytes += len(meta_bytes)
+            faults.retry_io(
+                lambda: _write_fsync(
+                    os.path.join(snap_dir, f"meta_p{proc}.json"),
+                    meta_bytes),
+                site="snapshot_io")
+            fsync_dir(snap_dir)
+            # THE mid-write kill window: payloads durable, pointer not yet
+            # moved — a death here must restore from the PREVIOUS manifest.
+            faults.kill_point("snapshot_write")
+            _barrier(f"photon_ckpt_commit_{seq}")
+            if proc == 0:
+                manifest = {"format": _FORMAT, "schema": schema, "seq": seq,
+                            "latest": name}
+                faults.retry_io(
+                    lambda: commit_bytes(self._manifest_path(),
+                                         json.dumps(manifest).encode()),
+                    site="snapshot_io")
+                self._gc(keep_name=name)
+        telemetry.count("checkpoint.snapshots")
+        telemetry.count("checkpoint.bytes", n_bytes)
+        return name
+
+    def _gc(self, keep_name: str) -> None:
+        """Retention AFTER the manifest commit: keep the newest ``keep``
+        snapshot dirs (by seq), delete the rest — including uncommitted
+        orphans a previous death left behind."""
+        dirs = sorted(d for d in os.listdir(self.root)
+                      if d.startswith("snap_")
+                      and os.path.isdir(os.path.join(self.root, d)))
+        doomed = [d for d in dirs[:-self.keep] if d != keep_name] \
+            if len(dirs) > self.keep else []
+        for d in doomed:
+            shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
+        if doomed:
+            telemetry.count("checkpoint.gc_snapshots", len(doomed))
+
+    # --------------------------------------------------------------- restore
+    def load_latest(self) -> Optional[tuple]:
+        """(state, manifest) of the last COMMITTED snapshot, or None.
+
+        Merges every process prefix found in the snapshot dir (shared
+        storage). Raises :class:`SnapshotSchemaError` on a snapshot whose
+        schema is newer than this build understands."""
+        from photon_tpu.checkpoint.state import SCHEMA_VERSION
+
+        manifest = self.read_manifest()
+        if manifest is None:
+            return None
+        if manifest.get("format") != _FORMAT:
+            raise SnapshotSchemaError(
+                f"{self.root}: manifest format "
+                f"{manifest.get('format')!r} is not {_FORMAT!r}")
+        if int(manifest.get("schema", 0)) > SCHEMA_VERSION:
+            raise SnapshotSchemaError(
+                f"snapshot schema v{manifest['schema']} is newer than this "
+                f"build's v{SCHEMA_VERSION}: resume with a photon-tpu at "
+                "least as new as the one that wrote the checkpoint (or "
+                "start fresh with a new --checkpoint-dir)")
+        snap_dir = os.path.join(self.root, manifest["latest"])
+        state: dict = {}
+        metas = sorted(f for f in os.listdir(snap_dir)
+                       if f.startswith("meta_p") and f.endswith(".json"))
+        if not metas:
+            raise SnapshotSchemaError(
+                f"{snap_dir}: committed snapshot has no meta files")
+        for mf in metas:
+
+            def _read(path=os.path.join(snap_dir, mf)):
+                with open(path) as f:
+                    return json.load(f)
+
+            meta = faults.retry_io(_read, site="snapshot_io")
+            if int(meta.get("schema", 0)) > SCHEMA_VERSION:
+                raise SnapshotSchemaError(
+                    f"snapshot schema v{meta['schema']} is newer than "
+                    f"this build's v{SCHEMA_VERSION}")
+            for path, entry in meta["entries"].items():
+                payload = state.setdefault(path, {})
+                for key, spec in entry.items():
+                    if key in payload:
+                        continue  # replicated entry: first process wins
+                    if "file" in spec:
+                        fpath = os.path.join(snap_dir, spec["file"])
+                        payload[key] = faults.retry_io(
+                            lambda p=fpath: np.load(p, allow_pickle=False),
+                            site="snapshot_io")
+                    else:
+                        payload[key] = spec["json"]
+        return state, manifest
+
+
+def _write_fsync(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class AsyncSnapshotWriter:
+    """FIFO snapshot writer on a daemon thread: `submit` enqueues an
+    already-packed state dict (host copies — the caller's consistency
+    point), the thread pays the fsync/rename latency. Errors are
+    remembered and re-raised at the next submit/drain so a dying disk
+    fails the run loudly instead of silently dropping snapshots."""
+
+    def __init__(self, store: SnapshotStore):
+        self.store = store
+        self._q: queue.Queue = queue.Queue()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="photon-ckpt-writer")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            state, seq, meta = item
+            try:
+                self.store.commit(state, seq, meta)
+            except BaseException as e:  # noqa: BLE001 — surfaced at submit
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _check(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, state: dict, seq: int,
+               meta: Optional[dict] = None) -> None:
+        self._check()
+        self._q.put((state, seq, meta))
+
+    def drain(self) -> None:
+        """Block until every queued snapshot is committed."""
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        self.drain()
+        self._q.put(None)
+        self._thread.join(timeout=10.0)

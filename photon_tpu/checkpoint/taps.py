@@ -1,0 +1,181 @@
+"""The resident-solver SNAPSHOT tap: opt-in last-iterate capture from
+inside the jitted solver loops — compiled OUT by default.
+
+The resident solvers (optim.lbfgs / owlqn / tron) are single XLA
+programs: there is no host boundary inside a `lax.while_loop` to cut a
+crash-consistent checkpoint at, so their elasticity story is a
+BEST-EFFORT last-iterate tap — `snapshot_tap(...)`, called beside
+`telemetry.taps.solver_tap` in each solver body, streams (it, w, f, |g|,
+aux) to the current `CheckpointSession` under ``resident/<solver>`` via
+`jax.debug.callback`, but ONLY in programs traced while a
+``CheckpointSession(resident_tap=True)`` is armed. A restored resident
+iterate is a WARM START for the re-run (for TRON, ``aux`` carries the
+trust radius so the re-run can re-enter at the same radius); bit-identical
+mid-solve resume is the host-loop regimes' guarantee
+(`optim/streamed.py`, `game/*` — see docs/ELASTICITY.md).
+
+Disarmed (the default), `snapshot_tap` is a pure-Python no-op: nothing
+enters the jaxpr, so every zero-transfer solver contract in the analysis
+registry stays intact. The two ContractSpecs below make that compiled-out
+guarantee law, exactly as `telemetry_off_is_free` does for the telemetry
+tap: one over the margin-cached L-BFGS (the GLM workhorse both taps now
+ride), one over the TRON margin solve (whose trust radius is state this
+tap alone captures). Arming/disarming transitions `jax.clear_caches()`
+for the same reason as the telemetry tap — the flag is not in jit's key.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["snapshot_tap", "snapshot_tap_enabled", "set_snapshot_tap",
+           "snapshot_tap_disabled", "resident_restore"]
+
+_TAP_ARMED = False
+
+
+def snapshot_tap_enabled() -> bool:
+    return _TAP_ARMED
+
+
+def set_snapshot_tap(on: bool) -> None:
+    """Arm/disarm the resident snapshot tap; a TRANSITION clears jit
+    caches so solver programs re-trace in the new mode."""
+    global _TAP_ARMED
+    on = bool(on)
+    if on == _TAP_ARMED:
+        return
+    _TAP_ARMED = on
+    import jax
+
+    jax.clear_caches()
+
+
+@contextlib.contextmanager
+def snapshot_tap_disabled():
+    """Trace-time scoping without the cache flush (same contract-builder
+    rationale as telemetry.taps.tap_disabled)."""
+    global _TAP_ARMED
+    was = _TAP_ARMED
+    _TAP_ARMED = False
+    try:
+        yield
+    finally:
+        _TAP_ARMED = was
+
+
+def _capture(solver: str, it, w, f, gnorm, aux):
+    """Host side of the callback: record the latest iterate into the
+    current session (absolute path — callbacks run outside scope
+    stacks). Values may be batched under vmap; stored as-is."""
+    from photon_tpu import checkpoint
+
+    sess = checkpoint.current()
+    if sess is None:
+        return
+    sess.update_absolute(f"resident/{solver}", {
+        "kind": "resident_iterate", "solver": solver,
+        "it": it, "w": w, "f": f, "gnorm": gnorm, "aux": aux})
+
+
+def snapshot_tap(solver: str, it, w, f, gnorm, aux=None) -> None:
+    """Per-iteration snapshot point for jitted solver bodies. No-op (and
+    absent from the jaxpr) unless armed at TRACE time."""
+    if not _TAP_ARMED:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    zero = jnp.zeros((), jnp.float32)
+    jax.debug.callback(
+        lambda i, wv, fv, g, a, _s=solver: _capture(_s, i, wv, fv, g, a),
+        it, w, f, gnorm, aux if aux is not None else zero)
+
+
+def resident_restore(solver: str):
+    """The last tapped iterate of ``solver`` from the current session's
+    restore image (``{"it", "w", "f", "gnorm", "aux"}``), or None — the
+    warm-start seed for a re-run after a mid-solve death."""
+    from photon_tpu import checkpoint
+
+    sess = checkpoint.current()
+    if sess is None:
+        return None
+    # absolute path, mirroring _capture
+    if sess._restored is None:
+        return None
+    with sess._lock:
+        return sess._restored.pop(f"resident/{solver}", None)
+
+
+# ----------------------------------------------------------------- contracts
+# The checkpoint-off guarantee as enforced law (registry 22 -> 24): both
+# taps (telemetry iteration + checkpoint snapshot) forced off at trace
+# time, the full solver program must contain zero callbacks/transfers and
+# zero collectives — i.e. never arming checkpointing (the default) costs
+# the jitted solvers nothing.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import TRANSFER_PRIMITIVES  # noqa: E402
+
+
+def _resident_problem():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.models.training import make_objective
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+    from photon_tpu.ops.losses import TaskType
+
+    rng = np.random.default_rng(2)
+    n, d = 48, 7
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.3, history=4)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d)
+    return cfg, obj, make_batch(X, y), jnp.zeros((d,), jnp.float32)
+
+
+@register_contract(
+    name="checkpoint_off_is_free",
+    description="resident margin-cached L-BFGS solve traced with the "
+                "checkpoint snapshot tap (and the telemetry tap) "
+                "disarmed: both taps compile OUT — zero callbacks, zero "
+                "transfers, zero collectives in the whole solver program",
+    collectives={}, forbid=TRANSFER_PRIMITIVES,
+    tags=("resident", "checkpoint"))
+def _contract_checkpoint_off_is_free():
+    from photon_tpu.optim.lbfgs import minimize_lbfgs_margin
+    from photon_tpu.telemetry.taps import tap_disabled
+
+    cfg, obj, batch, w0 = _resident_problem()
+
+    def fn(b, w, o):
+        with tap_disabled(), snapshot_tap_disabled():
+            return minimize_lbfgs_margin(o, b, w, max_iters=cfg.max_iters,
+                                         history=cfg.history)
+
+    return fn, (batch, w0, obj)
+
+
+@register_contract(
+    name="checkpoint_off_tron_free",
+    description="resident TRON margin solve traced with the snapshot tap "
+                "disarmed: the trust-radius capture is compiled OUT — "
+                "zero callbacks/transfers/collectives (TRON's only "
+                "checkpoint surface is this tap; it has no streamed "
+                "regime)",
+    collectives={}, forbid=TRANSFER_PRIMITIVES,
+    tags=("resident", "checkpoint"))
+def _contract_checkpoint_off_tron_free():
+    from photon_tpu.optim.tron import minimize_tron_margin
+    from photon_tpu.telemetry.taps import tap_disabled
+
+    cfg, obj, batch, w0 = _resident_problem()
+
+    def fn(b, w, o):
+        with tap_disabled(), snapshot_tap_disabled():
+            return minimize_tron_margin(o, b, w, max_iters=cfg.max_iters)
+
+    return fn, (batch, w0, obj)

@@ -332,6 +332,7 @@ class ChunkedBatch(NamedTuple):
         from collections import deque
 
         from photon_tpu import telemetry
+        from photon_tpu.checkpoint.faults import kill_point
 
         n = self.n_chunks
         if n == 0:
@@ -354,6 +355,10 @@ class ChunkedBatch(NamedTuple):
                 window.append(put(issued))
                 issued += 1
             cur = window.popleft()
+            # fault-injection site: a preemption mid-upload-stream (the
+            # checkpoint parity tests kill and resume here). Disarmed:
+            # one global load + one branch per chunk.
+            kill_point("chunk_upload")
             t0 = _time.perf_counter()
             jax.block_until_ready(cur)
             stall += _time.perf_counter() - t0

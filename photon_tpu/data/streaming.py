@@ -44,13 +44,24 @@ from photon_tpu.data.ingest import (
 from photon_tpu.game.dataset import GameData
 
 
+def _open_reader(p) -> AvroContainerReader:
+    """Open one Avro container with transient-IO retry/backoff
+    (checkpoint.faults.retry_io): a shared-filesystem hiccup at ingest
+    backs off and retries instead of killing an N-hour run. Mid-stream
+    read errors still propagate — a container cannot be safely resumed
+    mid-block, so the recovery unit is the (restartable) ingest pass."""
+    from photon_tpu.checkpoint.faults import retry_io
+
+    return retry_io(lambda: AvroContainerReader(p), site="avro_open")
+
+
 def scan_row_counts(path) -> list:
     """Per-file record counts from the container block HEADERS only — no
     payload decompression, no record decode. Cheap enough to run before
     streaming so device buffers can be preallocated exactly."""
     counts = []
     for p in avro_paths(path):
-        rd = AvroContainerReader(p)
+        rd = _open_reader(p)
         counts.append(sum(c for c, _ in rd.blocks(skip_payload=True)))
     return counts
 
@@ -122,7 +133,7 @@ def _build_index_maps_streaming(path, config: GameDataConfig, index_maps,
     building = {s: IndexMap() for s in todo}
     bag_names = sorted({b for cfg in todo.values() for b in cfg.bags})
     for p in avro_paths(path):
-        for rec in AvroContainerReader(p):
+        for rec in _open_reader(p):
             norm = {b: normalize_bag(rec.get(b)) for b in bag_names}
             for s, cfg in todo.items():
                 imap = building[s]
@@ -148,7 +159,7 @@ def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
     paths = avro_paths(path)
     if not paths:
         return None
-    readers = [AvroContainerReader(p) for p in paths]
+    readers = [_open_reader(p) for p in paths]
     plan0 = compile_plan(readers[0].schema, config)
     if plan0 is None:
         return None
@@ -335,7 +346,7 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
         return data
 
     for p in avro_paths(path):
-        rd = AvroContainerReader(p)
+        rd = _open_reader(p)
         for count, payload in rd.blocks():
             b = io.BytesIO(payload)
             buf.extend(read_datum(b, rd.schema) for _ in range(count))
@@ -355,7 +366,7 @@ def _native_chunks(path, stream: ChunkStream):
     paths = avro_paths(path)
     if not paths:
         return None
-    readers = [AvroContainerReader(p) for p in paths]
+    readers = [_open_reader(p) for p in paths]
     config = stream.config
     plan0 = compile_plan(readers[0].schema, config)
     if plan0 is None:

@@ -215,6 +215,22 @@ class TrainingParams:
     # fresh process skips most of its XLA compiles (the reference's JVM
     # pays startup once per application; measured in docs/PERF.md).
     compilation_cache_dir: Optional[str] = None
+    # Crash-consistent checkpoint/restore (photon_tpu/checkpoint;
+    # docs/ELASTICITY.md). A directory (relative → under output_dir)
+    # enables periodic snapshots of FULL solver state — streamed
+    # L-BFGS/OWL-QN iterate + curvature history + margin caches, GAME
+    # coordinate/bucket progress — committed atomically (temp + fsync +
+    # rename manifest). A killed run rerun with the same config and
+    # checkpoint_resume=True restores the last committed snapshot and
+    # finishes bit-identically (same mesh topology). Distinct from the
+    # grid-point `resume` above: that recovers whole finished grid
+    # points from models/; this resumes INSIDE a point's solves.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_s: Optional[float] = 30.0  # wall-clock cadence
+    checkpoint_every_evals: Optional[int] = None  # evaluation cadence
+    checkpoint_keep: int = 2  # snapshot retention (older dirs GC'd)
+    checkpoint_resume: bool = True  # restore a committed snapshot if any
+    checkpoint_async: bool = True  # commit on the writer thread
 
     def __post_init__(self):
         if self.output_mode.upper() not in ("BEST", "ALL"):
@@ -522,20 +538,52 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         vectorized_grid=params.vectorized_grid,
     )
 
-    n_resumed = 0
-    with timers("train"):
-        if params.tuning_iters > 0:
-            results = _tune(estimator, params, data, validation, log,
-                            initial_models)
-        elif params.resume:
-            results, n_resumed = _fit_grid_resumable(
-                estimator, params, data, validation, initial_models,
-                index_maps, log, streaming, streamed_obj)
+    ckpt_active = False
+    if params.checkpoint_dir:
+        from photon_tpu import checkpoint as ckpt_mod
+
+        ckpt_dir = params.checkpoint_dir
+        if not os.path.isabs(ckpt_dir):
+            ckpt_dir = os.path.join(params.output_dir, ckpt_dir)
+        sess = ckpt_mod.start_session(
+            ckpt_dir, every_s=params.checkpoint_every_s,
+            every_evals=params.checkpoint_every_evals,
+            keep=params.checkpoint_keep,
+            resume=params.checkpoint_resume,
+            async_writer=params.checkpoint_async)
+        ckpt_active = True
+        if sess.restored_any():
+            log.info("checkpoint/restore: resuming training from the "
+                     "last committed snapshot in %s", ckpt_dir)
         else:
-            results = estimator.fit(
-                data, validation=validation,
-                config_grid=_config_grid(params.coordinates),
-                initial_models=initial_models)
+            log.info("checkpoint/restore: snapshotting to %s "
+                     "(every_s=%s, every_evals=%s, keep=%d)", ckpt_dir,
+                     params.checkpoint_every_s,
+                     params.checkpoint_every_evals, params.checkpoint_keep)
+
+    n_resumed = 0
+    try:
+        with timers("train"):
+            if params.tuning_iters > 0:
+                results = _tune(estimator, params, data, validation, log,
+                                initial_models)
+            elif params.resume:
+                results, n_resumed = _fit_grid_resumable(
+                    estimator, params, data, validation, initial_models,
+                    index_maps, log, streaming, streamed_obj)
+            else:
+                results = estimator.fit(
+                    data, validation=validation,
+                    config_grid=_config_grid(params.coordinates),
+                    initial_models=initial_models)
+    finally:
+        if ckpt_active:
+            from photon_tpu import checkpoint as ckpt_mod
+
+            # drain the async writer either way: on success the state is
+            # complete (a rerun restores it and skips straight to save);
+            # on a crash the last committed snapshot is the resume point
+            ckpt_mod.finish_session()
     telemetry.sample_device_memory("post_train")
     best = estimator.best_model(results)
     if best.validation_score is not None:
@@ -1071,9 +1119,25 @@ def main(argv=None) -> None:
 
     p = argparse.ArgumentParser(description="photon-tpu GAME training driver")
     p.add_argument("--config", required=True, help="JSON TrainingParams file")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable crash-consistent snapshots in this "
+                        "directory (overrides the config's "
+                        "checkpoint_dir; relative paths land under "
+                        "output_dir)")
+    p.add_argument("--resume", dest="ckpt_resume", action="store_true",
+                   default=None,
+                   help="restore the last committed snapshot in "
+                        "--checkpoint-dir before training (the default "
+                        "when one exists)")
+    p.add_argument("--no-resume", dest="ckpt_resume", action="store_false",
+                   help="ignore any existing snapshot and start fresh")
     args = p.parse_args(argv)
     with open(args.config) as f:
         params = TrainingParams(**json.load(f))
+    if args.checkpoint_dir is not None:
+        params.checkpoint_dir = args.checkpoint_dir
+    if args.ckpt_resume is not None:
+        params.checkpoint_resume = args.ckpt_resume
     out = run_training(params)
     print(json.dumps({
         "model_dir": out.model_dir,

@@ -14,13 +14,16 @@ objective tracking.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import hashlib
 from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from photon_tpu import checkpoint as _ckpt
 from photon_tpu import telemetry
 from photon_tpu.game.fixed_effect import FixedEffectCoordinate
 from photon_tpu.game.model import GameModel
@@ -58,6 +61,105 @@ from photon_tpu.game.scoring import _sum_scores  # noqa: E402
 def _objective_at(task, y, weights, offsets, score):
     loss, _, _ = loss_fns(task)
     return jnp.sum(weights * loss(offsets + score, y))
+
+
+# ------------------------------------------------- checkpoint (de)hydration
+# The descent loop's crash-consistency cut is "coordinate updates 0..k
+# complete": the progress payload carries every updated coordinate's model
+# arrays + its SCORES (stored, not recomputed, so a resumed run's
+# downstream low bits match the uninterrupted run's exactly), the
+# objective history, and compact per-update stats. A live random-effect
+# update additionally checkpoints bucket-level state under its own
+# ``u<k>/re`` scope (game/random_effect.py).
+
+
+def _descent_fingerprint(coordinates, update_sequence, n_sweeps, locked,
+                         task, n_rows) -> str:
+    """Stable identity of one descent invocation: restored state is only
+    accepted by a loop solving the SAME problem (grid points with
+    different reg weights hash apart)."""
+    parts = []
+    for name in update_sequence:
+        c = coordinates[name]
+        cfg = c.config
+        parts.append((
+            name, type(c).__name__, cfg.effective_optimizer().value,
+            cfg.max_iters,
+            cfg.tolerance, cfg.history, cfg.cg_max_iters,
+            cfg.reg.reg_type.value, cfg.reg.alpha, float(cfg.reg_weight),
+            cfg.regularize_intercept,
+            getattr(c, "pipeline_depth", None),
+            getattr(c, "straggler_budget", None),
+        ))
+    ident = repr((task.name, n_sweeps, tuple(update_sequence),
+                  tuple(sorted(locked)), int(n_rows), parts))
+    return hashlib.sha1(ident.encode()).hexdigest()[:12]
+
+
+def _model_from_progress(progress, name, kind, coord, task):
+    from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+    var = progress.get(f"m.{name}.var")
+    var = jnp.asarray(var) if var is not None else None
+    if kind == "fixed":
+        return FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(jnp.asarray(progress[f"m.{name}.w"]), var),
+                task),
+            coord.dataset.shard_name)
+    ds = coord.dataset
+    return RandomEffectModel(
+        entity_name=ds.entity_name, feature_shard=ds.shard_name, task=task,
+        coefficients=jnp.asarray(progress[f"m.{name}.coeffs"]),
+        entity_keys=ds.entity_keys, key_to_index=ds.key_to_index,
+        variances=var)
+
+
+def _stats_from_entry(entry, models):
+    """Rehydrate a per-update stats record. Resumed stats carry the
+    SCALARS (value/grad-norm/iteration/convergence); per-iteration
+    histories died with the original process and come back as NaN."""
+    from photon_tpu.game.random_effect import RETrainStats
+    from photon_tpu.optim.tracker import OptResult
+
+    if entry["kind"] == "re":
+        return RETrainStats(int(entry["E"]), int(entry["c"]),
+                            int(entry["f"]), int(entry["it"]))
+    nan = jnp.full((1,), jnp.nan, jnp.float32)
+    return OptResult(
+        w=jnp.asarray(models[entry["name"]].model.coefficients.means),
+        value=jnp.asarray(jnp.float32(entry["value"])),
+        grad_norm=jnp.asarray(jnp.float32(entry["grad_norm"])),
+        iterations=jnp.asarray(jnp.int32(entry["iterations"])),
+        converged=jnp.asarray(bool(entry["converged"])),
+        failed=jnp.asarray(bool(entry["failed"])),
+        loss_history=nan, grad_norm_history=nan)
+
+
+def _progress_payload(updated, models, scores, objective_history,
+                      stats_entries, n_done) -> dict:
+    import numpy as np
+
+    from photon_tpu.game.model import FixedEffectModel
+
+    payload = {"kind": "descent_progress", "n_done": int(n_done),
+               "objective": [float(v) for v in objective_history],
+               "stats": list(stats_entries),
+               "updated": dict(updated)}
+    for name, kind in updated.items():
+        m = models[name]
+        if isinstance(m, FixedEffectModel):
+            payload[f"m.{name}.w"] = np.asarray(m.model.coefficients.means)
+            if m.model.coefficients.variances is not None:
+                payload[f"m.{name}.var"] = np.asarray(
+                    m.model.coefficients.variances)
+        else:
+            payload[f"m.{name}.coeffs"] = np.asarray(m.coefficients)
+            if m.variances is not None:
+                payload[f"m.{name}.var"] = np.asarray(m.variances)
+        payload[f"s.{name}"] = np.asarray(scores[name])
+    return payload
 
 
 @partial(jax.jit, static_argnames=("config", "task", "variance"))
@@ -172,85 +274,186 @@ def coordinate_descent(
         make_objective,
     )
 
+    from photon_tpu.game.random_effect import RETrainStats
+
+    ck = _ckpt.current()
+    cd_scope = contextlib.nullcontext()
+    if ck is not None:
+        fp = _descent_fingerprint(coordinates, update_sequence, n_sweeps,
+                                  locked, task, int(y.shape[0]))
+        cd_scope = ck.scope(f"game-{fp}-{ck.invocation(fp)}")
+
     deferred_re: list = []  # (stats-list index slot fillers for fused REs)
     update_log: list = []  # (sweep, coordinate) per objective_history entry
-    for sweep in range(n_sweeps):
-        telemetry.count("game.sweeps")
-        for name in update_sequence:
-            if name in locked:
-                continue
-            update_log.append((sweep, name))
-            telemetry.count("game.coordinate_updates")
-            coord = coordinates[name]
-            warm = models.get(name)
-            prior = priors.get(name)
-            others = tuple(s for o, s in scores.items() if o != name)
+    done_updates = 0
+    stats_entries: list = []
+    updated: dict = {}  # coordinate name -> "fixed" | "re", updated so far
+    with cd_scope:
+        progress = ck.restore("progress") if ck is not None else None
+        if progress is not None:
+            import numpy as np
 
-            if (isinstance(coord, FixedEffectCoordinate)
-                    and _fixed_fusable(coord, prior)):
-                ds = coord.dataset
-                w0 = jnp.zeros((ds.dim,), jnp.float32)
-                if warm is not None and \
-                        warm.model.weights.shape[0] == ds.dim:
-                    w0 = jnp.asarray(warm.model.weights)
-                batch = GLMBatch(ds.X, ds.y, ds.weights, base)
-                obj = make_objective(task, coord.config, ds.dim)
-                res, var, margin, objective = _fused_fixed_update(
-                    batch, base, others, w0, obj, _l1_lam(coord.config),
-                    y, weights, _static_config(coord.config), task,
-                    coord.variance)
-                models[name] = FixedEffectModel(
-                    GeneralizedLinearModel(Coefficients(res.w, var), task),
-                    ds.shard_name)
-                scores[name] = margin
-                coordinate_stats[name].append(res)
-                objective_history.append(objective)
-                continue
+            done_updates = int(progress["n_done"])
+            objective_history = [float(v) for v in progress["objective"]]
+            stats_entries = list(progress["stats"])
+            updated = dict(progress["updated"])
+            for name, kind in updated.items():
+                models[name] = _model_from_progress(progress, name, kind,
+                                                    coordinates[name], task)
+                scores[name] = jnp.asarray(
+                    np.asarray(progress[f"s.{name}"]))
+            for e in stats_entries:
+                coordinate_stats[e["name"]].append(
+                    _stats_from_entry(e, models))
+            telemetry.count("checkpoint.descent_restores")
 
-            # fused_update_program gates itself: it returns None for mesh /
-            # projection / normalization / straggler-budget coordinates,
-            # which then train on the pipelined block loop below.
-            fused = (coord.fused_update_program()
-                     if isinstance(coord, RandomEffectCoordinate)
-                     and prior is None else None)
-            if fused is not None:
-                fn, blocks_args, obj, lam = fused
-                ds = coord.dataset
-                E, d = ds.n_entities, ds.dim
-                coeffs0 = (jnp.asarray(warm.coefficients)
-                           if warm is not None
-                           and warm.coefficients.shape == (E, d)
-                           else jnp.zeros((E, d), jnp.float32))
-                coeffs, variances, margin, objective, st = fn(
-                    coeffs0, base, others, obj, lam, blocks_args, ds.X,
-                    jnp.asarray(ds.entity_dense), y, weights)
-                models[name] = RandomEffectModel(
-                    entity_name=ds.entity_name,
-                    feature_shard=ds.shard_name,
-                    task=task,
-                    coefficients=coeffs,
-                    entity_keys=ds.entity_keys,
-                    key_to_index=ds.key_to_index,
-                    variances=variances,
-                )
-                scores[name] = margin
-                # device scalars; finalized into RETrainStats below
-                slot = len(coordinate_stats[name])
-                coordinate_stats[name].append(None)
-                deferred_re.append((name, slot, E, st))
-                objective_history.append(objective)
-                continue
+        upd = -1
+        for sweep in range(n_sweeps):
+            telemetry.count("game.sweeps")
+            for name in update_sequence:
+                if name in locked:
+                    continue
+                upd += 1
+                update_log.append((sweep, name))
+                if upd < done_updates:
+                    continue  # restored from the checkpoint image above
+                telemetry.count("game.coordinate_updates")
+                coord = coordinates[name]
+                warm = models.get(name)
+                prior = priors.get(name)
+                others = tuple(s for o, s in scores.items() if o != name)
+                # per-update sub-scope: a live random-effect update's
+                # bucket-level state lands under u<k>/re and is dropped
+                # the moment the update completes
+                u_scope = (ck.scope(f"u{upd}") if ck is not None
+                           else contextlib.nullcontext())
+                stat_entry: Optional[dict] = None
+                with u_scope:
+                    if (isinstance(coord, FixedEffectCoordinate)
+                            and _fixed_fusable(coord, prior)):
+                        ds = coord.dataset
+                        w0 = jnp.zeros((ds.dim,), jnp.float32)
+                        if warm is not None and \
+                                warm.model.weights.shape[0] == ds.dim:
+                            w0 = jnp.asarray(warm.model.weights)
+                        batch = GLMBatch(ds.X, ds.y, ds.weights, base)
+                        obj = make_objective(task, coord.config, ds.dim)
+                        res, var, margin, objective = _fused_fixed_update(
+                            batch, base, others, w0, obj,
+                            _l1_lam(coord.config), y, weights,
+                            _static_config(coord.config), task,
+                            coord.variance)
+                        models[name] = FixedEffectModel(
+                            GeneralizedLinearModel(
+                                Coefficients(res.w, var), task),
+                            ds.shard_name)
+                        scores[name] = margin
+                        coordinate_stats[name].append(res)
+                        objective_history.append(objective)
+                        if ck is not None:
+                            stat_entry = {
+                                "name": name, "kind": "fixed",
+                                "value": float(res.value),
+                                "grad_norm": float(res.grad_norm),
+                                "iterations": int(res.iterations),
+                                "converged": bool(res.converged),
+                                "failed": bool(res.failed)}
+                    else:
+                        # fused_update_program gates itself: it returns
+                        # None for mesh / projection / normalization /
+                        # straggler-budget coordinates, which then train
+                        # on the pipelined block loop below.
+                        fused = (coord.fused_update_program()
+                                 if isinstance(coord, RandomEffectCoordinate)
+                                 and prior is None else None)
+                        if fused is not None:
+                            fn, blocks_args, obj, lam = fused
+                            ds = coord.dataset
+                            E, d = ds.n_entities, ds.dim
+                            coeffs0 = (jnp.asarray(warm.coefficients)
+                                       if warm is not None
+                                       and warm.coefficients.shape == (E, d)
+                                       else jnp.zeros((E, d), jnp.float32))
+                            coeffs, variances, margin, objective, st = fn(
+                                coeffs0, base, others, obj, lam,
+                                blocks_args, ds.X,
+                                jnp.asarray(ds.entity_dense), y, weights)
+                            models[name] = RandomEffectModel(
+                                entity_name=ds.entity_name,
+                                feature_shard=ds.shard_name,
+                                task=task,
+                                coefficients=coeffs,
+                                entity_keys=ds.entity_keys,
+                                key_to_index=ds.key_to_index,
+                                variances=variances,
+                            )
+                            scores[name] = margin
+                            if ck is None:
+                                # device scalars; finalized into
+                                # RETrainStats below
+                                slot = len(coordinate_stats[name])
+                                coordinate_stats[name].append(None)
+                                deferred_re.append((name, slot, E, st))
+                            else:
+                                # checkpointing forces the stats now —
+                                # the progress payload needs host values
+                                c_, f_, it_ = (int(v) for v in
+                                               jax.device_get(st))
+                                coordinate_stats[name].append(
+                                    RETrainStats(E, c_, f_, it_))
+                                stat_entry = {"name": name, "kind": "re",
+                                              "E": E, "c": c_, "f": f_,
+                                              "it": it_}
+                            objective_history.append(objective)
+                        else:
+                            offsets_full = _sum_scores(base, others)
+                            model, stats = coord.train(offsets_full,
+                                                       warm_start=warm,
+                                                       prior=prior)
+                            models[name] = model
+                            scores[name] = coord.score(model)
+                            coordinate_stats[name].append(stats)
+                            # device scalar now; host conversion is
+                            # deferred below so the descent loop never
+                            # blocks on a readback mid-sweep
+                            objective_history.append(
+                                _objective_at(task, y, weights,
+                                              offsets_full, scores[name]))
+                            if ck is not None:
+                                if isinstance(stats, RETrainStats):
+                                    stat_entry = {
+                                        "name": name, "kind": "re",
+                                        "E": stats.n_entities,
+                                        "c": stats.n_converged,
+                                        "f": stats.n_failed,
+                                        "it": stats.total_iterations}
+                                else:
+                                    stat_entry = {
+                                        "name": name, "kind": "fixed",
+                                        "value": float(stats.value),
+                                        "grad_norm": float(stats.grad_norm),
+                                        "iterations": int(stats.iterations),
+                                        "converged": bool(stats.converged),
+                                        "failed": bool(stats.failed)}
+                if ck is not None:
+                    # the update is complete: drop its sub-scope state,
+                    # force its objective to host, and publish the
+                    # progress cut (updates 0..upd done)
+                    ck.clear(f"u{upd}", prefix=True)
+                    objective_history[-1] = float(
+                        jax.device_get(objective_history[-1]))
+                    stats_entries.append(stat_entry)
+                    from photon_tpu.game.model import (
+                        FixedEffectModel as _FEM,
+                    )
 
-            offsets_full = _sum_scores(base, others)
-            model, stats = coord.train(offsets_full, warm_start=warm,
-                                       prior=prior)
-            models[name] = model
-            scores[name] = coord.score(model)
-            coordinate_stats[name].append(stats)
-            # device scalar now; host conversion is deferred below so the
-            # descent loop never blocks on a readback mid-sweep
-            objective_history.append(
-                _objective_at(task, y, weights, offsets_full, scores[name]))
+                    updated[name] = ("fixed" if isinstance(models[name],
+                                                           _FEM) else "re")
+                    ck.update("progress", _progress_payload(
+                        updated, models, scores, objective_history,
+                        stats_entries, upd + 1))
+                    ck.note_evaluations()
+                    ck.maybe_snapshot()
 
     # one concurrent device_get for every deferred scalar (a float() per
     # entry would pay one tunnel round-trip each)

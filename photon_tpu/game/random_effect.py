@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from photon_tpu import checkpoint as _ckpt
 from photon_tpu import telemetry
 from photon_tpu.data.matrix import next_pow2
 from photon_tpu.game.dataset import RandomEffectDataset, REBlock
@@ -392,6 +393,38 @@ class RandomEffectCoordinate:
                   dataclasses.replace(_static_config(self.config),
                                       max_iters=budget))
 
+        # ---- checkpoint/restore: buckets partition the entity set and
+        # retire in dispatch order, so "buckets 0..k retired" is a
+        # consistent cut — the snapshot is the live coefficient array (in
+        # SOLVE space) + the per-entity trackers + the retire cursor. The
+        # in-flight ledger is NOT snapshotted: un-retired buckets simply
+        # re-dispatch on resume, bit-identically (their warm-start rows
+        # are untouched by other buckets).
+        ck = _ckpt.current()
+        st = ck.restore("re") if ck is not None else None
+        n_blocks = len(ds.blocks)
+        start_block = 0
+        if st is not None:
+            from photon_tpu.checkpoint import SnapshotStateError
+
+            got = (st.get("kind"), int(st.get("E", -1)),
+                   int(st.get("d", -1)), int(st.get("n_blocks", -1)),
+                   bool(st.get("has_var", False)))
+            want = ("re_train", E, d, n_blocks, variances is not None)
+            if got != want:
+                raise SnapshotStateError(
+                    f"random-effect snapshot does not fit this coordinate:"
+                    f" snapshot (kind, E, d, n_blocks, has_var)={got} vs "
+                    f"resuming train() {want}")
+            coeffs = np.array(st["coeffs"], np.float32)
+            if variances is not None:
+                variances = np.array(st["variances"], np.float32)
+            iters_per_entity = np.array(st["iters"], np.int64)
+            n_conv, n_fail = int(st["n_conv"]), int(st["n_fail"])
+            start_block = int(st["blocks_done"])
+            telemetry.count("checkpoint.re_restores")
+        retired = start_block
+
         def dispatch(block: REBlock) -> _InFlight:
             """Pipeline stage 1: host prep + non-blocking upload + solve
             dispatch for one bucket. Nothing here waits on the device."""
@@ -444,7 +477,11 @@ class RandomEffectCoordinate:
             """Pipeline stage 2: force the OLDEST in-flight bucket's outputs
             to host and scatter/project them back — while any younger
             bucket's solve still runs on device."""
-            nonlocal n_conv, n_fail
+            nonlocal n_conv, n_fail, retired
+            # fault-injection site: a preemption at bucket retirement
+            # loses this bucket's (unscattered) results; resume
+            # re-dispatches from the last retired cursor.
+            _ckpt.kill_point("bucket_retire")
             block, e_real = fl.block, fl.e_real
             t0 = time.perf_counter_ns()
             with telemetry.span("game_re.readback", m=block.m):
@@ -486,20 +523,39 @@ class RandomEffectCoordinate:
             n_conv += int(conv[:e_real].sum())
             n_fail += int(fail[:e_real].sum())
             iters_per_entity[block.entity_index] = iters[:e_real]
+            retired += 1
+            if ck is not None:
+                payload = {
+                    "kind": "re_train", "E": E, "d": d,
+                    "n_blocks": n_blocks,
+                    "has_var": variances is not None,
+                    "coeffs": coeffs, "iters": iters_per_entity,
+                    "n_conv": n_conv, "n_fail": n_fail,
+                    "blocks_done": retired}
+                if variances is not None:
+                    payload["variances"] = variances
+                ck.update("re", payload)
+                ck.note_evaluations()
+                ck.maybe_snapshot()
 
         # The pipeline: dispatch runs ahead of retire by up to
         # `pipeline_depth` buckets. Buckets partition the entity set, so
         # dispatch(k+1)'s warm-start gather never reads rows retire(k)
-        # writes — any depth is bit-identical to depth 0.
+        # writes — any depth is bit-identical to depth 0. A resumed run
+        # skips the already-retired prefix of the bucket sequence.
         pending: deque = deque()
         depth = max(int(self.pipeline_depth), 0)
-        for block in ds.blocks:
+        for bi, block in enumerate(ds.blocks):
+            if bi < start_block:
+                continue
             pending.append(dispatch(block))
             telemetry.gauge("game_re.blocks_in_flight", len(pending))
             while len(pending) > depth:
                 retire(pending.popleft())
         while pending:
             retire(pending.popleft())
+        if ck is not None:
+            ck.clear("re")
         total_iters = int(iters_per_entity.sum())
         if norm is not None:
             coeffs = norm.rows_to_original_space(coeffs)

@@ -22,6 +22,9 @@ from photon_tpu.optim.tracker import OptResult
 # no-op (absent from the jaxpr) unless a Run(resident_tap=True) is
 # attached at trace time — the telemetry_off_is_free contract pins that.
 from photon_tpu.telemetry.taps import solver_tap
+# Opt-in resident last-iterate checkpoint tap: same compiled-out-by-
+# default story (the checkpoint_off_is_free contract pins it).
+from photon_tpu.checkpoint.taps import snapshot_tap
 
 
 class _State(NamedTuple):
@@ -168,6 +171,7 @@ def minimize_lbfgs(
                                  tolerance, dtype)
         it = s.it + 1
         solver_tap("lbfgs", it, f_new, gnorm, jnp.where(ok, alpha, 0.0))
+        snapshot_tap("lbfgs", it, w_new, f_new, gnorm)
         return _State(
             w=w_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho, sy=sy, yy=yy,
             idx=idx, count=count, it=it, done=converged | ~ok,
@@ -314,6 +318,7 @@ def minimize_lbfgs_margin(
         it = s.it + 1
         solver_tap("lbfgs_margin", it, f_new, gnorm,
                    jnp.where(ok, alpha, 0.0))
+        snapshot_tap("lbfgs_margin", it, w_new, f_new, gnorm)
         return _MarginState(
             w=w_new, z=z_new, f=f_new, g=g_new, S=S, Y=Y, rho=rho,
             sy=sy, yy=yy, idx=idx,

@@ -26,6 +26,7 @@ from photon_tpu.optim.tracker import OptResult
 # Opt-in in-loop iteration telemetry; compiled out by default (see
 # optim/lbfgs.py and the telemetry_off_is_free contract).
 from photon_tpu.telemetry.taps import solver_tap
+from photon_tpu.checkpoint.taps import snapshot_tap
 
 
 def pseudo_gradient(w, g, l1, mask):
@@ -166,6 +167,7 @@ def minimize_owlqn(
         converged = grad_conv | f_conv | precision_limited
         it = s.it + 1
         solver_tap("owlqn", it, F_new, pgnorm, jnp.where(ok, ls.a, 0.0))
+        snapshot_tap("owlqn", it, w_new, F_new, pgnorm)
         return _State(
             w=w_new, f=f_new, F=F_new, g=g_new, S=S, Y=Y, rho=rho,
             sy=sy, yy=yy, idx=idx,

@@ -67,6 +67,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from photon_tpu import checkpoint as _ckpt
 from photon_tpu import telemetry
 from photon_tpu.data.dataset import GLMBatch
 from photon_tpu.data.matrix import SparseRows
@@ -561,6 +562,85 @@ def _convergence_host(ok, f_old, f_new, gnorm, g0norm, dphi0,
     return grad_conv or f_conv or precision_limited
 
 
+def _eval_tick(ck, n: int = 1) -> None:
+    """One objective evaluation closed: a fault-injection site (the
+    streamed regime's 'evaluation' kill point) + checkpoint cadence
+    accounting. Session-less cost: one global load and one branch."""
+    _ckpt.kill_point("evaluation")
+    if ck is not None:
+        ck.note_evaluations(n)
+
+
+# ------------------------------------------------- checkpoint (de)hydration
+# The streamed solvers are HOST loops, so their full state is host-visible
+# at every iteration boundary — the crash-consistency cut. Snapshots are
+# exact: every f32 array round-trips bit-identically through the .npy
+# store, so a restored run replays the remaining iterations bit-identically
+# on the same topology (tests/test_checkpoint.py pins this per fault site).
+
+
+def _pack_stream_state(kind, d, n_chunks, chunk_rows, max_iters, it, f,
+                       g0norm, hist, ghist, converged, failed, done, w, g,
+                       hist_st, extra=None) -> dict:
+    st = {
+        "kind": kind, "d": int(d), "n_chunks": int(n_chunks),
+        "chunk_rows": int(chunk_rows), "max_iters": int(max_iters),
+        "it": int(it), "f": float(f), "g0norm": float(g0norm),
+        "hist": np.asarray(hist), "ghist": np.asarray(ghist),
+        "converged": bool(converged), "failed": bool(failed),
+        "done": bool(done), "w": w, "g": g,
+        "S": hist_st.S, "Y": hist_st.Y, "rho": hist_st.rho,
+        "h_idx": int(hist_st.idx), "h_count": int(hist_st.count),
+        "h_sy": float(hist_st.sy), "h_yy": float(hist_st.yy),
+    }
+    if extra:
+        st.update(extra)
+    return st
+
+
+def _validate_stream_state(st: dict, kind: str, d: int, n_chunks: int,
+                           chunk_rows: int, max_iters: int) -> None:
+    from photon_tpu.checkpoint import SnapshotStateError
+
+    got = (st.get("kind"), int(st.get("d", -1)), int(st.get("n_chunks", -1)),
+           int(st.get("chunk_rows", -1)), int(st.get("max_iters", -1)))
+    want = (kind, d, n_chunks, chunk_rows, max_iters)
+    if got != want:
+        raise SnapshotStateError(
+            f"streamed-solver snapshot does not fit this solve: snapshot "
+            f"(kind, d, n_chunks, chunk_rows, max_iters)={got} vs resuming "
+            f"program {want}. Resume must re-run the same problem with the "
+            "same chunking and iteration budget (the mesh shape MAY "
+            "differ; margin caches re-shard).")
+
+
+def _restore_history(st: dict, history: int, d: int) -> _History:
+    hs = _History(history, d)
+    S, Y, rho = (np.asarray(st["S"]), np.asarray(st["Y"]),
+                 np.asarray(st["rho"]))
+    if S.shape != (history, d):
+        from photon_tpu.checkpoint import SnapshotStateError
+
+        raise SnapshotStateError(
+            f"curvature history shape {S.shape} in snapshot vs "
+            f"({history}, {d}) in the resuming solve")
+    hs.S, hs.Y, hs.rho = jnp.asarray(S), jnp.asarray(Y), jnp.asarray(rho)
+    hs.idx, hs.count = int(st["h_idx"]), int(st["h_count"])
+    hs.sy, hs.yy = float(st["h_sy"]), float(st["h_yy"])
+    return hs
+
+
+def _restore_z_cache(st: dict, data, mesh) -> list:
+    """Per-chunk cached margins out of a snapshot, re-laid for the
+    CURRENT backend: canonical global rows -> single-device flat chunks or
+    the mesh's local-slot stacks (a mesh-8 snapshot restores onto mesh-4
+    or one chip; pad rows carry weight 0, so re-padding is exact)."""
+    pad = (data.mesh_chunk_rows(mesh) if mesh is not None
+           else data.chunk_rows)
+    return [_ckpt.unpack_rows(np.asarray(st[f"z{i}"]), mesh, pad)
+            for i in range(data.n_chunks)]
+
+
 def _result(w, value, gnorm, it, converged, failed, hist, ghist) -> OptResult:
     return OptResult(
         w=w, value=jnp.asarray(np.float32(value)),
@@ -602,41 +682,88 @@ def minimize_lbfgs_streamed(
                                history, max_ls_evals, mesh, prefetch)
 
 
+def _pack_lbfgs_state(d, n_chunks, data, mesh, max_iters, it, f, g0norm,
+                      hist, ghist, converged, failed, done, w, g, hist_st,
+                      z_cache, z_gen) -> dict:
+    extra = {f"z{i}": _ckpt.pack_rows(z_cache[i], mesh, data.chunk_rows)
+             for i in range(n_chunks)}
+    extra["z_gen"] = int(z_gen)
+    return _pack_stream_state("lbfgs_streamed", d, n_chunks,
+                              data.chunk_rows, max_iters, it, f, g0norm,
+                              hist, ghist, converged, failed, done, w, g,
+                              hist_st, extra)
+
+
 def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
                     max_ls_evals, mesh, prefetch) -> OptResult:
     _check_streamable(obj, mesh)
     be = _backend(data, mesh, prefetch)
-    w = jnp.asarray(w0, jnp.float32)
-    if mesh is not None:
-        from photon_tpu.parallel.mesh import replicated
-
-        # solver state lives mesh-replicated so every derived array shares
-        # one device assignment (mixing mesh- and single-device-committed
-        # operands is an error in eager ops)
-        w = jax.device_put(w, replicated(mesh))
-    d = w.shape[0]
-    hist_st = _History(history, d)
     n_chunks = data.n_chunks
+    d = int(jnp.asarray(w0).shape[0])
+    ck = _ckpt.current()
+    st = ck.restore("lbfgs_streamed") if ck is not None else None
+    z_gen = 0
+    if st is not None:
+        # ---- resume: the full iteration-boundary state rehydrates and
+        # the initial pass is skipped (margins come from the snapshot).
+        _validate_stream_state(st, "lbfgs_streamed", d, n_chunks,
+                               data.chunk_rows, max_iters)
+        w = jnp.asarray(np.asarray(st["w"]), jnp.float32)
+        g = jnp.asarray(np.asarray(st["g"]), jnp.float32)
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import replicated
 
-    # ---- initial pass: margins cached per chunk, (f, g) accumulated
-    z_cache: list = [None] * n_chunks
-    acc = None
-    for i, b in be.iter_chunks():
-        z_cache[i], parts = be.chunk_init(obj, w, b)
-        acc = parts if acc is None else _acc(acc, parts)
-    f_dev, g = be.finish(obj, w, acc)
-    f = float(f_dev)
-    g0norm = float(jnp.linalg.norm(g))
-    telemetry.count("solver.feature_streams")
-    telemetry.count("solver.evaluations")
-    telemetry.iteration("lbfgs_streamed", 0, f, grad_norm=g0norm)
+            w = jax.device_put(w, replicated(mesh))
+            g = jax.device_put(g, replicated(mesh))
+        hist_st = _restore_history(st, history, d)
+        z_cache = _restore_z_cache(st, data, mesh)
+        f, g0norm = float(st["f"]), float(st["g0norm"])
+        hist = np.array(st["hist"], np.float32)
+        ghist = np.array(st["ghist"], np.float32)
+        it = int(st["it"])
+        converged, failed = bool(st["converged"]), bool(st["failed"])
+        done = bool(st["done"])
+        z_gen = int(st.get("z_gen", 0))
+        telemetry.count("checkpoint.solver_restores")
+    else:
+        w = jnp.asarray(w0, jnp.float32)
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import replicated
 
-    hist = np.full(max_iters + 1, np.nan, np.float32)
-    ghist = np.full(max_iters + 1, np.nan, np.float32)
-    hist[0], ghist[0] = f, g0norm
+            # solver state lives mesh-replicated so every derived array
+            # shares one device assignment (mixing mesh- and single-
+            # device-committed operands is an error in eager ops)
+            w = jax.device_put(w, replicated(mesh))
 
-    it, converged, failed = 0, g0norm <= 1e-14, False
-    done = converged
+        hist_st = _History(history, d)
+
+        # ---- initial pass: margins cached per chunk, (f, g) accumulated
+        z_cache = [None] * n_chunks
+        acc = None
+        for i, b in be.iter_chunks():
+            z_cache[i], parts = be.chunk_init(obj, w, b)
+            acc = parts if acc is None else _acc(acc, parts)
+        f_dev, g = be.finish(obj, w, acc)
+        f = float(f_dev)
+        g0norm = float(jnp.linalg.norm(g))
+        telemetry.count("solver.feature_streams")
+        telemetry.count("solver.evaluations")
+        _eval_tick(ck)
+        telemetry.iteration("lbfgs_streamed", 0, f, grad_norm=g0norm)
+
+        hist = np.full(max_iters + 1, np.nan, np.float32)
+        ghist = np.full(max_iters + 1, np.nan, np.float32)
+        hist[0], ghist[0] = f, g0norm
+
+        it, converged, failed = 0, g0norm <= 1e-14, False
+        done = converged
+        if ck is not None:
+            # the it=0 cut: resuming from here is provably == cold start
+            ck.update("lbfgs_streamed", _pack_lbfgs_state(
+                d, n_chunks, data, mesh, max_iters, it, f, g0norm, hist,
+                ghist, converged, failed, done, w, g, hist_st, z_cache,
+                z_gen))
+            ck.maybe_snapshot()
     dz_cache: list = [None] * n_chunks
     while not done and it < max_iters:
         p, dphi0_dev, pnorm = _lbfgs_direction(g, *hist_st.args())
@@ -662,6 +789,7 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
         # evaluation and the line search's first trial
         telemetry.count("solver.feature_streams")
         telemetry.count("solver.evaluations")
+        _eval_tick(ck)
 
         def phi(a):
             """Streamed trial: 16 bytes/row of cached margins, no X."""
@@ -672,6 +800,7 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
                 wlwd = be.chunk_phi(obj, i, z_cache[i], dz_cache[i], a)
                 phis = wlwd if phis is None else _acc(phis, wlwd)
             wl, wd = be.totals(phis)
+            _eval_tick(ck)
             rv, rd = reg_ray(a)
             return wl + rv, wd + rd
 
@@ -692,6 +821,7 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
             telemetry.count("solver.evaluations")
             if refresh:
                 telemetry.count("solver.margin_cache.refreshes")
+                z_gen += 1
             acc = None
             for i, b in be.iter_chunks():
                 if refresh:  # re-anchor the chained margin on w (f32 drift)
@@ -700,6 +830,7 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
                     parts = be.chunk_grad(obj, z_cache[i], b)
                 acc = parts if acc is None else _acc(acc, parts)
             _, g_new = be.finish(obj, w_new, acc)
+            _eval_tick(ck)
             f_new = f_star  # the accepted trial's value, as the resident
             # margin solver uses it
             hist_st.push(w_new - w, g_new - g)
@@ -717,6 +848,13 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
                             step=(alpha if ok else 0.0), trials=n_trials)
         w, g, f = w_new, g_new, f_new
         done = converged or not ok
+        if ck is not None:
+            # iteration boundary = the crash-consistency cut
+            ck.update("lbfgs_streamed", _pack_lbfgs_state(
+                d, n_chunks, data, mesh, max_iters, it, f, g0norm, hist,
+                ghist, converged, failed, done, w, g, hist_st, z_cache,
+                z_gen))
+            ck.maybe_snapshot()
 
     return _result(be.result_w(w), f, float(jnp.linalg.norm(g)), it,
                    converged, failed, hist, ghist)
@@ -755,22 +893,28 @@ def minimize_owlqn_streamed(
                                ladder_lanes, mesh, prefetch)
 
 
+def _pack_owlqn_state(d, n_chunks, data, max_iters, it, f, F, pg0norm,
+                      hist, ghist, converged, failed, done, w, g,
+                      hist_st) -> dict:
+    return _pack_stream_state("owlqn_streamed", d, n_chunks,
+                              data.chunk_rows, max_iters, it, f, pg0norm,
+                              hist, ghist, converged, failed, done, w, g,
+                              hist_st, {"F": float(F)})
+
+
 def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
                     history, max_ls_evals, reg_mask, ladder_lanes, mesh,
                     prefetch) -> OptResult:
     _check_streamable(obj, mesh)
     be = _backend(data, mesh, prefetch)
-    w = jnp.asarray(w0, jnp.float32)
-    if mesh is not None:
-        from photon_tpu.parallel.mesh import replicated
-
-        w = jax.device_put(w, replicated(mesh))
-    d = w.shape[0]
+    n_chunks = data.n_chunks
+    d = int(jnp.asarray(w0).shape[0])
     l1 = np.float32(l1_weight)
     mask = (jnp.ones((d,), jnp.float32) if reg_mask is None
             else jnp.asarray(reg_mask, jnp.float32))
-    hist_st = _History(history, d)
     c1 = 1e-4  # optim.owlqn's Armijo constant
+    ck = _ckpt.current()
+    st = ck.restore("owlqn_streamed") if ck is not None else None
 
     def value_grad_pass(w_at):
         telemetry.count("solver.feature_streams")
@@ -780,19 +924,54 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
             _, parts = be.chunk_init(obj, w_at, b)
             acc = parts if acc is None else _acc(acc, parts)
         f_dev, g_at = be.finish(obj, w_at, acc)
+        _eval_tick(ck)
         return float(f_dev), g_at
 
-    f, g = value_grad_pass(w)
-    F = f + float(_l1_term(w, l1, mask))
-    pg0norm = float(_pg_norm(w, g, l1, mask))
-    telemetry.iteration("owlqn_streamed", 0, F, grad_norm=pg0norm)
+    if st is not None:
+        # ---- resume: OWL-QN keeps no margin cache across iterations, so
+        # the full iteration-boundary state is iterate+history+scalars.
+        _validate_stream_state(st, "owlqn_streamed", d, n_chunks,
+                               data.chunk_rows, max_iters)
+        w = jnp.asarray(np.asarray(st["w"]), jnp.float32)
+        g = jnp.asarray(np.asarray(st["g"]), jnp.float32)
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import replicated
 
-    hist = np.full(max_iters + 1, np.nan, np.float32)
-    ghist = np.full(max_iters + 1, np.nan, np.float32)
-    hist[0], ghist[0] = F, pg0norm
+            w = jax.device_put(w, replicated(mesh))
+            g = jax.device_put(g, replicated(mesh))
+        hist_st = _restore_history(st, history, d)
+        f, F = float(st["f"]), float(st["F"])
+        pg0norm = float(st["g0norm"])
+        hist = np.array(st["hist"], np.float32)
+        ghist = np.array(st["ghist"], np.float32)
+        it = int(st["it"])
+        converged, failed = bool(st["converged"]), bool(st["failed"])
+        done = bool(st["done"])
+        telemetry.count("checkpoint.solver_restores")
+    else:
+        w = jnp.asarray(w0, jnp.float32)
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import replicated
 
-    it, converged, failed = 0, pg0norm <= 1e-14, False
-    done = converged
+            w = jax.device_put(w, replicated(mesh))
+        hist_st = _History(history, d)
+
+        f, g = value_grad_pass(w)
+        F = f + float(_l1_term(w, l1, mask))
+        pg0norm = float(_pg_norm(w, g, l1, mask))
+        telemetry.iteration("owlqn_streamed", 0, F, grad_norm=pg0norm)
+
+        hist = np.full(max_iters + 1, np.nan, np.float32)
+        ghist = np.full(max_iters + 1, np.nan, np.float32)
+        hist[0], ghist[0] = F, pg0norm
+
+        it, converged, failed = 0, pg0norm <= 1e-14, False
+        done = converged
+        if ck is not None:
+            ck.update("owlqn_streamed", _pack_owlqn_state(
+                d, n_chunks, data, max_iters, it, f, F, pg0norm, hist,
+                ghist, converged, failed, done, w, g, hist_st))
+            ck.maybe_snapshot()
     while not done and it < max_iters:
         p, dphi0_dev, xi, pg, pnorm = _owlqn_direction(
             w, g, l1, mask, *hist_st.args())
@@ -817,6 +996,7 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
             for _, b in be.iter_chunks():
                 part = be.chunk_value_many(obj, W, b)
                 acc = part if acc is None else _acc(acc, part)
+            _eval_tick(ck, K)
             F_cand = (be.values_total(acc) + np.asarray(rv, np.float64)
                       + np.asarray(l1t, np.float64))
             dec_np = np.asarray(dec, np.float64)
@@ -849,6 +1029,11 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
                             trials=evals)
         w, g, f, F = w_new, g_new, f_new, F_new
         done = converged or not ok
+        if ck is not None:
+            ck.update("owlqn_streamed", _pack_owlqn_state(
+                d, n_chunks, data, max_iters, it, f, F, pg0norm, hist,
+                ghist, converged, failed, done, w, g, hist_st))
+            ck.maybe_snapshot()
 
     return _result(be.result_w(w), F, float(_pg_norm(w, g, l1, mask)), it,
                    converged, failed, hist, ghist)

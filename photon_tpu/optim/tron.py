@@ -21,6 +21,7 @@ from photon_tpu.optim.tracker import OptResult
 # Opt-in in-loop iteration telemetry; compiled out by default (see
 # optim/lbfgs.py and the telemetry_off_is_free contract).
 from photon_tpu.telemetry.taps import solver_tap
+from photon_tpu.checkpoint.taps import snapshot_tap
 
 ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
 SIGMA1, SIGMA2, SIGMA3 = 0.25, 0.5, 4.0
@@ -176,6 +177,7 @@ def minimize_tron(
                                      g0norm, delta, tolerance, dtype)
         it = s.it + 1
         solver_tap("tron", it, f_new, gnorm, delta)
+        snapshot_tap("tron", it, w_new, f_new, gnorm, aux=delta)
         return _State(
             w=w_new, f=f_new, g=g_new, delta=delta, it=it,
             done=converged | stuck, converged=converged,
@@ -338,6 +340,7 @@ def minimize_tron_margin(
                                      g0norm, delta, tolerance, dtype)
         it = s.it + 1
         solver_tap("tron_margin", it, f_new, gnorm, delta)
+        snapshot_tap("tron_margin", it, w_new, f_new, gnorm, aux=delta)
         return _MarginState(
             w=w_new, z=z_new, f=f_new, g=g_new, delta=delta, it=it,
             done=converged | stuck, converged=converged,

@@ -135,34 +135,66 @@ class CoefficientStore:
     # ------------------------------------------------------------------ IO
     def save(self, out_dir) -> None:
         """Persist the store: one .npy per coefficient block (flat,
-        mmap-able) + the entity directories + a JSON manifest."""
+        mmap-able) + the entity directories + a JSON manifest.
+
+        Crash-consistent, two-phase: every payload file is fully written
+        and fsynced under a temp name FIRST, then the batch renames, then
+        the manifest commits LAST (checkpoint.store commit idiom). A kill
+        anywhere in the long write phase leaves a previously-saved store
+        untouched and a fresh directory without a manifest — `open` then
+        fails cleanly ("no manifest") instead of reading a torn .npy
+        (tests/test_serving.py kill-mid-write regression)."""
+        import io as _io
+
+        from photon_tpu.checkpoint.store import (commit_bytes,
+                                                 replace_committed)
+
         os.makedirs(out_dir, exist_ok=True)
         meta: dict = {"format": _FORMAT, "task": self.task.name,
                       "coordinates": []}
+        staged: list = []  # (tmp_path, final_path) renamed after all writes
+
+        def stage_npy(fname: str, arr: np.ndarray) -> None:
+            buf = _io.BytesIO()
+            np.save(buf, np.asarray(arr, np.float32), allow_pickle=False)
+            final = os.path.join(out_dir, fname)
+            tmp = f"{final}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(buf.getvalue())
+                f.flush()
+                os.fsync(f.fileno())
+            staged.append((tmp, final))
+
         for name in self.order:
             if name in self.fixed:
                 blk = self.fixed[name]
-                np.save(os.path.join(out_dir, f"{name}.fixed.npy"),
-                        np.asarray(blk.weights, np.float32))
+                stage_npy(f"{name}.fixed.npy", blk.weights)
                 meta["coordinates"].append(
                     {"name": name, "type": "fixed",
                      "feature_shard": blk.feature_shard})
             else:
                 blk = self.random[name]
-                np.save(os.path.join(out_dir, f"{name}.coeffs.npy"),
-                        np.asarray(blk.coefficients, np.float32))
+                stage_npy(f"{name}.coeffs.npy", blk.coefficients)
                 paldb = isinstance(blk.directory, PalDBIndexMap)
                 dpath = os.path.join(
                     out_dir, f"{name}.entities" + (".paldb" if paldb
                                                    else ".tsv"))
-                blk.directory.save(dpath)
+                blk.directory.save(f"{dpath}.tmp.{os.getpid()}")
+                if paldb:
+                    # PalDB saves <path> + <path>.meta; publish both
+                    staged.append((f"{dpath}.tmp.{os.getpid()}.meta",
+                                   f"{dpath}.meta"))
+                staged.append((f"{dpath}.tmp.{os.getpid()}", dpath))
                 meta["coordinates"].append(
                     {"name": name, "type": "random",
                      "feature_shard": blk.feature_shard,
                      "entity_name": blk.entity_name,
                      "directory": "paldb" if paldb else "tsv"})
-        with open(os.path.join(out_dir, _META_NAME), "w") as f:
-            json.dump(meta, f, indent=2)
+        for tmp, final in staged:
+            replace_committed(tmp, final)
+        # manifest LAST: its commit is the store's publication point
+        commit_bytes(os.path.join(out_dir, _META_NAME),
+                     json.dumps(meta, indent=2).encode())
 
     @classmethod
     def open(cls, out_dir, mmap: bool = True) -> "CoefficientStore":

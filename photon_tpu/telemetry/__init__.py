@@ -28,7 +28,12 @@ upload/solve/readback/tail_solve spans; the online serving tier's
 counters (pad_waste is shared with the offline chunked scorer),
 queue_depth/batch_fill/latency_p50_ms/latency_p95_ms/latency_p99_ms
 gauges, per-flush `serving.flush` spans, and one `serving_batch` event
-per dispatched micro-batch — and HBM watermarks), and the
+per dispatched micro-batch; the elastic-runs `checkpoint.*` family —
+snapshots/bytes/restores plus the per-layer scope_restores/
+solver_restores/re_restores/descent_restores and gc_snapshots, with
+`checkpoint.pack`/`checkpoint.write` spans — and its `faults.*` sibling
+— injected_kills/injected_errors/io_retries/backoff_seconds — and HBM
+watermarks), and the
 **iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted
 resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`
@@ -55,7 +60,11 @@ import threading
 from typing import Optional
 
 from photon_tpu.telemetry.run import Run, Span  # noqa: F401
-from photon_tpu.telemetry.sinks import load_report, read_jsonl  # noqa: F401
+from photon_tpu.telemetry.sinks import (  # noqa: F401
+    load_report,
+    read_jsonl,
+    repair_jsonl_tail,
+)
 from photon_tpu.telemetry.taps import (  # noqa: F401
     set_resident_tap,
     solver_tap,
@@ -77,7 +86,8 @@ _ATTACH_LOCK = threading.Lock()
 
 # ------------------------------------------------------------- run lifecycle
 def start_run(name: str = "run", jsonl_path: Optional[str] = None,
-              resident_tap: bool = False, logger=None) -> Run:
+              resident_tap: bool = False, logger=None,
+              append: bool = False) -> Run:
     """Create a Run and attach it as the process-wide current run. One run
     at a time: starting while one is attached finishes the old one first
     (runs are process-scoped, like the reference's one Spark UI per app)."""
@@ -86,7 +96,7 @@ def start_run(name: str = "run", jsonl_path: Optional[str] = None,
         if _CURRENT is not None:
             _CURRENT.close()
         r = Run(name=name, jsonl_path=jsonl_path, resident_tap=resident_tap,
-                logger=logger)
+                logger=logger, append=append)
         _CURRENT = r
         set_resident_tap(resident_tap)
     return r
@@ -103,10 +113,10 @@ def finish_run() -> Optional[dict]:
 
 @contextlib.contextmanager
 def run(name: str = "run", jsonl_path: Optional[str] = None,
-        resident_tap: bool = False, logger=None):
+        resident_tap: bool = False, logger=None, append: bool = False):
     """`with telemetry.run(...) as r:` — start_run/finish_run scoped."""
     r = start_run(name, jsonl_path=jsonl_path, resident_tap=resident_tap,
-                  logger=logger)
+                  logger=logger, append=append)
     try:
         yield r
     finally:

@@ -129,7 +129,7 @@ class Run:
 
     def __init__(self, name: str = "run", jsonl_path: Optional[str] = None,
                  resident_tap: bool = False, logger=None,
-                 keep_iterations: int = 100_000):
+                 keep_iterations: int = 100_000, append: bool = False):
         self.name = name
         self.resident_tap = bool(resident_tap)
         self.started_unix = time.time()
@@ -155,7 +155,15 @@ class Run:
         if jsonl_path is not None:
             os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)),
                         exist_ok=True)
-            self._jsonl_file = open(jsonl_path, "w")
+            if append:
+                # a resumed run continues the dead run's event log: first
+                # truncate a crash-torn final record (otherwise our first
+                # write would fuse onto it and hide every later event
+                # from read_jsonl), then reopen for append
+                from photon_tpu.telemetry.sinks import repair_jsonl_tail
+
+                repair_jsonl_tail(jsonl_path)
+            self._jsonl_file = open(jsonl_path, "a" if append else "w")
         self._emit({"type": "run_start", "name": name,
                     "started_unix": self.started_unix})
 

@@ -25,9 +25,10 @@ iterations are the incremental records.
 from __future__ import annotations
 
 import json
+import os
 from typing import Iterator, Optional
 
-__all__ = ["read_jsonl", "load_report"]
+__all__ = ["read_jsonl", "load_report", "repair_jsonl_tail"]
 
 
 def read_jsonl(path: str, kind: Optional[str] = None) -> Iterator[dict]:
@@ -45,6 +46,46 @@ def read_jsonl(path: str, kind: Optional[str] = None) -> Iterator[dict]:
                 return  # truncated tail from a dead run: stop, don't raise
             if kind is None or obj.get("type") == kind:
                 yield obj
+
+
+def repair_jsonl_tail(path: str) -> int:
+    """Truncate a crash-torn FINAL record so the file is append-safe.
+
+    A run killed mid-`_emit` leaves a partial last line. Readers already
+    tolerate that (`read_jsonl` stops at the torn tail) — but a run
+    REOPENED for append would write its next record onto the same line,
+    corrupting one record boundary mid-file and silently hiding every
+    event after it from `read_jsonl`. Called by `Run(append=True)` before
+    the reopen: scans back from EOF, drops a trailing line that is
+    missing its newline or is not valid JSON, and returns the number of
+    bytes truncated (0 when the tail was clean). Complete records are
+    never touched."""
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb+") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            return 0
+        # read the final partial-or-complete line (bounded back-scan)
+        back = min(size, 1 << 20)
+        f.seek(size - back)
+        tail = f.read(back)
+        nl = tail.rfind(b"\n")
+        if nl == len(tail) - 1:
+            # file ends on a newline: check the LAST complete line still
+            # parses (a torn multi-byte write can include the newline)
+            prev = tail[:-1].rfind(b"\n")
+            last = tail[prev + 1:-1]
+            try:
+                json.loads(last.decode("utf-8"))
+                return 0
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                cut = size - (len(tail) - (prev + 1))
+        else:
+            cut = size - (len(tail) - (nl + 1))
+        f.truncate(cut)
+        return size - cut
 
 
 def load_report(path: str) -> dict:

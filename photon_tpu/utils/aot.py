@@ -276,10 +276,13 @@ class AotStore:
                     raise
                 self._loaded.pop(path, None)
         data = export_program(fn, *args, platforms=self.platforms)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)  # atomic: concurrent processes race safely
+        # temp + fsync + rename (checkpoint.store.commit_bytes): atomic
+        # against concurrent processes AND durable against a kill
+        # mid-write — a preemption can no longer leave a truncated export
+        # that fails (or worse, half-replays) at the next load.
+        from photon_tpu.checkpoint.store import commit_bytes
+
+        commit_bytes(path, data)
         run = load_program(data)
         self._loaded[path] = run
         return run(*args)

@@ -24,13 +24,47 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 22 specs (round 9 added the serving request-program pins)
-    spanning every workload family, now including online serving."""
-    assert len(_REGISTRY) >= 22
+    """≥ 24 specs (round 10 added the checkpoint-off pins) spanning every
+    workload family, now including the checkpoint snapshot tap."""
+    assert len(_REGISTRY) >= 24
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
-                   "serving"):
+                   "serving", "checkpoint"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_checkpoint_off_specs_are_registered():
+    """Disarmed checkpointing must add ZERO transfer/callback primitives
+    to jitted solver programs: both checkpoint-off specs are strict
+    (no transfers, no f64, empty collective budget) and forbid the
+    transfer family outright — the acceptance pin of the elastic-runs
+    round, mirroring telemetry_off_is_free."""
+    from photon_tpu.analysis.walker import TRANSFER_PRIMITIVES
+
+    for name in ("checkpoint_off_is_free", "checkpoint_off_tron_free"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}
+        assert not spec.allow_transfers and not spec.allow_f64
+        assert TRANSFER_PRIMITIVES <= spec.forbid
+
+
+def test_checkpoint_selftest_cli_end_to_end():
+    """`python -m photon_tpu.checkpoint --selftest --json` — the
+    snapshot → kill → restore → bit-parity smoke — exits 0 with every
+    check green (exit 1 on drift is the CI contract)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the CLI must self-provision its platform
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.checkpoint", "--selftest",
+         "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"] is True
+    assert report["checks"]["resume_bit_identical"]["ok"] is True
+    assert report["checks"]["mid_write_resume_bit_identical"]["ok"] is True
 
 
 def test_serving_request_specs_are_registered():

@@ -55,6 +55,30 @@ def test_export_replay_bitwise(rng, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_store_kill_mid_write_leaves_no_corrupt_export(rng, tmp_path):
+    """Kill-mid-write regression (elastic-runs round): exports commit via
+    temp+fsync+rename, so a preemption during the write leaves either NO
+    export (fresh store) or the OLD bytes (overwrite) — never a truncated
+    .jaxexp that fails at the next load. The next call simply re-exports
+    and succeeds."""
+    from photon_tpu import checkpoint
+
+    fn, args = _fn_and_args(rng)
+    store = AotStore(str(tmp_path))
+    with np.testing.assert_raises(checkpoint.InjectedFault):
+        with checkpoint.fault_plan(
+                checkpoint.FaultPlan.kill_at("commit", 1)):
+            store.call("lane", fn, *args)
+    # the final path never appeared — only an abandoned temp file
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".jaxexp")] == []
+    # a fresh process re-exports cleanly and the replay works
+    fresh = AotStore(str(tmp_path))
+    r = fresh.call("lane", fn, *args)
+    assert np.asarray(r.w).ndim == 2  # (d, lanes)
+    assert len([f for f in os.listdir(tmp_path)
+                if f.endswith(".jaxexp")]) == 1
+
+
 def test_store_hits_and_aval_guard(rng, tmp_path):
     fn, args = _fn_and_args(rng)
     store = AotStore(str(tmp_path))

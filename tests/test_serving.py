@@ -103,6 +103,40 @@ class TestCoefficientStore:
         ids_b, _ = back.lookup("perEntity", ["e001", "x"])
         np.testing.assert_array_equal(ids_a, ids_b)
 
+    def test_save_kill_mid_write_is_crash_consistent(self, demo, tmp_path):
+        """Kill-mid-write regression (elastic-runs round): `save` commits
+        payload files temp+fsync+rename-first and the manifest LAST, so a
+        preemption during the write leaves (a) a fresh directory with NO
+        manifest — `open` fails cleanly instead of reading a torn .npy —
+        and (b) a re-save over the old store either the complete old or
+        complete new manifest, with every referenced block loadable."""
+        from photon_tpu import checkpoint
+
+        _, store, _ = demo
+        out = tmp_path / "s"
+        # (a) fresh save killed in the write phase (before any rename)
+        with pytest.raises(checkpoint.InjectedFault):
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan.kill_at("commit", 1)):
+                store.save(out)
+        assert not (out / "serving_store.json").exists()
+        with pytest.raises(FileNotFoundError):
+            serving.CoefficientStore.open(out)
+        # (b) retry completes; then a killed RE-save (mid manifest
+        # commit — the LAST commit point of a save) leaves the previous
+        # committed store fully loadable
+        with checkpoint.record_sites() as rec:
+            store.save(out)
+        with pytest.raises(checkpoint.InjectedFault):
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan.kill_at("commit",
+                                                 rec.hits["commit"])):
+                store.save(out)
+        back = serving.CoefficientStore.open(out, mmap=False)
+        np.testing.assert_array_equal(
+            back.random["perEntity"].coefficients,
+            store.random["perEntity"].coefficients)
+
     def test_open_rejects_foreign_dir(self, tmp_path):
         (tmp_path / "serving_store.json").write_text('{"format": "nope"}')
         with pytest.raises(ValueError, match="not a"):

@@ -12,6 +12,7 @@ regime tests/conftest.py's marker exists for.
 """
 import json
 import logging
+import os
 import threading
 
 import numpy as np
@@ -173,6 +174,41 @@ class TestJsonlSink:
             fh.write('{"type": "iteration", "solver": "s", "it')  # cut off
         disk = telemetry.load_report(path)
         assert len(disk["iterations"]) == 1  # prefix still served
+
+    def test_reopen_after_kill_appends_past_torn_tail(self, tmp_path):
+        """Elastic-runs satellite: a run killed mid-write leaves a torn
+        FINAL record; a resumed run reopening the SAME file with
+        append=True must first truncate that tail (otherwise its first
+        record fuses onto the torn line and every later event vanishes
+        from read_jsonl), then append — all complete records from both
+        generations are served."""
+        path = str(tmp_path / "run.jsonl")
+        telemetry.start_run("gen1", jsonl_path=path)
+        telemetry.iteration("s", 0, 1.0)
+        telemetry.iteration("s", 1, 0.5)
+        telemetry.finish_run()
+        with open(path, "a") as fh:  # the kill: a torn final record
+            fh.write('{"type": "iteration", "solver": "s", "it')
+
+        telemetry.start_run("gen2", jsonl_path=path, append=True)
+        telemetry.iteration("s", 2, 0.25)
+        telemetry.finish_run()
+
+        events = list(telemetry.read_jsonl(path))
+        assert [e["name"] for e in events
+                if e["type"] == "run_start"] == ["gen1", "gen2"]
+        iters = [e for e in events if e["type"] == "iteration"]
+        assert [e["it"] for e in iters] == [0, 1, 2]  # torn tail dropped
+        assert sum(1 for e in events if e["type"] == "run_end") == 2
+
+    def test_repair_tail_noop_on_clean_file(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        telemetry.start_run("rt", jsonl_path=path)
+        telemetry.iteration("s", 0, 1.0)
+        telemetry.finish_run()
+        size = os.path.getsize(path)
+        assert telemetry.repair_jsonl_tail(path) == 0
+        assert os.path.getsize(path) == size
 
     def test_every_line_is_json_with_type(self, tmp_path):
         path = str(tmp_path / "run.jsonl")
