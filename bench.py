@@ -59,6 +59,19 @@ if "--check-contracts" in sys.argv:
                                    " --xla_force_host_platform_device_count"
                                    "=8").strip()
 
+# --gate: the noise-aware bench regression sentinel
+# (photon_tpu/profiling/sentinel.py) — judge the latest BENCH_r0*.json
+# round (or --gate-candidate FILE) against the earlier trajectory with
+# per-leg median/MAD robust z-scores; exit 1 iff any leg regressed
+# beyond --gate-z. Runs BEFORE the benchmark imports: gating a PR costs
+# milliseconds, never a benchmark run. [--gate-dir DIR] [--gate-z Z]
+if "--gate" in sys.argv:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from photon_tpu.profiling.sentinel import gate_main
+
+    raise SystemExit(gate_main(
+        sys.argv, bench_dir=os.path.dirname(os.path.abspath(__file__))))
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -563,9 +576,10 @@ def main() -> None:
     # in BENCH_*.json next to the wall-clock numbers. --telemetry-out PATH
     # additionally streams the full JSONL event log for offline reading
     # (python -m photon_tpu.telemetry --report PATH).
-    from photon_tpu import telemetry
+    from photon_tpu import profiling, telemetry
 
     run = telemetry.start_run("bench", jsonl_path=_telemetry_out_path())
+    profiling.start_ledger("bench")
     with telemetry.span("leg.sparse_data"):
         batch = sparse_problem()
     with telemetry.span("leg.sparse_grid8"):
@@ -595,8 +609,15 @@ def main() -> None:
     with telemetry.span("leg.serving_qps"):
         serving_stats = run_serving(sv_ladder, sv_pool)
     telemetry.finish_run()
+    ledger_report = profiling.finish_ledger()
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
-    print(json.dumps({
+    doc = {
+        # schema 2 (profiling.sentinel.SCHEMA_VERSION): the line is
+        # self-describing for the regression sentinel — it carries its
+        # schema version and the per-leg gate verdicts computed against
+        # the BENCH_r0*.json trajectory beside this script.
+        "schema": None,  # filled below (sentinel owns the version)
+        "gate": None,
         "telemetry": run.report_compact(),
         "metric": "sparse10m_logistic_grid8_rows_iters_per_sec_per_chip",
         "value": round(grid_value, 1),
@@ -653,7 +674,19 @@ def main() -> None:
             "serving_p95_ms": round(serving_stats["p95_ms"], 3),
             "serving_p99_ms": round(serving_stats["p99_ms"], 3),
         },
-    }))
+    }
+    # attribution-ledger digest: the top measured programs + compile
+    # accounting ride the JSON line next to the wall-clock legs
+    doc["ledger"] = {"compile": ledger_report["compile"],
+                     "attribution": ledger_report["attribution"][:8]}
+    from photon_tpu.profiling import sentinel
+
+    doc["schema"] = sentinel.SCHEMA_VERSION
+    history = sentinel.load_history(
+        os.path.dirname(os.path.abspath(__file__)))
+    verdicts = sentinel.gate(sentinel.leg_values(doc), history)
+    doc["gate"] = {leg: v.to_json() for leg, v in verdicts.items()}
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

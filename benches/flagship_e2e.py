@@ -84,6 +84,12 @@ def main() -> None:
                         "every K evaluations vs none; rows·iters/s "
                         "delta + snapshot bytes/s) and print its JSON "
                         "line")
+    p.add_argument("--xprof-dir", default=None,
+                   help="wrap each driver run in jax.profiler.start_trace/"
+                        "stop_trace writing an XProf capture here, so the "
+                        "telemetry spans (mirrored to TraceAnnotation) and "
+                        "the attribution ledger's phases line up with the "
+                        "device timeline on real TPUs")
     p.add_argument("--serving-leg", action="store_true",
                    help="also run bench.py's serving_qps leg (closed-loop "
                         "online scoring over a zipf entity mix through "
@@ -145,24 +151,43 @@ def main() -> None:
 
     import json
 
-    from photon_tpu import telemetry
+    from photon_tpu import profiling, telemetry
 
     for run in range(args.runs):
-        # each driver invocation records a telemetry run: spans for the
+        # each driver invocation records a telemetry run (spans for the
         # driver phases, stall/eval/retrace counters, live iteration
-        # events from any streamed solve — JSONL under the run's out dir,
-        # compact report embedded in the JSON line printed below
+        # events from any streamed solve — JSONL under the run's out
+        # dir, compact report embedded in the JSON line printed below)
+        # AND an attribution ledger (photon_tpu/profiling: per-program
+        # modeled FLOPs/bytes vs measured wall, compile accounting —
+        # ledger.json beside the telemetry JSONL)
         jsonl = os.path.join(args.out_dir, f"game_r{run}",
                              "telemetry.jsonl")
+        ledger_json = os.path.join(args.out_dir, f"game_r{run}",
+                                   "ledger.json")
         # a --resume rerun APPENDS to the dead run's event log (the sink
         # repairs a crash-torn tail record first) instead of truncating
         trun = telemetry.start_run(f"flagship_r{run}", jsonl_path=jsonl,
                                    append=args.resume)
+        profiling.start_ledger(f"flagship_r{run}")
+        if args.xprof_dir:
+            import jax
+
+            jax.profiler.start_trace(args.xprof_dir)
         t0 = time.perf_counter()
-        out = run_training(params(fd.COORDINATES, f"game_r{run}"),
-                           mesh=mesh)
+        try:
+            out = run_training(params(fd.COORDINATES, f"game_r{run}"),
+                               mesh=mesh)
+        finally:
+            if args.xprof_dir:
+                import jax
+
+                jax.profiler.stop_trace()
         total = time.perf_counter() - t0
         telemetry.finish_run()
+        ledger_report = profiling.finish_ledger()
+        with open(ledger_json, "w") as fh:
+            json.dump(ledger_report, fh)
         phases = {k: round(v, 1) for k, v in sorted(out.timings.items())}
         print(f"run {run}: total {total:.0f}s  phases {phases}", flush=True)
         print(f"run {run}: validation AUC {out.best.validation_score:.4f} "
@@ -170,6 +195,7 @@ def main() -> None:
               flush=True)
         print(json.dumps({"run": run, "total_s": round(total, 1),
                           "telemetry_jsonl": jsonl,
+                          "ledger_json": ledger_json,
                           "telemetry": trun.report_compact()}),
               flush=True)
 

@@ -24,6 +24,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
     "photon_tpu.serving.programs",    # online per-request scoring ladder
     "photon_tpu.checkpoint.taps",     # checkpoint-off-is-free guarantee
+    "photon_tpu.profiling.ledger",    # ledger-off-is-free guarantee
 )
 
 

@@ -40,6 +40,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from photon_tpu import checkpoint as _ckpt
+from photon_tpu import profiling
 from photon_tpu import telemetry
 from photon_tpu.data.matrix import next_pow2
 from photon_tpu.game.dataset import RandomEffectDataset, REBlock
@@ -299,7 +300,8 @@ class RandomEffectCoordinate:
         tail_args = compact_rows((fl.args[0], fl.res.w) + tuple(fl.args[2:]),
                                  idx, pad_rows=e_pad2)
         solver = self._solver_for(fl.with_prior)  # full-depth program
-        with telemetry.span("game_re.tail_solve", entities=n2):
+        with telemetry.span("game_re.tail_solve", entities=n2), \
+                profiling.measure("game_re.block", "tail_solve"):
             res2, var2 = dispatch_chunked(solver, (fl.obj, lam), tail_args,
                                           chunk2, e_pad2, self.mesh)
             w2, conv2, fail2, it2, var2h = jax.device_get(
@@ -429,7 +431,8 @@ class RandomEffectCoordinate:
             """Pipeline stage 1: host prep + non-blocking upload + solve
             dispatch for one bucket. Nothing here waits on the device."""
             with telemetry.span("game_re.upload", m=block.m,
-                                entities=block.n_entities):
+                                entities=block.n_entities), \
+                    profiling.measure("game_re.block", "upload"):
                 batch = ds.block_batch(block, offsets_dev)
                 w0_full = coeffs[block.entity_index]
                 # Project warm starts / priors into this bucket's solve
@@ -466,7 +469,9 @@ class RandomEffectCoordinate:
             e_pad = pad_to_multiple(e_real, chunk)
             args = _pad_axis0((batch, w0) + ((pm, pp) if with_prior else ()),
                               e_pad)
-            with telemetry.span("game_re.solve", m=block.m, entities=e_real):
+            with telemetry.span("game_re.solve", m=block.m,
+                                entities=e_real), \
+                    profiling.measure("game_re.block", "solve_dispatch"):
                 res, var = dispatch_chunked(solver, (obj, lam), args, chunk,
                                             e_pad, self.mesh)
             telemetry.count("game_re.blocks")
@@ -484,7 +489,8 @@ class RandomEffectCoordinate:
             _ckpt.kill_point("bucket_retire")
             block, e_real = fl.block, fl.e_real
             t0 = time.perf_counter_ns()
-            with telemetry.span("game_re.readback", m=block.m):
+            with telemetry.span("game_re.readback", m=block.m), \
+                    profiling.measure("game_re.block", "readback"):
                 w_out, conv, fail, iters, var_h = jax.device_get(
                     (fl.res.w, fl.res.converged, fl.res.failed,
                      fl.res.iterations,

@@ -49,8 +49,12 @@ from photon_tpu.parallel.mesh import data_sharding, pad_to_multiple, replicated
 # Run telemetry (no-op without an attached Run): the solve dispatches
 # record their jit-cache argument signatures, so the run report counts
 # retraces (`retrace.new_signatures`) and flags weak-type drift — the
-# dynamic face of the analysis retrace-hazard rule.
-from photon_tpu import telemetry
+# dynamic face of the analysis retrace-hazard rule. The attribution
+# ledger (photon_tpu/profiling, same off-state contract) additionally
+# measures each dispatch's wall time: a NEW-signature dispatch pays
+# trace+lower+compile inline, so the ledger's compile accounting rides
+# the same signature log.
+from photon_tpu import profiling, telemetry
 
 
 def make_objective(
@@ -509,22 +513,25 @@ def train_glm_grid(
                  # all three optimizers have a lane-minor solver
                  and (l1s is not None) == (static_cfg.optimizer
                                            is OptimizerType.OWLQN))
-    if sharded_hybrid:
-        if use_lanes:
-            res, var = _train_run_sharded_grid_lanes(batch, w0, obj, l2s,
-                                                     l1s, static_cfg, mesh)
+    with profiling.dispatch("training._train_run_grid",
+                            (batch, w0, obj, l2s, l1s)):
+        if sharded_hybrid:
+            if use_lanes:
+                res, var = _train_run_sharded_grid_lanes(
+                    batch, w0, obj, l2s, l1s, static_cfg, mesh)
+            else:
+                res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
+                                                   static_cfg, variance,
+                                                   mesh)
         else:
-            res, var = _train_run_sharded_grid(batch, w0, obj, l2s, l1s,
-                                               static_cfg, variance, mesh)
-    else:
-        if mesh is not None:
-            batch, w0 = _mesh_prep(batch, w0, mesh)
-        if use_lanes:
-            res, var = _train_run_grid_lanes(batch, w0, obj, l2s, l1s,
-                                             static_cfg)
-        else:
-            res, var = _train_run_grid(batch, w0, obj, l2s, l1s, static_cfg,
-                                       variance)
+            if mesh is not None:
+                batch, w0 = _mesh_prep(batch, w0, mesh)
+            if use_lanes:
+                res, var = _train_run_grid_lanes(batch, w0, obj, l2s, l1s,
+                                                 static_cfg)
+            else:
+                res, var = _train_run_grid(batch, w0, obj, l2s, l1s,
+                                           static_cfg, variance)
     if permuted:
         # Back to original column order (one (G, d) device gather for the
         # whole sweep) before normalization unfolds / models assemble;
@@ -785,8 +792,11 @@ def train_glm(
     if sharded_hybrid:
         telemetry.record_signature("training._train_run_sharded",
                                    (batch, w0, obj, _l1_lam(config)))
-        res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
-                                      _static_config(config), variance, mesh)
+        with profiling.dispatch("training._train_run_sharded",
+                                (batch, w0, obj, _l1_lam(config))):
+            res, var = _train_run_sharded(batch, w0, obj, _l1_lam(config),
+                                          _static_config(config), variance,
+                                          mesh)
     elif mesh is not None:
         batch, w0 = _mesh_prep(batch, w0, mesh)
     elif (obj.fused
@@ -803,8 +813,19 @@ def train_glm(
     if not sharded_hybrid:
         telemetry.record_signature("training._train_run",
                                    (batch, w0, obj, _l1_lam(config)))
-        res, var = _train_run(batch, w0, obj, _l1_lam(config),
-                              _static_config(config), variance)
+        if profiling.needs_note("training._train_run"):
+            # static cost of the WHOLE jitted solve, its while loops
+            # bounded by the config's iteration budget (trace-only)
+            lam, static_cfg = _l1_lam(config), _static_config(config)
+            profiling.note_program(
+                "training._train_run",
+                lambda b, w, o: _train_run(b, w, o, lam, static_cfg,
+                                           variance),
+                (batch, w0, obj), while_trips=config.max_iters)
+        with profiling.dispatch("training._train_run",
+                                (batch, w0, obj, _l1_lam(config))):
+            res, var = _train_run(batch, w0, obj, _l1_lam(config),
+                                  _static_config(config), variance)
     if permuted:
         # Back to original column order (one device gather) BEFORE the
         # normalization unfold — elementwise transforms commute with the

@@ -68,6 +68,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from photon_tpu import checkpoint as _ckpt
+from photon_tpu import profiling
 from photon_tpu import telemetry
 from photon_tpu.data.dataset import GLMBatch
 from photon_tpu.data.matrix import SparseRows
@@ -324,8 +325,33 @@ class _SingleDeviceStream:
     """The single-chip execution regime: chunks upload whole, margin caches
     are (chunk_rows,) host numpy, partial totals are plain device scalars."""
 
+    # attribution-ledger program-name prefix + the traceable chunk
+    # programs behind each backend method (profiling.note_program
+    # estimates their static FLOP/byte cost once per attached ledger)
+    prog = "streamed."
+
     def __init__(self, data, prefetch: int = 2):
         self.data, self.prefetch = data, prefetch
+        self.cost_fns = {"chunk_init": _chunk_init,
+                         "chunk_grad": _chunk_grad_at_margin,
+                         "chunk_dz_phi": _chunk_dz_phi,
+                         "chunk_value_many": _chunk_value_many}
+
+    def note(self, name, *args):
+        """Static-cost registration (trace-only, once per attached
+        ledger) for one chunk program, with the hot loop's own args."""
+        if profiling.needs_note(self.prog + name):
+            profiling.note_program(self.prog + name, self.cost_fns[name],
+                                   args)
+
+    def note_phi(self, obj, i, z, dz, a):
+        """The margin-trial program's note (needs a live chunk's scalars;
+        only prepared while a ledger wants it)."""
+        if not profiling.needs_note(self.prog + "chunk_phi"):
+            return
+        b = self.data.chunk(i)
+        profiling.note_program(self.prog + "chunk_phi", _chunk_phi,
+                               (obj, z, dz, np.float32(a), b.y, b.weights))
 
     def iter_chunks(self):
         return self.data.iter_device(prefetch=self.prefetch)
@@ -368,9 +394,37 @@ class _MeshStream:
     ((n_local_slots, s) numpy — `parallel.mesh.fetch_local_rows`), and each
     evaluation closes with the backend's single psum."""
 
+    prog = "streamed_mesh."
+
     def __init__(self, data, mesh, prefetch: int = 2):
         self.data, self.mesh, self.prefetch = data, mesh, prefetch
         self.ops = _mesh_ops(mesh)
+        self.cost_fns = {"chunk_init": self.ops.chunk_init,
+                         "chunk_grad": self.ops.chunk_grad,
+                         "chunk_dz_phi": self.ops.chunk_dz_phi,
+                         "chunk_value_many": self.ops.chunk_value_many}
+
+    def note(self, name, *args):
+        """Mesh face of `_SingleDeviceStream.note`: margin caches live
+        host-side in LOCAL-SLOT layout, so the z-carrying programs trace
+        with the re-sharded device array the real call would see."""
+        if not profiling.needs_note(self.prog + name):
+            return
+        if name == "chunk_dz_phi":
+            obj, p, z, a, b = args
+            args = (obj, p, self._put(z), np.float32(a), b)
+        elif name == "chunk_grad":
+            obj, z, b = args
+            args = (obj, self._put(z), b)
+        profiling.note_program(self.prog + name, self.cost_fns[name], args)
+
+    def note_phi(self, obj, i, z, dz, a):
+        if not profiling.needs_note(self.prog + "chunk_phi"):
+            return
+        y, wt = self.data.chunk_scalars_sharded(i, self.mesh)
+        profiling.note_program(
+            self.prog + "chunk_phi", self.ops.chunk_phi,
+            (obj, self._put(z), self._put(dz), np.float32(a), y, wt))
 
     def iter_chunks(self):
         return self.data.iter_device(mesh=self.mesh, prefetch=self.prefetch)
@@ -740,11 +794,14 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
         # ---- initial pass: margins cached per chunk, (f, g) accumulated
         z_cache = [None] * n_chunks
         acc = None
-        for i, b in be.iter_chunks():
-            z_cache[i], parts = be.chunk_init(obj, w, b)
-            acc = parts if acc is None else _acc(acc, parts)
-        f_dev, g = be.finish(obj, w, acc)
-        f = float(f_dev)
+        with profiling.measure(be.prog + "chunk_init", "lbfgs/init",
+                               calls=n_chunks):
+            for i, b in be.iter_chunks():
+                be.note("chunk_init", obj, w, b)
+                z_cache[i], parts = be.chunk_init(obj, w, b)
+                acc = parts if acc is None else _acc(acc, parts)
+            f_dev, g = be.finish(obj, w, acc)
+            f = float(f_dev)  # the host readback closes the measured pass
         g0norm = float(jnp.linalg.norm(g))
         telemetry.count("solver.feature_streams")
         telemetry.count("solver.evaluations")
@@ -778,11 +835,15 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
         # ---- direction pass (feature stream 1 of 2): dz per chunk, with
         # the FIRST Wolfe trial's φ(a_init) partials riding along.
         phis = None
-        for i, b in be.iter_chunks():
-            dz_cache[i], wlwd = be.chunk_dz_phi(obj, p, z_cache[i],
-                                                a_init, b)
-            phis = wlwd if phis is None else _acc(phis, wlwd)
-        wl0, wd0 = be.totals(phis)
+        with profiling.measure(be.prog + "chunk_dz_phi", "lbfgs/direction",
+                               calls=n_chunks):
+            for i, b in be.iter_chunks():
+                be.note("chunk_dz_phi", obj, p, z_cache[i],
+                        np.float32(a_init), b)
+                dz_cache[i], wlwd = be.chunk_dz_phi(obj, p, z_cache[i],
+                                                    a_init, b)
+                phis = wlwd if phis is None else _acc(phis, wlwd)
+            wl0, wd0 = be.totals(phis)
         rv, rd = reg_ray(a_init)
         first_eval = (wl0 + rv, wd0 + rd)
         # feature stream 1 of 2; its piggybacked φ(a_init) is both an
@@ -796,10 +857,13 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
             telemetry.count("solver.evaluations")
             telemetry.count("solver.margin_cache.hits")
             phis = None
-            for i in range(n_chunks):
-                wlwd = be.chunk_phi(obj, i, z_cache[i], dz_cache[i], a)
-                phis = wlwd if phis is None else _acc(phis, wlwd)
-            wl, wd = be.totals(phis)
+            with profiling.measure(be.prog + "chunk_phi",
+                                   "lbfgs/linesearch", calls=n_chunks):
+                be.note_phi(obj, 0, z_cache[0], dz_cache[0], a)
+                for i in range(n_chunks):
+                    wlwd = be.chunk_phi(obj, i, z_cache[i], dz_cache[i], a)
+                    phis = wlwd if phis is None else _acc(phis, wlwd)
+                wl, wd = be.totals(phis)
             _eval_tick(ck)
             rv, rd = reg_ray(a)
             return wl + rv, wd + rd
@@ -823,13 +887,17 @@ def _lbfgs_streamed(obj, data, w0, max_iters, tolerance, history,
                 telemetry.count("solver.margin_cache.refreshes")
                 z_gen += 1
             acc = None
-            for i, b in be.iter_chunks():
-                if refresh:  # re-anchor the chained margin on w (f32 drift)
-                    z_cache[i], parts = be.chunk_init(obj, w_new, b)
-                else:
-                    parts = be.chunk_grad(obj, z_cache[i], b)
-                acc = parts if acc is None else _acc(acc, parts)
-            _, g_new = be.finish(obj, w_new, acc)
+            grad_prog = be.prog + ("chunk_init" if refresh else "chunk_grad")
+            with profiling.measure(grad_prog, "lbfgs/gradient",
+                                   calls=n_chunks):
+                for i, b in be.iter_chunks():
+                    if refresh:  # re-anchor chained margin on w (f32 drift)
+                        z_cache[i], parts = be.chunk_init(obj, w_new, b)
+                    else:
+                        be.note("chunk_grad", obj, z_cache[i], b)
+                        parts = be.chunk_grad(obj, z_cache[i], b)
+                    acc = parts if acc is None else _acc(acc, parts)
+                _, g_new = be.finish(obj, w_new, acc)
             _eval_tick(ck)
             f_new = f_star  # the accepted trial's value, as the resident
             # margin solver uses it
@@ -920,12 +988,16 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
         telemetry.count("solver.feature_streams")
         telemetry.count("solver.evaluations")
         acc = None
-        for i, b in be.iter_chunks():
-            _, parts = be.chunk_init(obj, w_at, b)
-            acc = parts if acc is None else _acc(acc, parts)
-        f_dev, g_at = be.finish(obj, w_at, acc)
+        with profiling.measure(be.prog + "chunk_init", "owlqn/value_grad",
+                               calls=n_chunks):
+            for i, b in be.iter_chunks():
+                be.note("chunk_init", obj, w_at, b)
+                _, parts = be.chunk_init(obj, w_at, b)
+                acc = parts if acc is None else _acc(acc, parts)
+            f_dev, g_at = be.finish(obj, w_at, acc)
+            f_host = float(f_dev)  # readback closes the measured pass
         _eval_tick(ck)
-        return float(f_dev), g_at
+        return f_host, g_at
 
     if st is not None:
         # ---- resume: OWL-QN keeps no margin cache across iterations, so
@@ -993,11 +1065,15 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
             telemetry.count("solver.evaluations", K)
             telemetry.count("solver.linesearch_trials", K)
             acc = None
-            for _, b in be.iter_chunks():
-                part = be.chunk_value_many(obj, W, b)
-                acc = part if acc is None else _acc(acc, part)
+            with profiling.measure(be.prog + "chunk_value_many",
+                                   "owlqn/ladder", calls=n_chunks):
+                for _, b in be.iter_chunks():
+                    be.note("chunk_value_many", obj, W, b)
+                    part = be.chunk_value_many(obj, W, b)
+                    acc = part if acc is None else _acc(acc, part)
+                vals_total = be.values_total(acc)  # sync: closes the pass
             _eval_tick(ck, K)
-            F_cand = (be.values_total(acc) + np.asarray(rv, np.float64)
+            F_cand = (vals_total + np.asarray(rv, np.float64)
                       + np.asarray(l1t, np.float64))
             dec_np = np.asarray(dec, np.float64)
             for k in range(K):  # first passing rung == sequential halving

@@ -1,0 +1,77 @@
+"""Performance attribution ledger: modeled-vs-measured rooflines,
+compile accounting, and the noise-aware bench regression sentinel.
+
+PR 3 made the cost model enforced STATIC law (`photon_tpu/analysis`:
+jaxpr contracts fail CI on drift) and PR 4 recorded runtime BLINDLY
+(`photon_tpu/telemetry`: spans/counters with no idea what they should
+have cost). This package connects the two planes:
+
+- `model` — static per-program cost estimates over the same recursive
+  jaxpr walk the contract checker uses: FLOPs from `dot_general`/
+  elementwise/reduction shapes, bytes moved, collective payload bytes,
+  `scan` lengths from the IR and `while` trips from solver iteration
+  bounds — plus XLA's own `compiled.cost_analysis()` view.
+- `ledger` — the process-wide `Ledger` (the `telemetry.Run` analog):
+  attributes measured span durations to the programs that ran, computes
+  achieved FLOP/s / bytes/s and roofline-utilization fractions per
+  (program, phase), books trace/lower/compile wall time and retrace
+  counts (riding `analysis.TraceSignatureLog`), and records per-phase
+  HBM high-water marks. Detached (the default) every entry point is one
+  global load + one branch, and the registered ``ledger_off_is_free``
+  ContractSpec proves the disarmed ledger adds ZERO primitives to
+  jitted solver programs.
+- `sentinel` — the bench regression gate: fits per-leg median/MAD over
+  the BENCH_r0*.json trajectory and judges a candidate round with
+  noise-aware robust z-scores (``bench.py --gate``; verdicts are also
+  embedded in every bench JSON line under ``"gate"``).
+
+::
+
+    from photon_tpu import profiling
+
+    with profiling.ledger("flagship") as led:
+        train_glm(batch, task, config)        # instrumented hot paths
+    print(led.summary_lines())                # attribute into the ledger
+
+CLI: ``python -m photon_tpu.profiling --report [--json]`` runs a small
+streamed-dense solve under a ledger and renders the attribution report
+(top programs by time, utilization, compile share, bench-gate
+verdicts); ``--selftest`` is the smoke the umbrella
+``python -m photon_tpu --selfcheck`` aggregates.
+"""
+from __future__ import annotations
+
+from photon_tpu.profiling.ledger import (  # noqa: F401
+    Ledger,
+    ProgramRecord,
+    attribute,
+    current_ledger,
+    dispatch,
+    enabled,
+    finish_ledger,
+    ledger,
+    ledger_disabled,
+    measure,
+    needs_note,
+    note_program,
+    record_signature,
+    resolve_peaks,
+    sample_hbm,
+    start_ledger,
+)
+from photon_tpu.profiling.model import (  # noqa: F401
+    StaticCost,
+    estimate_fn,
+    estimate_jaxpr,
+    xla_cost,
+)
+from photon_tpu.profiling import sentinel  # noqa: F401
+
+__all__ = [
+    "Ledger", "ProgramRecord", "StaticCost",
+    "start_ledger", "finish_ledger", "ledger", "current_ledger",
+    "enabled", "measure", "attribute", "note_program", "needs_note",
+    "dispatch", "record_signature", "sample_hbm", "ledger_disabled",
+    "resolve_peaks",
+    "estimate_jaxpr", "estimate_fn", "xla_cost", "sentinel",
+]

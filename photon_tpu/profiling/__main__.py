@@ -1,0 +1,217 @@
+"""CLI: render the performance attribution ledger.
+
+    python -m photon_tpu.profiling --report            # human report
+    python -m photon_tpu.profiling --report --json     # machine report
+    python -m photon_tpu.profiling --report --rows N --chunk-rows C
+    python -m photon_tpu.profiling --selftest [--json] # smoke, exit 1 on drift
+
+``--report`` attaches a process-wide `Ledger` (+ a telemetry Run),
+drives a STREAMED-DENSE solve — the regime whose passes are closed by
+host readbacks, so measured seconds are honest device+stream time —
+through the instrumented `optim/streamed.py` path, then renders: per
+(program, phase) attribution entries carrying static FLOP/byte
+estimates, measured duration and a roofline-utilization fraction in
+(0, 1]; per-program compile accounting (trace/lower/compile probe walls
++ new-signature dispatch walls + retrace counts); and the bench
+sentinel's per-leg verdicts over the repo's BENCH_r0*.json trajectory
+when one is found beside the package.
+
+``--selftest`` runs the same report on a tiny problem and asserts the
+acceptance facts (every streamed attribution entry has static estimates,
+measured time, utilization ∈ (0, 1]; the `ledger_off_is_free` contract
+holds) — the piece `python -m photon_tpu --selfcheck` aggregates.
+
+Environment defaults mirror `analysis.__main__` (CPU platform
+self-provisioned before jax loads), so this runs anywhere CI does.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _flag_value(argv, name, default):
+    return type(default)(argv[argv.index(name) + 1]) \
+        if name in argv else default
+
+
+def _repo_bench_dir() -> str:
+    """Where the BENCH_r0*.json trajectory lives: the repo root, two
+    levels above this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_report(rows: int = 1 << 14, chunk_rows: int = 1 << 12,
+               d: int = 32, max_iters: int = 6,
+               bench_dir: str | None = None) -> dict:
+    """Drive one streamed-dense solve under a fresh ledger + telemetry
+    run; return {"ledger": ..., "gate": ...} (gate omitted when no bench
+    history is found)."""
+    import numpy as np
+
+    from photon_tpu import profiling, telemetry
+    from photon_tpu.data.dataset import chunk_batch, make_batch
+    from photon_tpu.models.training import train_glm
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.uniform(size=rows) < p).astype(np.float32)
+    cb = chunk_batch(make_batch(X, y), chunk_rows)
+    cfg = OptimizerConfig(max_iters=max_iters, tolerance=0.0, reg=l2(),
+                          reg_weight=1e-3, history=5)
+
+    led = profiling.start_ledger("profiling_report")
+    telemetry.start_run("profiling_report")
+    try:
+        led.sample_hbm("start")
+        train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+        led.sample_hbm("streamed_dense")
+    finally:
+        telemetry.finish_run()
+        profiling.finish_ledger()
+    out = {"ledger": led.report()}
+
+    bench_dir = bench_dir or _repo_bench_dir()
+    history = profiling.sentinel.load_history(bench_dir)
+    if history:
+        _, candidate = history[-1]
+        verdicts = profiling.sentinel.gate(candidate, history[:-1])
+        out["gate"] = {leg: v.to_json() for leg, v in verdicts.items()}
+    return out
+
+
+def _render_human(out: dict) -> None:
+    rep = out["ledger"]
+    print(f"attribution ledger '{rep['name']}' "
+          f"({rep['duration_s']:.3f}s wall, peaks: "
+          f"{rep['peaks']['flops_per_s']:.3g} FLOP/s, "
+          f"{rep['peaks']['bytes_per_s']:.3g} B/s)")
+    print("top programs by measured time:")
+    for e in rep["attribution"][:12]:
+        util = e.get("utilization")
+        tail = ""
+        if util is not None:
+            tail = (f"  util={100.0 * util:.1f}% ({e['bound']}-bound, "
+                    f"{e['achieved_flops_per_s']:.3g} FLOP/s, "
+                    f"{e['achieved_bytes_per_s']:.3g} B/s)")
+        print(f"  {e['program']} [{e['phase']}]  "
+              f"{e['seconds']:.4f}s / {e['calls']} call(s)" + tail)
+    comp = rep["compile"]
+    share = comp["share_of_measured"]
+    print(f"compile: {comp['wall_s']:.3f}s wall, "
+          f"{comp['retraces']} (re)trace(s)"
+          + (f", {100.0 * share:.1f}% of measured time"
+             if share is not None else ""))
+    for name, prog in rep["programs"].items():
+        st = prog.get("static")
+        if st is None:
+            continue
+        print(f"  {name}: modeled {st['flops']:.3g} FLOP / "
+              f"{st['bytes']:.3g} B per call"
+              + (" (lower bound)" if st["lower_bound"] else ""))
+    if rep["hbm"]:
+        print(f"hbm watermarks: {rep['hbm']}")
+    if rep["retrace_hazards"]:
+        print(f"RETRACE HAZARDS: {', '.join(rep['retrace_hazards'])}")
+    gate = out.get("gate")
+    if gate:
+        print("bench gate (latest round vs history):")
+        for leg, v in sorted(gate.items()):
+            print(f"  {leg}: {v['line']}")
+
+
+def _selftest(as_json: bool) -> int:
+    import json
+
+    checks: dict[str, str] = {}
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks[name] = "" if ok else (detail or "failed")
+
+    out = run_report(rows=1 << 12, chunk_rows=1 << 10, d=16, max_iters=4)
+    entries = [e for e in out["ledger"]["attribution"]
+               if e["program"].startswith("streamed.")]
+    check("has_streamed_entries", len(entries) >= 3,
+          f"{len(entries)} streamed entries")
+    check("entries_have_static_estimates",
+          bool(entries) and all("flops_modeled" in e and "bytes_modeled"
+                                in e for e in entries),
+          "missing flops/bytes estimates")
+    check("utilization_in_unit_interval",
+          bool(entries) and all(
+              0.0 < e.get("utilization", -1.0) <= 1.0 for e in entries),
+          f"utils: {[e.get('utilization') for e in entries]}")
+    check("measured_durations_positive",
+          bool(entries) and all(e["seconds"] > 0 for e in entries))
+    progs = out["ledger"]["programs"]
+    check("compile_accounting",
+          out["ledger"]["compile"]["retraces"] >= 1
+          and out["ledger"]["compile"]["wall_s"] > 0.0
+          and any(p.get("dispatch_compile_s") or p.get("trace_s")
+                  or p.get("compile_s") for p in progs.values()),
+          "no compile wall recorded")
+
+    # the off-state guarantee, via the registered ContractSpec
+    from photon_tpu.analysis.contracts import REGISTRY, check_contract
+
+    import photon_tpu.profiling.ledger  # noqa: F401 (registers the spec)
+
+    spec = REGISTRY.get("ledger_off_is_free")
+    if spec is None:
+        check("ledger_off_is_free", False, "spec not registered")
+    else:
+        violations = check_contract(spec)
+        check("ledger_off_is_free", not violations,
+              "; ".join(str(v) for v in violations))
+
+    failures = {k: v for k, v in checks.items() if v}
+    if as_json:
+        print(json.dumps({"ok": not failures, "checks": {
+            k: (v or "ok") for k, v in checks.items()}}))
+    else:
+        for k in checks:
+            print(("ok   " if not checks[k] else "FAIL ") + k
+                  + (f": {checks[k]}" if checks[k] else ""))
+        print(f"{len(checks)} check(s), {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _default_env()
+    as_json = "--json" in argv
+    if "--selftest" in argv:
+        return _selftest(as_json)
+    if "--report" in argv:
+        import json
+
+        out = run_report(
+            rows=_flag_value(argv, "--rows", 1 << 14),
+            chunk_rows=_flag_value(argv, "--chunk-rows", 1 << 12),
+            bench_dir=(_flag_value(argv, "--bench-dir", "") or None))
+        if as_json:
+            print(json.dumps(out))
+        else:
+            _render_human(out)
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

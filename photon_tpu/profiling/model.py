@@ -1,0 +1,244 @@
+"""Static per-program cost estimates: the MODELED half of the
+attribution ledger's modeled-vs-measured roofline story.
+
+PR 3's `analysis/walker.py` walks a jaxpr to pin communication/dtype
+LAW; this module rides the same recursive descent to ESTIMATE cost —
+FLOPs from `dot_general`/elementwise/reduction shapes, bytes moved from
+operand avals, collective payload bytes from the collective primitives'
+operands, with `scan` bodies multiplied by their static ``length`` and
+`while` bodies by a caller-supplied trip-count hint (solver loops bound
+their trips by ``max_iters``; an un-hinted while defaults to 1 and the
+estimate is marked a lower bound).
+
+Two deliberate conventions:
+
+- **Per-device view.** Higher-order call eqns (`pjit`, `scan`, `while`,
+  `cond`, `shard_map`, custom-derivative wrappers) contribute nothing
+  themselves — only their leaf equations are costed — so a `shard_map`
+  body is costed at its per-device shapes. Roofline utilization is a
+  per-chip quantity; aggregate = per-chip × mesh size.
+- **Bytes are an operand-traffic proxy.** Each costed leaf equation
+  charges its input + output aval bytes. XLA fuses aggressively, so this
+  OVERSTATES true HBM traffic (intermediate operands of a fused
+  elementwise chain never materialize); the ledger therefore also
+  records XLA's own ``compiled.cost_analysis()`` view where available,
+  and the utilization fraction is computed against the ESTIMATE that
+  binds (the model is a ceiling check, not an exact simulator).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu.analysis.walker import (
+    COLLECTIVE_PRIMITIVES,
+    as_jaxpr,
+    sub_jaxprs,
+)
+
+__all__ = ["StaticCost", "estimate_jaxpr", "estimate_fn", "xla_cost"]
+
+
+# 1 FLOP per output element. Comparison/select/copy ops count here too:
+# they occupy the VPU a lane-cycle each, which is what a roofline cares
+# about (transcendentals are tallied separately below — on TPU they cost
+# several VPU passes, on CPU a libm call).
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "neg", "abs", "sign",
+    "floor", "ceil", "round", "pow", "integer_pow", "rem",
+    "and", "or", "xor", "not", "select_n", "clamp", "nextafter",
+    "eq", "ne", "lt", "le", "gt", "ge", "square",
+    "is_finite", "erf_inv", "copy",
+})
+
+_TRANSCENDENTAL = frozenset({
+    "exp", "log", "log1p", "expm1", "logistic", "tanh", "sqrt", "rsqrt",
+    "sin", "cos", "erf", "lgamma", "digamma", "cbrt",
+})
+
+# Accumulator fills: 1 FLOP per INPUT element.
+_REDUCTION = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "cumsum", "cummax", "cummin", "cumprod",
+    "reduce_window_sum", "argmax", "argmin", "add_any",
+})
+
+# Data movement with no arithmetic: bytes only.
+_MOVEMENT = frozenset({
+    "gather", "scatter", "scatter-add", "scatter-mul", "scatter-min",
+    "scatter-max", "dynamic_slice", "dynamic_update_slice", "slice",
+    "concatenate", "reshape", "broadcast_in_dim", "transpose", "rev",
+    "pad", "squeeze", "convert_element_type", "bitcast_convert_type",
+    "iota", "sort",
+})
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "dtype"):
+        return 0
+    shape = tuple(getattr(aval, "shape", ()))
+    try:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        return n * np.dtype(aval.dtype).itemsize
+    except TypeError:  # symbolic dims: not costable statically
+        return 0
+
+
+def _numel(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = tuple(getattr(aval, "shape", ())) if aval is not None else ()
+    try:
+        return int(np.prod(shape, dtype=np.int64)) if shape else 1
+    except TypeError:
+        return 0
+
+
+def _dot_general_flops(eqn) -> int:
+    """2·batch·M·N·K from the dimension numbers (the MXU convention of
+    counting one multiply + one add per contraction element)."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs = tuple(eqn.invars[0].aval.shape)
+    rhs = tuple(eqn.invars[1].aval.shape)
+    batch = int(np.prod([lhs[i] for i in lb], dtype=np.int64)) if lb else 1
+    K = int(np.prod([lhs[i] for i in lc], dtype=np.int64)) if lc else 1
+    m_dims = [s for i, s in enumerate(lhs) if i not in set(lc) | set(lb)]
+    n_dims = [s for i, s in enumerate(rhs) if i not in set(rc) | set(rb)]
+    M = int(np.prod(m_dims, dtype=np.int64)) if m_dims else 1
+    N = int(np.prod(n_dims, dtype=np.int64)) if n_dims else 1
+    return 2 * batch * M * N * K
+
+
+@dataclasses.dataclass
+class StaticCost:
+    """One program's modeled cost (per call, per device)."""
+
+    flops: float = 0.0
+    bytes: float = 0.0
+    collective_bytes: float = 0.0
+    transcendentals: float = 0.0
+    dot_flops: float = 0.0
+    eqns: int = 0
+    while_loops: int = 0
+    while_trips_assumed: int = 1  # the hint applied to un-lengthed loops
+
+    @property
+    def lower_bound(self) -> bool:
+        """True when the estimate contains a while body costed at the
+        default single trip — real cost is at least this."""
+        return self.while_loops > 0 and self.while_trips_assumed <= 1
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity (FLOPs per byte moved) — the roofline
+        x-axis."""
+        return self.flops / self.bytes if self.bytes > 0 else 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "flops": self.flops, "bytes": self.bytes,
+            "collective_bytes": self.collective_bytes,
+            "transcendentals": self.transcendentals,
+            "dot_flops": self.dot_flops, "eqns": self.eqns,
+            "while_loops": self.while_loops,
+            "while_trips_assumed": self.while_trips_assumed,
+            "intensity": round(self.intensity, 4),
+            "lower_bound": self.lower_bound,
+        }
+
+
+def estimate_jaxpr(jaxpr, while_trips: int = 1) -> StaticCost:
+    """Walk a (Closed)Jaxpr and accumulate the modeled cost. ``while_
+    trips`` is the per-`while` trip-count hint (e.g. a solver's
+    max_iters); `scan` lengths come from the IR itself."""
+    cost = StaticCost(while_trips_assumed=int(while_trips))
+
+    def walk(j, mult: float) -> None:
+        for eqn in as_jaxpr(j).eqns:
+            name = eqn.primitive.name
+            subs = list(sub_jaxprs(eqn))
+            if subs:
+                # call eqns are containers: cost only their leaves
+                sub_mult = mult
+                if name == "scan":
+                    sub_mult = mult * int(eqn.params.get("length", 1))
+                elif name == "while":
+                    cost.while_loops += 1
+                    sub_mult = mult * max(int(while_trips), 1)
+                for sub in subs:
+                    walk(sub, sub_mult)
+                continue
+            cost.eqns += 1
+            io_bytes = (sum(_aval_bytes(v) for v in eqn.invars)
+                        + sum(_aval_bytes(v) for v in eqn.outvars))
+            if name == "dot_general":
+                f = _dot_general_flops(eqn)
+                cost.dot_flops += mult * f
+                cost.flops += mult * f
+                cost.bytes += mult * io_bytes
+            elif name in _ELEMENTWISE:
+                n = max((_numel(v) for v in eqn.outvars), default=0)
+                cost.flops += mult * n
+                cost.bytes += mult * io_bytes
+            elif name in _TRANSCENDENTAL:
+                n = max((_numel(v) for v in eqn.outvars), default=0)
+                cost.flops += mult * n
+                cost.transcendentals += mult * n
+                cost.bytes += mult * io_bytes
+            elif name in _REDUCTION:
+                n = max((_numel(v) for v in eqn.invars), default=0)
+                cost.flops += mult * n
+                cost.bytes += mult * io_bytes
+            elif name in COLLECTIVE_PRIMITIVES:
+                payload = sum(_aval_bytes(v) for v in eqn.invars)
+                cost.collective_bytes += mult * payload
+                cost.flops += mult * sum(_numel(v) for v in eqn.invars)
+                cost.bytes += mult * io_bytes
+            elif name in _MOVEMENT:
+                cost.bytes += mult * io_bytes
+            # anything else (rng, custom calls, ...): uncounted rather
+            # than guessed — the estimate stays a defensible floor
+
+    walk(jaxpr, 1.0)
+    return cost
+
+
+def estimate_fn(fn, args, while_trips: int = 1) -> StaticCost:
+    """Trace ``fn(*args)`` (jax.make_jaxpr — no lowering, no compile)
+    and estimate it. Mirrors `analysis.contracts.trace_contract`'s
+    trace-only discipline: safe on any backend, costs milliseconds."""
+    import jax
+
+    return estimate_jaxpr(jax.make_jaxpr(fn)(*args),
+                          while_trips=while_trips)
+
+
+def xla_cost(fn, args) -> Optional[dict]:
+    """XLA's OWN view of the compiled program: ``flops`` / ``bytes
+    accessed`` from ``compiled.cost_analysis()`` plus the
+    ``memory_analysis()`` sizes. This LOWERS AND COMPILES (unlike
+    everything else in this module) — the ledger only calls it from
+    explicit compile probes, never from hot paths. Returns None when the
+    backend provides no analysis (some plugin backends)."""
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # jax<=0.4.x returns [dict]
+            ca = ca[0] if ca else {}
+        out = {"flops": float(ca.get("flops", 0.0)),
+               "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+               "transcendentals": float(ca.get("transcendentals", 0.0))}
+        try:
+            ma = compiled.memory_analysis()
+            out["temp_bytes"] = int(ma.temp_size_in_bytes)
+            out["argument_bytes"] = int(ma.argument_size_in_bytes)
+            out["output_bytes"] = int(ma.output_size_in_bytes)
+        except Exception:  # noqa: BLE001 — memory stats are best-effort
+            pass
+        return out
+    except Exception:  # noqa: BLE001 — absence of analysis is not an error
+        return None

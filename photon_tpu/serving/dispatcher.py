@@ -34,7 +34,7 @@ from typing import Optional
 
 import numpy as np
 
-from photon_tpu import telemetry
+from photon_tpu import profiling, telemetry
 from photon_tpu.data.matrix import SparseRows
 from photon_tpu.serving.programs import ProgramLadder
 from photon_tpu.serving.store import CoefficientStore
@@ -237,8 +237,13 @@ class MicroBatchDispatcher:
         try:
             with telemetry.span("serving.flush", rows=n):
                 bucket = self.ladder.bucket_for(n)
-                offsets, shards, ids, misses = self._collate(batch, bucket)
-                out_dev = self.ladder.score_padded(offsets, shards, ids)
+                # per-rung attribution: collate + dispatch wall (the
+                # device readback is the retire thread's, measured by
+                # the request-latency percentiles)
+                with profiling.measure(f"serving.rung_{bucket}", "flush"):
+                    offsets, shards, ids, misses = self._collate(batch,
+                                                                 bucket)
+                    out_dev = self.ladder.score_padded(offsets, shards, ids)
             telemetry.count("serving.requests", n)
             telemetry.count("serving.batches")
             telemetry.count("serving.batch_rows", n)

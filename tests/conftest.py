@@ -19,6 +19,23 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", False)
+# Persistent XLA compilation cache for the suite (the round-5 driver
+# feature, applied to CI): the tier-1 wall is compile-dominated — the GP
+# tuner alone retraces its fit across ~100 growing training-set shapes —
+# and the 870 s budget is thin on a contended box, so repeat runs load
+# executables from disk instead of recompiling. Artifacts are keyed by
+# jax on program+flags, so numerics are identical to a cold compile;
+# only programs over the min-compile-time threshold are stored (tiny
+# jits stay out of the cache). Override the location with
+# PHOTON_TPU_TEST_CACHE_DIR; set it empty to disable.
+_cache_dir = os.environ.get("PHOTON_TPU_TEST_CACHE_DIR",
+                            "/tmp/photon_tpu_xla_test_cache")
+if _cache_dir:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.9)
+    except Exception:  # older/newer jax without the flags: run uncached
+        pass
 # The axon TPU plugin overrides JAX_PLATFORMS env filtering with its own
 # jax_platforms='axon,cpu'; force plain CPU *before* any backend init so the
 # suite never touches (or blocks on) the TPU tunnel.
@@ -33,6 +50,19 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "cpu_parity_drift: one of the 6 triaged grid/lane/permuted parity "
+        "assertions that fail ONLY on this container's jax 0.4.37 CPU "
+        "backend (reduction-order drift between compilation paths — see "
+        "ADVICE.md round-8 triage). NOT a skip/xfail: pass/fail behavior "
+        "is unchanged; the marker exists so reports and -m selections "
+        "can name the set (verify on a real TPU backend before loosening "
+        "any tolerance).")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 suite (-m 'not slow'); "
+        "long-running end-to-end checks like the umbrella selfcheck.")
     config.addinivalue_line(
         "markers",
         "release_programs: drop this module's compiled XLA programs at "

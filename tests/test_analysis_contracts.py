@@ -24,12 +24,12 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 24 specs (round 10 added the checkpoint-off pins) spanning every
-    workload family, now including the checkpoint snapshot tap."""
-    assert len(_REGISTRY) >= 24
+    """≥ 25 specs (round 11 added the ledger-off pin) spanning every
+    workload family, now including the profiling attribution ledger."""
+    assert len(_REGISTRY) >= 25
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
-                   "serving", "checkpoint"):
+                   "serving", "checkpoint", "profiling"):
         assert family in tags, f"no contract covers the {family} family"
 
 
@@ -46,6 +46,19 @@ def test_checkpoint_off_specs_are_registered():
         assert dict(spec.collectives or {}) == {}
         assert not spec.allow_transfers and not spec.allow_f64
         assert TRANSFER_PRIMITIVES <= spec.forbid
+
+
+def test_ledger_off_spec_is_registered():
+    """Disarmed profiling must add ZERO transfer/callback primitives to
+    jitted solver programs — the attribution-ledger round's acceptance
+    pin, same strictness as the telemetry/checkpoint off-specs."""
+    from photon_tpu.analysis.walker import TRANSFER_PRIMITIVES
+
+    spec = _REGISTRY["ledger_off_is_free"]
+    assert dict(spec.collectives or {}) == {}
+    assert not spec.allow_transfers and not spec.allow_f64
+    assert TRANSFER_PRIMITIVES <= spec.forbid
+    assert "profiling" in spec.tags
 
 
 def test_checkpoint_selftest_cli_end_to_end():

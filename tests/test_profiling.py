@@ -1,0 +1,346 @@
+"""The attribution-ledger round's tier-1 coverage.
+
+Three planes:
+
+- `sentinel` — the noise-aware bench gate's acceptance matrix, on
+  SYNTHETIC histories (pure python, no jax): a genuine regression is
+  caught, normal best-of noise passes, a brand-new leg is admitted
+  without tripping, a missing/short history degrades to warn-only, and
+  lower-is-better legs gate in the right direction — plus the
+  `bench.py --gate` CLI end to end (exit 1 on a synthetically regressed
+  trajectory, exit 0 on the repo's real one: THE acceptance bars).
+- `model` — static cost estimates are the arithmetic they claim:
+  dot_general FLOPs from dimension numbers, scan-length multipliers,
+  while-trip hints, collective payload bytes.
+- `ledger` — attribution + utilization ∈ (0, 1] on a real instrumented
+  streamed solve, compile accounting, detached-state no-ops, and the
+  `python -m photon_tpu.profiling --report --json` CLI (the acceptance
+  criterion's exact command) as a subprocess.
+
+The umbrella selfcheck (5 subprocesses) is marked ``slow`` — tier-1
+runs ``-m 'not slow'`` and each sub-CLI is already exercised on its own.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_tpu import profiling
+from photon_tpu.profiling import sentinel
+
+# Deliberately NOT release_programs-marked: this module compiles only a
+# handful of tiny single-device programs (the 96×5 streamed solve shares
+# shapes with test_telemetry's), and the marker's module-teardown
+# jax.clear_caches() would force every LATER module to recompile —
+# tens of seconds against the tier-1 870 s budget.
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ sentinel
+def _wrap(legs, metric=None, value=None):
+    parsed = {"legs": dict(legs)}
+    if metric is not None:
+        parsed["metric"], parsed["value"] = metric, value
+    return {"n": 5, "rc": 0, "parsed": parsed}
+
+
+def _history(leg="dense_rate", base=1e8, jitter=(1.0, 1.02, 0.98, 1.01, 0.99)):
+    return [(f"BENCH_r{i:02d}.json", {leg: base * j})
+            for i, j in enumerate(jitter, start=1)]
+
+
+class TestSentinel:
+    def test_regression_is_caught(self):
+        hist = _history()
+        v = sentinel.gate({"dense_rate": 0.5e8}, hist)["dense_rate"]
+        assert v.status == "regressed" and v.z > sentinel.DEFAULT_Z
+
+    def test_normal_noise_passes(self):
+        hist = _history()
+        for wobble in (0.95, 1.0, 1.05, 1.25):
+            v = sentinel.gate({"dense_rate": 1e8 * wobble},
+                              hist)["dense_rate"]
+            assert v.status == "ok", (wobble, v.to_json())
+
+    def test_improvement_never_trips(self):
+        v = sentinel.gate({"dense_rate": 5e8}, _history())["dense_rate"]
+        assert v.status == "ok"
+
+    def test_new_leg_admitted_without_tripping(self):
+        verdicts = sentinel.gate({"dense_rate": 1e8, "brand_new_leg": 1.0},
+                                 _history())
+        assert verdicts["brand_new_leg"].status == "new"
+        assert verdicts["dense_rate"].status == "ok"
+
+    def test_short_history_degrades_to_warn_only(self):
+        short = _history(jitter=(1.0, 1.01))  # < MIN_HISTORY rounds
+        v = sentinel.gate({"dense_rate": 0.1e8}, short)["dense_rate"]
+        assert v.status == "new"  # admitted, never "regressed"
+
+    def test_missing_history_degrades_to_warn_only(self):
+        v = sentinel.gate({"dense_rate": 0.1e8}, [])["dense_rate"]
+        assert v.status == "no-history"
+
+    def test_lower_better_legs_gate_in_the_right_direction(self):
+        hist = _history(leg="serving_p99_ms", base=2.0)
+        worse = sentinel.gate({"serving_p99_ms": 9.0}, hist)
+        better = sentinel.gate({"serving_p99_ms": 0.5}, hist)
+        assert worse["serving_p99_ms"].status == "regressed"
+        assert better["serving_p99_ms"].status == "ok"
+
+    def test_config_legs_are_not_gated(self):
+        hist = _history(leg="streamed_mesh_n_chips", base=8.0)
+        verdicts = sentinel.gate({"streamed_mesh_n_chips": 4.0}, hist)
+        assert "streamed_mesh_n_chips" not in verdicts
+
+    def test_leg_values_flattens_headline_and_skips_dups(self):
+        legs = sentinel.leg_values({
+            "metric": "headline", "value": 2.0,
+            "legs": {"a": 1.0, "a_vs_baseline": 0.1, "b": True}})
+        assert legs == {"headline": 2.0, "a": 1.0}
+
+    def test_history_loader_tolerates_null_and_garbage(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text('{"parsed": null}')
+        (tmp_path / "BENCH_r02.json").write_text("not json")
+        (tmp_path / "BENCH_r03.json").write_text(
+            json.dumps(_wrap({"a": 1.0})))
+        hist = sentinel.load_history(str(tmp_path))
+        assert hist == [("BENCH_r03.json", {"a": 1.0})]
+
+    def _write_rounds(self, tmp_path, values, leg="rate"):
+        for i, v in enumerate(values, start=1):
+            (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+                json.dumps(_wrap({leg: v})))
+
+    def test_gate_main_exit_codes(self, tmp_path, capsys):
+        # regressed trajectory: last round collapses -> exit 1, with a
+        # one-line verdict per leg in the output
+        self._write_rounds(tmp_path, [1e8, 1.01e8, 0.99e8, 1.02e8, 0.4e8])
+        rc = sentinel.gate_main(["--gate"], bench_dir=str(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 1 and "rate: regressed" in out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["regressed"] == ["rate"] and not doc["ok"]
+        # healthy trajectory -> exit 0
+        self._write_rounds(tmp_path, [1e8, 1.01e8, 0.99e8, 1.02e8, 1.05e8])
+        assert sentinel.gate_main(["--gate"],
+                                  bench_dir=str(tmp_path)) == 0
+
+    def test_gate_real_trajectory_passes(self, capsys):
+        """The gate over the repo's own BENCH_r0*.json history exits 0
+        (the acceptance bar) — in-process; the bench.py CLI wiring is
+        covered once by the synthetic-regression subprocess below."""
+        rc = sentinel.gate_main(["--gate"], bench_dir=_REPO)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["ok"] and doc["schema"] == sentinel.SCHEMA_VERSION
+
+    def test_bench_gate_cli_synthetic_regression(self, tmp_path):
+        """bench.py --gate --gate-dir <regressed trajectory>: exit 1."""
+        self._write_rounds(tmp_path, [1e8, 1.0e8, 1.01e8, 0.99e8, 0.3e8])
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py"), "--gate",
+             "--gate-dir", str(tmp_path)],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------------------------- model
+class TestStaticModel:
+    def test_dot_general_flops(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.zeros((32, 8), jnp.float32)
+        w = jnp.zeros((8, 4), jnp.float32)
+        cost = profiling.estimate_fn(lambda a, b: a @ b, (x, w))
+        assert cost.dot_flops == 2 * 32 * 8 * 4
+        # operand-traffic proxy: inputs + outputs of the matmul
+        assert cost.bytes >= (32 * 8 + 8 * 4 + 32 * 4) * 4
+        del jax
+
+    def test_elementwise_and_transcendental(self):
+        import jax.numpy as jnp
+
+        x = jnp.zeros((64,), jnp.float32)
+        cost = profiling.estimate_fn(lambda a: jnp.tanh(a * 2.0), (x,))
+        assert cost.transcendentals == 64
+        assert cost.flops >= 128  # mul + tanh
+
+    def test_scan_length_multiplies(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(xs):
+            return jax.lax.scan(lambda c, x: (c + x, x * 2.0),
+                                jnp.zeros((16,)), xs)
+
+        cost = profiling.estimate_fn(f, (jnp.zeros((5, 16)),))
+        # 5 trips x (add 16 + mul 16) = 160 elementwise FLOPs
+        assert cost.flops == 5 * 32
+
+    def test_while_trip_hint(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jax.lax.while_loop(lambda c: c[1] < 3,
+                                      lambda c: (c[0] * 2.0, c[1] + 1),
+                                      (x, 0))
+
+        x = jnp.zeros((16,), jnp.float32)
+        c1 = profiling.estimate_fn(f, (x,), while_trips=1)
+        c10 = profiling.estimate_fn(f, (x,), while_trips=10)
+        assert c1.while_loops == 1 and c1.lower_bound
+        assert not c10.lower_bound
+        assert c10.flops > c1.flops  # body cost scales with the hint
+
+    def test_collective_payload_bytes(self):
+        import jax
+
+        fn = lambda x: jax.lax.psum(x, "i")  # noqa: E731
+        closed = jax.make_jaxpr(fn, axis_env=[("i", 4)])(
+            np.zeros((128,), np.float32))
+        cost = profiling.estimate_jaxpr(closed)
+        assert cost.collective_bytes == 128 * 4
+
+
+# ------------------------------------------------------------------- ledger
+class TestLedger:
+    def test_detached_is_noop(self):
+        assert profiling.current_ledger() is None
+        assert not profiling.enabled()
+        assert not profiling.needs_note("anything")
+        with profiling.measure("p", "ph") as m:
+            assert m is None
+        profiling.attribute("p", "ph", 1.0)  # no-op, no error
+        profiling.record_signature("p", (1.0,))
+
+    def test_attribution_and_utilization(self):
+        import jax.numpy as jnp
+
+        with profiling.ledger("t", peaks=(1e9, 1e9)) as led:
+            x = jnp.zeros((64, 64), jnp.float32)
+            led.note_program("mm", lambda a: a @ a, (x,))
+            led.attribute("mm", "phase", 0.01, calls=10)
+            rep = led.report()
+        (entry,) = rep["attribution"]
+        assert entry["program"] == "mm" and entry["calls"] == 10
+        assert entry["flops_modeled"] == 10 * 2 * 64 ** 3
+        assert 0.0 < entry["utilization"] <= 1.0
+        assert entry["bound"] in ("compute", "bandwidth")
+        # the note's trace enters both compile accounts
+        assert rep["programs"]["mm"]["retraces"] == 1
+        assert rep["compile"]["wall_s"] > 0.0
+
+    def test_utilization_clamped_into_unit_interval(self):
+        import jax.numpy as jnp
+
+        with profiling.ledger("t", peaks=(1.0, 1.0)) as led:  # absurd peaks
+            x = jnp.zeros((8, 8), jnp.float32)
+            led.note_program("mm", lambda a: a @ a, (x,))
+            led.attribute("mm", "phase", 1e-6)
+            entry = led.report()["attribution"][0]
+        assert entry["utilization"] == 1.0
+
+    def test_dispatch_books_compile_on_new_signature_only(self):
+        import jax.numpy as jnp
+
+        with profiling.ledger("t") as led:
+            x = jnp.zeros((4,), jnp.float32)
+            with led.dispatch("prog", (x,)):
+                pass
+            with led.dispatch("prog", (x,)):  # same signature: no retrace
+                pass
+            with led.dispatch("prog", (jnp.zeros((8,), jnp.float32),)):
+                pass
+            rep = led.report()
+        prog = rep["programs"]["prog"]
+        assert prog["retraces"] == 2
+        entry = rep["attribution"][0]
+        assert entry["phase"] == "dispatch" and entry["calls"] == 3
+
+    def test_note_error_is_contained(self):
+        with profiling.ledger("t") as led:
+            led.note_program("bad", lambda: 1 / 0, ())
+            rep = led.report()
+        assert "ZeroDivisionError" in rep["programs"]["bad"]["note_error"]
+
+    def test_instrumented_streamed_solve(self):
+        """The tentpole wiring end to end IN-PROCESS: a streamed-dense
+        train_glm under an attached ledger yields per-program entries
+        with static estimates, measured durations, and utilization in
+        (0, 1] — and zero ledger entries when detached."""
+        from photon_tpu.data.dataset import chunk_batch, make_batch
+        from photon_tpu.models.training import train_glm
+        from photon_tpu.ops.losses import TaskType
+        from photon_tpu.optim.config import OptimizerConfig
+        from photon_tpu.optim.regularization import l2
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(96, 5)).astype(np.float32)
+        y = (rng.uniform(size=96) < 0.5).astype(np.float32)
+        cb = chunk_batch(make_batch(X, y), 32)
+        cfg = OptimizerConfig(max_iters=4, tolerance=1e-7, reg=l2(),
+                              reg_weight=0.1, history=3)
+        with profiling.ledger("solve") as led:
+            train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+            rep = led.report()
+        entries = [e for e in rep["attribution"]
+                   if e["program"].startswith("streamed.")]
+        assert len(entries) >= 2  # init + direction at minimum
+        for e in entries:
+            assert e["seconds"] > 0.0
+            assert e["flops_modeled"] > 0.0 and e["bytes_modeled"] > 0.0
+            assert 0.0 < e["utilization"] <= 1.0
+        assert rep["compile"]["retraces"] >= 1
+
+    def test_report_cli_json(self):
+        """`python -m photon_tpu.profiling --report --json` — THE
+        acceptance command — on a small streamed-dense run: every
+        streamed attribution entry carries static FLOP/byte estimates,
+        a measured duration, and a utilization fraction in (0, 1]."""
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # the CLI self-provisions its platform
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_tpu.profiling", "--report",
+             "--json", "--rows", "2048", "--chunk-rows", "512"],
+            capture_output=True, text=True, env=env, cwd=_REPO,
+            timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        entries = [e for e in doc["ledger"]["attribution"]
+                   if e["program"].startswith("streamed.")]
+        assert entries, doc["ledger"]["attribution"]
+        for e in entries:
+            assert e["seconds"] > 0.0
+            assert e["flops_modeled"] > 0.0 and e["bytes_modeled"] > 0.0
+            assert 0.0 < e["utilization"] <= 1.0
+        assert doc["ledger"]["compile"]["retraces"] >= 1
+        # the gate verdicts ride along (the repo has a BENCH history)
+        assert doc["gate"]
+
+
+@pytest.mark.slow
+def test_umbrella_selfcheck_cli():
+    """`python -m photon_tpu --selfcheck --json`: the four existing
+    selftests + the profiling smoke aggregate into one verdict."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_tpu", "--selfcheck", "--json"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["ok"]
+    assert set(doc["suites"]) == {"analysis", "telemetry", "serving",
+                                  "checkpoint", "profiling"}
