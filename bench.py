@@ -77,7 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.dataset import cast_features, chunk_batch, make_batch
-from photon_tpu.data.matrix import SparseRows, to_permuted_hybrid
+from photon_tpu.data.matrix import SparseRows, to_blocked_ell
 from photon_tpu.models.training import train_glm, train_glm_grid
 from photon_tpu.ops.losses import TaskType
 from photon_tpu.optim.config import OptimizerConfig
@@ -119,7 +119,8 @@ REPS = 5  # keep the best: tunnel throughput drifts ±30% between runs
 
 
 def sparse_problem(seed: int = 0, rows: int = S_ROWS):
-    """Power-law 10M-feature logistic rows with a planted hot-end signal."""
+    """(batch, layout stats) — power-law 10M-feature logistic rows with a
+    planted hot-end signal."""
     rng = np.random.default_rng(seed)
     n, k, d = rows, S_NNZ, S_FEATURES
     col = (rng.zipf(S_ZIPF, size=(n, k)).astype(np.int64) - 1) % (d - 1)
@@ -138,13 +139,26 @@ def sparse_problem(seed: int = 0, rows: int = S_ROWS):
     # nnz) instead of the materialized 4.3 GB bf16 block (~5x fewer
     # bytes) — data load dropped from minutes to ~23 s over the tunnel.
     # Tail/scalars still cast bf16 on host first (cast_features), then
-    # one device_put. PermutedHybridRows (round 5) keeps both X passes
-    # scatter-free — TPU scatter-adds are the measured wall (~12 ns/elem
-    # vs ~7 ns/index gathers; docs/PERF.md) — while the solver still works
-    # in the full R^10M space.
-    H = to_permuted_hybrid(SparseRows(ind, va, d), S_DENSE,
-                           device_dense_dtype=jnp.bfloat16)
-    return jax.device_put(cast_features(make_batch(H, y)))
+    # one device_put. BlockedEllRows (round 12) keeps both X passes
+    # scatter-free AND scan-free: the tail matvec is pow2-width ELL row
+    # buckets (gather + dense einsum, bf16 multiply / f32 accumulate)
+    # instead of round 5's full-tail cumsum — TPU scatter-adds are the
+    # measured wall (~12 ns/elem vs ~7 ns/index gathers; docs/PERF.md)
+    # and the cumsum scan was the residual tail cost. The solver still
+    # works in the full R^10M space.
+    H = to_blocked_ell(SparseRows(ind, va, d), S_DENSE,
+                       device_dense_dtype=jnp.bfloat16)
+    total_nnz = n * (k + 1)
+    stats = {
+        # hot/tail split + pow2 pad waste of the blocked-ELL tail: layout
+        # facts (not wall-clocks) that make the sparse legs' cost model
+        # auditable from the JSON line alone.
+        "sparse10m_tail_pad_waste": round(float(H.tail_pad_waste), 4),
+        "sparse10m_tail_nnz_frac": round(H.tail_nnz / total_nnz, 4),
+        "sparse10m_hot_nnz_frac": round(1.0 - H.tail_nnz / total_nnz, 4),
+        "sparse10m_ell_width_buckets": len(H.ell_vals),
+    }
+    return jax.device_put(cast_features(make_batch(H, y))), stats
 
 
 def dense_problem(seed: int = 0):
@@ -581,7 +595,7 @@ def main() -> None:
     run = telemetry.start_run("bench", jsonl_path=_telemetry_out_path())
     profiling.start_ledger("bench")
     with telemetry.span("leg.sparse_data"):
-        batch = sparse_problem()
+        batch, sparse_stats = sparse_problem()
     with telemetry.span("leg.sparse_grid8"):
         grid_value = run_sparse_grid(batch)
     with telemetry.span("leg.sparse_single"):
@@ -628,6 +642,10 @@ def main() -> None:
                 round(single_value, 1),
             "sparse10m_single_lane_vs_baseline": round(single_value / base,
                                                        3),
+            # blocked-ELL layout facts (round 12): pad waste is gated
+            # lower-better by the sentinel; the split/bucket legs are
+            # config facts the sentinel excludes from gating.
+            **sparse_stats,
             "dense_grid16_rows_iters_per_sec_per_chip": round(dense_value, 1),
             "dense_grid16_vs_baseline": round(dense_value / base, 3),
             "dense_grid256_rows_iters_per_sec_per_chip":

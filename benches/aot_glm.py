@@ -47,7 +47,7 @@ def main() -> None:
     from photon_tpu.optim.regularization import l2
 
     t0 = time.perf_counter()
-    batch = bench.sparse_problem(rows=args.rows)
+    batch, _ = bench.sparse_problem(rows=args.rows)
     jax.block_until_ready(batch.X.dense)
     t_data = time.perf_counter() - t0
 

@@ -71,7 +71,7 @@ def main() -> None:
     if args.leg == "sparse":
         rows = args.rows or bench.S_ROWS
         t0 = time.perf_counter()
-        batch = bench.sparse_problem(rows=rows)
+        batch, _ = bench.sparse_problem(rows=rows)
         jax.block_until_ready(batch.X.dense)
         print(f"sparse problem ({rows} rows x {bench.S_FEATURES} features) "
               f"loaded in {time.perf_counter() - t0:.0f}s")

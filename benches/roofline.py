@@ -38,7 +38,7 @@ def main() -> None:
     results = []
     for n in args.rows:
         t0 = time.perf_counter()
-        batch = bench.sparse_problem(rows=n)
+        batch, _ = bench.sparse_problem(rows=n)
         jax.block_until_ready(batch.X.dense)
         t_load = time.perf_counter() - t0
         value = bench.run_sparse(batch)

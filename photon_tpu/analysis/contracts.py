@@ -53,6 +53,14 @@ class ContractSpec:
     allow_transfers: bool = False
     allow_f64: bool = False
     allow_weak_args: bool = False
+    # Strict f32-accumulation pin (the round-12 sparse dtype rule): EVERY
+    # floating dot_general must OUTPUT float32 (bf16 inputs are fine —
+    # that is the MXU recipe; a bf16/f16 output means the accumulator was
+    # narrowed) and every accumulating reduction (reduce_sum / cumsum /
+    # psum / ...) must run on f32 operands. The default dtype rule only
+    # rejects bf16×bf16→bf16; this flag also rejects mixed-input dots
+    # whose accumulator silently follows a narrow operand.
+    require_f32_accum: bool = False
     tags: tuple = ()
 
 
@@ -64,7 +72,8 @@ def register_contract(name: str, *, description: str = "",
                       collectives: Optional[Mapping[str, int]] = None,
                       forbid=frozenset(), max_const_bytes: int = 1 << 20,
                       allow_transfers: bool = False, allow_f64: bool = False,
-                      allow_weak_args: bool = False, tags: tuple = ()):
+                      allow_weak_args: bool = False,
+                      require_f32_accum: bool = False, tags: tuple = ()):
     """Decorator: register the decorated zero-arg builder as ``name``.
 
     ::
@@ -81,7 +90,8 @@ def register_contract(name: str, *, description: str = "",
             collectives=collectives, forbid=frozenset(forbid),
             max_const_bytes=max_const_bytes,
             allow_transfers=allow_transfers, allow_f64=allow_f64,
-            allow_weak_args=allow_weak_args, tags=tuple(tags))
+            allow_weak_args=allow_weak_args,
+            require_f32_accum=require_f32_accum, tags=tuple(tags))
         if name in REGISTRY:
             raise ValueError(f"duplicate contract name: {name!r}")
         REGISTRY[name] = spec

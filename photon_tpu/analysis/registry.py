@@ -14,6 +14,7 @@ import importlib
 # Every module that registers ContractSpecs. Order is import order only;
 # the registry itself is a flat name -> spec mapping.
 HOT_PATH_MODULES = (
+    "photon_tpu.data.matrix",         # blocked-ELL scatter-free X passes
     "photon_tpu.ops.objective",       # resident evaluation + trial programs
     "photon_tpu.parallel.mesh",       # shard_map value_and_grad (1-D, hybrid)
     "photon_tpu.models.training",     # resident/lane solvers, sharded hybrids
@@ -25,6 +26,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.serving.programs",    # online per-request scoring ladder
     "photon_tpu.checkpoint.taps",     # checkpoint-off-is-free guarantee
     "photon_tpu.profiling.ledger",    # ledger-off-is-free guarantee
+    "photon_tpu.evaluation.grouped",  # scatter-free per-entity metrics
 )
 
 

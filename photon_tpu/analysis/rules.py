@@ -152,6 +152,22 @@ def rule_dtype_policy(t: TracedContract) -> list[Violation]:
                     "bf16 x bf16 -> bf16 dot_general (pass "
                     "preferred_element_type=float32: bf16 matmul must "
                     "accumulate f32 on the MXU)", site.where))
+            elif getattr(spec, "require_f32_accum", False) \
+                    and outd in ("bfloat16", "float16"):
+                # the strict round-12 sparse pin: ANY narrow-accumulator
+                # dot (even mixed-input) is a policy breach on this spec
+                out.append(Violation(
+                    "dtype-policy", spec.name,
+                    f"dot_general accumulates {outd} on a "
+                    "require_f32_accum program (every sparse dot/einsum "
+                    "must output float32)", site.where))
+        if getattr(spec, "require_f32_accum", False) \
+                and site.name in _ACCUMULATING and dtypes \
+                and dtypes[0] in ("float16",):
+            out.append(Violation(
+                "dtype-policy", spec.name,
+                f"`{site.name}` accumulates in {dtypes[0]} on a "
+                "require_f32_accum program", site.where))
     if f64_hits:
         out.append(Violation(
             "dtype-policy", spec.name,

@@ -38,11 +38,14 @@ TRANSFER_PRIMITIVES = frozenset({
     "debug_callback",
 })
 
-# Combining scatters: the measured TPU wall the permuted layouts eliminate
-# by construction (~12 ns/element scatter-add vs ~7 ns/index gather,
-# docs/PERF.md) — pinned via `ContractSpec.forbid` on scatter-free paths.
+# Combining scatters: the measured TPU wall the permuted/blocked-ELL
+# layouts eliminate by construction (~12 ns/element scatter-add vs
+# ~7 ns/index gather, docs/PERF.md) — pinned via `ContractSpec.forbid` on
+# scatter-free paths. scatter-sub is jax's subtraction combiner (same
+# read-modify-write lowering as scatter-add).
 SCATTER_ADD_PRIMITIVES = frozenset({
-    "scatter-add", "scatter-mul", "scatter-min", "scatter-max",
+    "scatter-add", "scatter-sub", "scatter-mul", "scatter-min",
+    "scatter-max",
 })
 
 # The full family. NOTE: `.at[i].set(x)` with a scalar index traces to a
@@ -53,6 +56,13 @@ SCATTER_ADD_PRIMITIVES = frozenset({
 SCATTER_PRIMITIVES = SCATTER_ADD_PRIMITIVES | frozenset({
     "scatter", "scatter_apply",
 })
+
+# Irregular random-access READS — the other half of the scatter/gather
+# taxonomy. Not forbidden anywhere (gathers are the ~7 ns/index GOOD case
+# the blocked layouts are built on); profiling/model.py keys its
+# random-access byte costing on this set so sparse-program rooflines are
+# honest about per-index traffic instead of charging whole-table bytes.
+GATHER_PRIMITIVES = frozenset({"gather", "dynamic_slice"})
 
 # Bodies of these run many times per dispatch: a transfer inside is a
 # per-iteration stall, not a one-off.

@@ -15,12 +15,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.matrix import (
+    BlockedEllRows,
     HybridRows,
     Matrix,
     PermutedHybridRows,
+    ShardedBlockedEllRows,
     ShardedHybridRows,
     ShardedPermutedHybridRows,
     SparseRows,
+    shard_blocked_ell,
     shard_hybrid,
 )
 
@@ -44,7 +47,8 @@ def make_batch(X, y, weights=None, offsets=None) -> GLMBatch:
     if offsets is None:
         offsets = jnp.zeros((n,), jnp.float32)
     if not isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
-                          PermutedHybridRows, ShardedPermutedHybridRows)):
+                          PermutedHybridRows, ShardedPermutedHybridRows,
+                          BlockedEllRows, ShardedBlockedEllRows)):
         import jax
 
         # host numpy transfers as f32; an already-device FLOATING array
@@ -66,10 +70,12 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
         return batch
     extra = target_n - n
     X = batch.X
-    if isinstance(X, (ShardedHybridRows, ShardedPermutedHybridRows)):
+    if isinstance(X, (ShardedHybridRows, ShardedPermutedHybridRows,
+                      ShardedBlockedEllRows)):
         raise ValueError(
             "cannot pad a sharded batch (per-shard tails are already laid "
-            "out); pad before shard_hybrid_batch/shard_permuted_batch")
+            "out); pad before shard_hybrid_batch/shard_permuted_batch/"
+            "shard_blocked_ell_batch")
     if isinstance(X, HybridRows):
         import dataclasses
 
@@ -93,6 +99,20 @@ def pad_batch(batch: GLMBatch, target_n: int) -> GLMBatch:
                 [jnp.asarray(X.row_bounds),
                  jnp.full((extra,), jnp.asarray(X.row_bounds)[-1],
                           jnp.asarray(X.row_bounds).dtype)]))
+    elif isinstance(X, BlockedEllRows):
+        import dataclasses
+
+        # Padding rows have no tail nnz: the dense block grows and the
+        # new rows' row_pos point at the appended zero slot (index B).
+        B = sum(int(v.shape[0]) for v in X.ell_vals)
+        X = dataclasses.replace(
+            X,
+            dense=jnp.concatenate(
+                [X.dense, jnp.zeros((extra, X.dense.shape[1]),
+                                    X.dense.dtype)]),
+            row_pos=jnp.concatenate(
+                [jnp.asarray(X.row_pos),
+                 jnp.full((extra,), B, jnp.asarray(X.row_pos).dtype)]))
     elif isinstance(X, SparseRows):
         X = SparseRows(
             jnp.concatenate([X.indices, jnp.zeros((extra, X.indices.shape[1]), jnp.int32)]),
@@ -144,6 +164,25 @@ def shard_permuted_batch(batch: GLMBatch, n_shards: int,
         batch.X, n_shards, d_dense, device_dense_dtype=device_dense_dtype))
 
 
+def shard_blocked_ell_batch(batch: GLMBatch, n_shards: int,
+                            d_dense: int = 1024,
+                            device_dense_dtype=None) -> GLMBatch:
+    """Pad a sparse batch to the mesh and re-lay its X as
+    ShardedBlockedEllRows (data.matrix.shard_blocked_ell): the mesh-ready
+    form of the blocked-ELL layout — each device gets its own ELL row
+    buckets + occurrence buckets under one global column permutation, so
+    the sharded solve compiles to one all-reduce and zero scatters of any
+    kind (models/training's `sharded_blocked_ell_value_and_grad`
+    contract)."""
+    from photon_tpu.parallel.mesh import pad_to_multiple
+
+    if not isinstance(batch.X, SparseRows):
+        raise TypeError("shard_blocked_ell_batch expects SparseRows")
+    batch = pad_batch(batch, pad_to_multiple(batch.n, n_shards))
+    return batch._replace(X=shard_blocked_ell(
+        batch.X, n_shards, d_dense, device_dense_dtype=device_dense_dtype))
+
+
 def with_offsets(batch: GLMBatch, offsets) -> GLMBatch:
     return batch._replace(offsets=jnp.asarray(offsets, jnp.float32))
 
@@ -155,7 +194,17 @@ def cast_features(batch: GLMBatch, dtype=jnp.bfloat16) -> GLMBatch:
     (data.matrix matvec/rmatvec use preferred_element_type=float32).
     Labels/weights/offsets and all solver state stay f32."""
     X = batch.X
-    if isinstance(X, (PermutedHybridRows, ShardedPermutedHybridRows)):
+    if isinstance(X, (BlockedEllRows, ShardedBlockedEllRows)):
+        import dataclasses
+
+        # Every value leaf (hot block, ELL tail, occurrence buckets)
+        # recasts; matvec/rmatvec then MULTIPLY in the storage dtype and
+        # accumulate f32 (the blocked_ell_x_passes contract pins it).
+        X = dataclasses.replace(
+            X, dense=X.dense.astype(dtype),
+            ell_vals=tuple(v.astype(dtype) for v in X.ell_vals),
+            bucket_vals=tuple(v.astype(dtype) for v in X.bucket_vals))
+    elif isinstance(X, (PermutedHybridRows, ShardedPermutedHybridRows)):
         import dataclasses
 
         X = dataclasses.replace(
@@ -193,23 +242,37 @@ def total_weight(batch: GLMBatch) -> float:
 class ChunkedMatrix:
     """A design matrix as HOST-resident uniform row chunks.
 
-    `chunks` are numpy dense (c, d) blocks or host-backed SparseRows with a
-    shared nnz width — every chunk the same shape, so the per-chunk device
-    programs compile exactly once. The LAST chunk is padded with all-zero
-    rows up to the chunk height (`n_real` marks where real rows end; the
-    owning ChunkedBatch gives pad rows weight 0, so every reduction ignores
-    them). Hybrid/permuted layouts are deliberately unsupported: their value
-    is device-side locality, and a host-chunked solve re-uploads every pass
-    anyway — SparseRows/dense are the streaming-native forms.
+    `chunks` are numpy dense (c, d) blocks, host-backed SparseRows with a
+    shared nnz width, or host-backed BlockedEllRows cut from ONE
+    `shard_blocked_ell` ladder (`chunk_blocked_ell`) — every chunk the
+    same shape, so the per-chunk device programs compile exactly once.
+    The LAST chunk is padded with all-zero rows up to the chunk height
+    (`n_real` marks where real rows end; the owning ChunkedBatch gives pad
+    rows weight 0, so every reduction ignores them).
+
+    Blocked-ELL chunks carry the ladder's GLOBAL column permutation in
+    `perm_cols`/`inv_perm`/`last_col_pos` — chunk partials then accumulate
+    in ONE shared permuted (d,)-space across the whole stream, and
+    models.training translates at its public boundary exactly as for the
+    resident permuted layouts. The other device-locality layouts
+    (Hybrid/Permuted) stay deliberately unsupported: without a shared
+    cross-chunk permutation their per-chunk gradients would not align.
     """
 
-    chunks: tuple  # host numpy (c, d) blocks or host SparseRows, uniform
+    chunks: tuple  # host numpy / SparseRows / BlockedEllRows, uniform
     n_real: int  # real rows (pre-padding)
     n_features: int
+    perm_cols: object = None      # (d,) np.int32 — blocked-ELL chunks only
+    inv_perm: object = None       # (d,) np.int32 — blocked-ELL chunks only
+    last_col_pos: int | None = None
 
     @property
     def n_chunks(self) -> int:
         return len(self.chunks)
+
+    @property
+    def permuted(self) -> bool:
+        return self.perm_cols is not None
 
     @property
     def chunk_rows(self) -> int:
@@ -229,6 +292,9 @@ class ChunkedMatrix:
         for c in self.chunks:
             if isinstance(c, SparseRows):
                 total += c.indices.nbytes + c.values.nbytes
+            elif isinstance(c, BlockedEllRows):
+                total += sum(int(leaf.nbytes) for leaf in
+                             jax.tree_util.tree_leaves(c))
             else:
                 total += c.nbytes
         return total
@@ -287,6 +353,12 @@ class ChunkedBatch(NamedTuple):
 
         pad = self.mesh_chunk_rows(mesh)
         X = self.X.chunks[i]
+        if isinstance(X, BlockedEllRows):
+            raise TypeError(
+                "blocked-ELL chunks cannot row-shard over a mesh (their "
+                "per-chunk ELL buckets are laid for one device); stream "
+                "SparseRows chunks under a mesh, or solve resident with "
+                "data.dataset.shard_blocked_ell_batch")
         if isinstance(X, SparseRows):
             Xs = SparseRows(shard_rows(X.indices, mesh, pad_rows=pad),
                             shard_rows(X.values, mesh, pad_rows=pad),
@@ -402,10 +474,13 @@ def chunk_matrix(X, chunk_rows: int) -> ChunkedMatrix:
     """Split a dense array or SparseRows into a host ChunkedMatrix (last
     chunk zero-padded to the uniform height)."""
     if isinstance(X, (HybridRows, ShardedHybridRows, PermutedHybridRows,
-                      ShardedPermutedHybridRows)):
+                      ShardedPermutedHybridRows, BlockedEllRows,
+                      ShardedBlockedEllRows)):
         raise TypeError(
             f"{type(X).__name__} cannot be host-chunked (device-locality "
-            "layout); chunk the SparseRows/dense form instead")
+            "layout); chunk the SparseRows/dense form instead — or use "
+            "chunk_blocked_ell to build a blocked-ELL chunk ladder from "
+            "SparseRows")
     if chunk_rows < 1:
         raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
     sparse = isinstance(X, SparseRows)
@@ -473,3 +548,51 @@ def chunk_batch(batch: GLMBatch, chunk_rows: int) -> ChunkedBatch:
     return make_chunked_batch(
         chunk_matrix(X, chunk_rows), np.asarray(batch.y),
         np.asarray(batch.weights), np.asarray(batch.offsets))
+
+
+def chunk_blocked_ell(batch: GLMBatch, chunk_rows: int,
+                      d_dense: int = 1024,
+                      feature_dtype=None) -> ChunkedBatch:
+    """Re-lay a SparseRows batch as a HOST blocked-ELL chunk ladder: one
+    `shard_blocked_ell` pass with S = n_chunks builds a GLOBAL column
+    permutation + per-chunk structures padded to COMMON shapes, so the
+    streamed solve uploads gather-fused scatter-free chunks and compiles
+    each per-chunk program exactly once (the out-of-HBM form of the
+    blocked-ELL hot path — `train_glm` on the result dispatches to the
+    streamed solvers and translates the permutation at its boundary).
+
+    ``feature_dtype`` (e.g. jnp.bfloat16) recasts every chunk's value
+    storage after the build — half the per-pass host→device feature bytes,
+    f32 accumulation unchanged.
+    """
+    X = batch.X
+    if not isinstance(X, SparseRows):
+        raise TypeError("chunk_blocked_ell expects SparseRows")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    n = batch.n
+    n_pad = -(-max(n, 1) // chunk_rows) * chunk_rows
+    host = batch._replace(X=_host_sparse(X), y=np.asarray(batch.y),
+                          weights=np.asarray(batch.weights),
+                          offsets=np.asarray(batch.offsets))
+    padded = pad_batch(host, n_pad)
+    S = n_pad // chunk_rows
+    ladder = shard_blocked_ell(_host_sparse(padded.X), S, d_dense)
+    chunks = []
+    for i in range(S):
+        c = ladder.chunk(i)
+        if feature_dtype is not None:
+            c = dataclasses.replace(
+                c, dense=np.asarray(c.dense).astype(feature_dtype),
+                ell_vals=tuple(np.asarray(v).astype(feature_dtype)
+                               for v in c.ell_vals),
+                bucket_vals=tuple(np.asarray(v).astype(feature_dtype)
+                                  for v in c.bucket_vals))
+        chunks.append(c)
+    cm = ChunkedMatrix(tuple(chunks), n, X.n_features,
+                       perm_cols=np.asarray(ladder.perm_cols),
+                       inv_perm=np.asarray(ladder.inv_perm),
+                       last_col_pos=ladder.last_col_pos)
+    return make_chunked_batch(cm, np.asarray(padded.y)[:n_pad],
+                              np.asarray(padded.weights)[:n_pad],
+                              np.asarray(padded.offsets)[:n_pad])

@@ -326,8 +326,216 @@ class ShardedPermutedHybridRows:
         return jnp.asarray(w)[self.inv_perm]
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "ell_pcols", "ell_vals", "row_pos",
+                 "bucket_rows", "bucket_vals", "perm_cols", "inv_perm"),
+    meta_fields=("n_features", "n_prefix", "last_col_pos", "tail_nnz"),
+)
+@dataclasses.dataclass(frozen=True)
+class BlockedEllRows:
+    """Blocked-ELL hybrid: hot columns dense on the MXU, cold tail as
+    nnz-bucketed ELL row blocks — gather-fused X passes with NO scans and
+    NO scatters of any kind.
+
+    PermutedHybridRows (round 5) removed the combining scatters but its
+    matvec tail still rides a full-length `cumsum` over the flat tail plus
+    a `row_bounds` boundary pass — a log-depth scan over every tail nnz,
+    per X pass, per line-search direction. This layout replaces it with
+    the classic blocked-ELL form: rows are bucketed by tail-nnz into a
+    small set of power-of-two widths (`next_pow2` ladder), each bucket is
+    a dense (r_b, W_b) pair of permuted-column-id / value matrices, and
+    the tail matvec is per bucket ONE gather of w plus ONE
+    `einsum("rw,rw->r")` — a dense contraction XLA maps straight onto the
+    vector/matrix units, f32 accumulation pinned by
+    ``preferred_element_type``. Bucket outputs concatenate in sorted-row
+    order and ONE (n,)-gather (`row_pos`) reassembles original row order;
+    rows with no tail hit an appended zero slot. Zero combining scatters,
+    zero `.at[].set` scatters, zero cumsum — in BOTH X passes.
+
+    rmatvec keeps the embedding-style PRE-SORTED gather of the permuted
+    layouts: the distinct tail columns are grouped by occurrence-count
+    bucket at build time, each bucket's (c_b, k_b) ORIGINAL-row-id matrix
+    gathers the cotangent and reduces over k_b, and the gradient is
+    assembled by concatenation in prefix order (identical machinery to
+    PermutedHybridRows — `bucket_rows`/`bucket_vals` are byte-compatible).
+
+    Mixed precision: with bf16 storage (dataset.cast_features) BOTH tail
+    einsums multiply in bf16 and accumulate f32 — the same MXU recipe as
+    the hot block, at half the value-storage bytes. With f32 storage the
+    contractions are plain f32 (the parity-test reference path).
+
+    COORDINATE CONVENTION as PermutedHybridRows: matvec/rmatvec (and the
+    whole solver stack) operate on PERMUTED-space vectors;
+    `to_model_space` / `from_model_space` translate at the public
+    boundary (models/training, models/glm).
+
+    Padding slots carry (column 0, value 0) so they contribute exactly
+    0·w[0]; `tail_pad_waste` reports the pow2 slot overhead.
+    """
+
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot block, original rows
+    ell_pcols: tuple                    # per width bucket: (r_b, W_b) int32
+    #                                     PREFIX-RELATIVE col ids (absolute
+    #                                     permuted id − d_sel; padding 0 with
+    #                                     value 0) — the tail gather then
+    #                                     reads the small contiguous
+    #                                     w[d_sel:n_prefix] slice (the ~U
+    #                                     distinct tail columns), not the
+    #                                     full (d,) vector: at 10M features
+    #                                     that is a ~2 MB gather table vs
+    #                                     40 MB, cache-resident on TPU
+    ell_vals: tuple                     # per width bucket: (r_b, W_b) values
+    row_pos: jax.Array | np.ndarray     # (n,) int32 position in the bucket
+    #                                     concatenation (B = zero slot)
+    bucket_rows: tuple                  # per occ bucket: (c_b, k_b) row ids
+    bucket_vals: tuple                  # per occ bucket: (c_b, k_b) values
+    perm_cols: jax.Array | np.ndarray   # (d,) original col id per position
+    inv_perm: jax.Array | np.ndarray    # (d,) position of each original col
+    n_features: int
+    n_prefix: int                       # P = d_sel + distinct tail columns
+    last_col_pos: int                   # permuted position of original col d-1
+    tail_nnz: int                       # real (unpadded) tail nnz
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+    @property
+    def d_sel(self) -> int:
+        return self.dense.shape[1]
+
+    @property
+    def ell_slots(self) -> int:
+        """Total (padded) ELL slots across the width ladder."""
+        return sum(int(v.shape[0]) * int(v.shape[1]) for v in self.ell_vals)
+
+    @property
+    def tail_pad_waste(self) -> float:
+        """Fraction of ELL slots that are pow2 padding (0.0 = none)."""
+        slots = self.ell_slots
+        return (slots / self.tail_nnz - 1.0) if self.tail_nnz else 0.0
+
+    def from_model_space(self, v):
+        """Original-space (d,)-vector (or (d, ...) stack) → permuted space."""
+        return jnp.asarray(v)[self.perm_cols]
+
+    def to_model_space(self, w):
+        """Permuted-space (d,)-vector (or (d, ...) stack) → original space."""
+        return jnp.asarray(w)[self.inv_perm]
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dense", "ell_pcols", "ell_vals", "row_pos",
+                 "bucket_rows", "bucket_vals", "perm_cols", "inv_perm"),
+    meta_fields=("n_features", "n_prefix", "last_col_pos", "tail_nnz"),
+)
+@dataclasses.dataclass(frozen=True)
+class ShardedBlockedEllRows:
+    """BlockedEllRows laid out for a device mesh (or a streamed chunk
+    ladder): per-shard ELL row buckets and occurrence buckets under ONE
+    GLOBAL column permutation.
+
+    Every per-shard structure is padded to a COMMON shape across shards
+    (shard axis leading): the ELL width ladder is the union of per-shard
+    exponents with r_b = the max per-shard row count, occurrence buckets
+    use MAX-LOCAL counts exactly as ShardedPermutedHybridRows, and
+    `row_pos` is (S, n_local) with LOCAL concat positions. Sharding every
+    data leaf's axis 0 over the mesh gives each device a complete
+    scatter-free piece; `local()` squeezes the shard axis into a plain
+    BlockedEllRows inside shard_map, and the same common-shape property
+    is what lets `data.dataset.chunk_blocked_ell` stream the shards as
+    host chunks through ONE compiled chunk program.
+
+    Residency/coordinate contracts as ShardedPermutedHybridRows.
+    """
+
+    dense: jax.Array | np.ndarray       # (n, d_sel) hot block, global rows
+    ell_pcols: tuple                    # per width bucket: (S, r_b, W_b)
+    ell_vals: tuple                     # per width bucket: (S, r_b, W_b)
+    row_pos: jax.Array | np.ndarray     # (S, n_local) int32 local positions
+    bucket_rows: tuple                  # per occ bucket: (S, c_b, k_b) LOCAL
+    bucket_vals: tuple                  # per occ bucket: (S, c_b, k_b)
+    perm_cols: jax.Array | np.ndarray   # (d,) replicated
+    inv_perm: jax.Array | np.ndarray    # (d,) replicated
+    n_features: int
+    n_prefix: int
+    last_col_pos: int
+    tail_nnz: int
+
+    @property
+    def shape(self):
+        return (self.dense.shape[0], self.n_features)
+
+    @property
+    def d_sel(self) -> int:
+        return self.dense.shape[1]
+
+    @property
+    def n_shards(self) -> int:
+        return self.row_pos.shape[0]
+
+    @property
+    def n_local(self) -> int:
+        return self.row_pos.shape[1]
+
+    @property
+    def ell_slots(self) -> int:
+        return sum(int(np.prod(v.shape)) for v in self.ell_vals)
+
+    @property
+    def tail_pad_waste(self) -> float:
+        slots = self.ell_slots
+        return (slots / self.tail_nnz - 1.0) if self.tail_nnz else 0.0
+
+    def local(self) -> BlockedEllRows:
+        """The one-shard view (inside shard_map, where the shard axis has
+        been sliced to length 1)."""
+        return BlockedEllRows(
+            dense=self.dense,
+            ell_pcols=tuple(b[0] for b in self.ell_pcols),
+            ell_vals=tuple(b[0] for b in self.ell_vals),
+            row_pos=self.row_pos[0],
+            bucket_rows=tuple(b[0] for b in self.bucket_rows),
+            bucket_vals=tuple(b[0] for b in self.bucket_vals),
+            perm_cols=self.perm_cols,
+            inv_perm=self.inv_perm,
+            n_features=self.n_features,
+            n_prefix=self.n_prefix,
+            last_col_pos=self.last_col_pos,
+            tail_nnz=self.tail_nnz,
+        )
+
+    def chunk(self, i: int) -> BlockedEllRows:
+        """Shard ``i`` as a host BlockedEllRows (the streamed-chunk view:
+        every chunk shares the common per-shard shapes, so the per-chunk
+        device programs compile exactly once)."""
+        return BlockedEllRows(
+            dense=self.dense[i * self.n_local:(i + 1) * self.n_local],
+            ell_pcols=tuple(np.asarray(b)[i] for b in self.ell_pcols),
+            ell_vals=tuple(np.asarray(b)[i] for b in self.ell_vals),
+            row_pos=np.asarray(self.row_pos)[i],
+            bucket_rows=tuple(np.asarray(b)[i] for b in self.bucket_rows),
+            bucket_vals=tuple(np.asarray(b)[i] for b in self.bucket_vals),
+            perm_cols=self.perm_cols,
+            inv_perm=self.inv_perm,
+            n_features=self.n_features,
+            n_prefix=self.n_prefix,
+            last_col_pos=self.last_col_pos,
+            tail_nnz=self.tail_nnz,
+        )
+
+    def from_model_space(self, v):
+        return jnp.asarray(v)[self.perm_cols]
+
+    def to_model_space(self, w):
+        return jnp.asarray(w)[self.inv_perm]
+
+
 Matrix = (jax.Array | SparseRows | HybridRows | ShardedHybridRows
-          | PermutedHybridRows | ShardedPermutedHybridRows)
+          | PermutedHybridRows | ShardedPermutedHybridRows
+          | BlockedEllRows | ShardedBlockedEllRows)
 
 
 _SCATTER_CHUNK_ELEMS = 1 << 29  # ~2 GB f32 intermediate per scatter chunk
@@ -471,6 +679,124 @@ def to_hybrid(X: SparseRows, d_dense: int = 1024,
     )
 
 
+def _bucket_exponents(counts: np.ndarray) -> np.ndarray:
+    """pow2 bucket exponent per count (0 for counts ≤ 1; f64 log2 is exact
+    at powers of two well past any realistic count)."""
+    e = np.zeros(counts.shape, np.int64)
+    big = counts > 1
+    e[big] = np.ceil(np.log2(counts[big].astype(np.float64))).astype(np.int64)
+    return e
+
+
+def _column_perm(sel, u_cols, order, d):
+    """(perm_cols, inv_perm) for the hot-prefix + bucket-ordered-tail +
+    untouched-suffix column relabeling shared by every permuted layout."""
+    perm_prefix = np.concatenate([sel, u_cols[order]])
+    untouched = np.setdiff1d(np.arange(d), perm_prefix)
+    perm_cols = np.concatenate([perm_prefix, untouched]).astype(np.int32)
+    inv_perm = np.empty(d, np.int64)
+    inv_perm[perm_cols] = np.arange(d)
+    return perm_cols, inv_perm.astype(np.int32)
+
+
+def _occurrence_buckets(t_rows, t_vals, pcol, d_sel, e, order, u_counts):
+    """Column-major padded occurrence buckets (rmatvec's embedding-style
+    pre-sorted gather): tail nnz sorted by prefix id groups each column's
+    occurrences contiguously, in rank (= output) order. Returns
+    (bucket_rows, bucket_vals) tuples of (c_b, k_b) matrices."""
+    m = pcol.shape[0]
+    nnz_order = np.argsort(pcol, kind="stable")
+    rank_per = pcol[nnz_order].astype(np.int64) - d_sel
+    counts_by_rank = u_counts[order]
+    col_offsets = np.concatenate([[0], np.cumsum(counts_by_rank)])
+    pos_within = np.arange(m) - col_offsets[rank_per]
+    es = e[order]                      # exponent per rank, ascending
+    bucket_rows, bucket_vals = [], []
+    for e_v in np.unique(es):
+        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
+        c_b, k_b = int(r1 - r0), 1 << int(e_v)
+        lo, hi = int(col_offsets[r0]), int(col_offsets[r1])
+        br = np.zeros((c_b, k_b), np.int32)
+        bv = np.zeros((c_b, k_b), np.float32)
+        lr = rank_per[lo:hi] - r0
+        pw = pos_within[lo:hi]
+        br[lr, pw] = t_rows[nnz_order[lo:hi]]
+        bv[lr, pw] = t_vals[nnz_order[lo:hi]]
+        bucket_rows.append(br)
+        bucket_vals.append(bv)
+    return tuple(bucket_rows), tuple(bucket_vals)
+
+
+def _sharded_occurrence_buckets(loc_rows, t_vals, rank_nnz, s_ids, S, e,
+                                order):
+    """Per-shard occurrence buckets (S, c_b, k_b) with LOCAL row ids:
+    sort nnz by (rank, shard); within a (rank, shard) group the row-major
+    source keeps local rows ascending."""
+    m_tot = rank_nnz.shape[0]
+    U = order.shape[0]
+    nnz_order = np.lexsort((s_ids, rank_nnz))
+    rs_key = (rank_nnz * S + s_ids)[nnz_order]
+    counts_rs = np.bincount(rs_key, minlength=U * S)
+    offsets_rs = np.concatenate([[0], np.cumsum(counts_rs)])
+    pos_within = np.arange(m_tot) - offsets_rs[rs_key]
+    rank_sorted = rank_nnz[nnz_order]
+    es = e[order]                      # exponent per rank, ascending
+    bucket_rows, bucket_vals = [], []
+    for e_v in np.unique(es):
+        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
+        c_b, k_b = int(r1 - r0), 1 << int(e_v)
+        lo, hi = np.searchsorted(rank_sorted, [r0, r1])
+        br = np.zeros((S, c_b, k_b), np.int32)
+        bv = np.zeros((S, c_b, k_b), np.float32)
+        sel_nnz = nnz_order[lo:hi]
+        ls = s_ids[sel_nnz]
+        lr = rank_nnz[sel_nnz] - r0
+        pw = pos_within[lo:hi]
+        br[ls, lr, pw] = loc_rows[sel_nnz]
+        bv[ls, lr, pw] = t_vals[sel_nnz]
+        bucket_rows.append(br)
+        bucket_vals.append(bv)
+    return tuple(bucket_rows), tuple(bucket_vals)
+
+
+def _row_exponents(counts: np.ndarray) -> np.ndarray:
+    """ELL width-bucket exponent per row tail-nnz count (-1 = no tail)."""
+    e = np.where(counts > 0, _bucket_exponents(counts), -1)
+    return e.astype(np.int64)
+
+
+def _fill_ell(widths, counts, e_row, starts, pcol, vals):
+    """One shard's ELL row buckets over a shared ``widths`` ladder of
+    (exponent, r_b) pairs. ``starts``: per-row offset of the row's slice
+    in the (global) flat row-major tail arrays. Returns
+    ([(r_b, W_b) pcols], [(r_b, W_b) vals], row_pos) where row_pos maps
+    each row to its position in the bucket concatenation (rows with no
+    tail map to the appended zero slot at B = Σ r_b)."""
+    n = counts.shape[0]
+    B = sum(r_b for _, r_b in widths)
+    row_pos = np.full(n, B, np.int32)
+    out_c, out_v = [], []
+    base = 0
+    for e_v, r_b in widths:
+        w_b = 1 << e_v
+        rows_b = np.flatnonzero(e_row == e_v)
+        pc = np.zeros((r_b, w_b), np.int32)
+        pv = np.zeros((r_b, w_b), np.float32)
+        if rows_b.size:
+            L = counts[rows_b]
+            tot = int(L.sum())
+            pw = np.arange(tot) - np.repeat(np.cumsum(L) - L, L)
+            src = np.repeat(starts[rows_b], L) + pw
+            dr = np.repeat(np.arange(rows_b.size), L)
+            pc[dr, pw] = pcol[src]
+            pv[dr, pw] = vals[src]
+            row_pos[rows_b] = base + np.arange(rows_b.size, dtype=np.int64)
+        base += r_b
+        out_c.append(pc)
+        out_v.append(pv)
+    return out_c, out_v, row_pos
+
+
 def to_permuted_hybrid(X: SparseRows, d_dense: int = 1024,
                        device_dense_dtype=None) -> PermutedHybridRows:
     """Build the scatter-free permuted hybrid from padded COO rows.
@@ -511,53 +837,191 @@ def to_permuted_hybrid(X: SparseRows, d_dense: int = 1024,
     u_cols, inv, u_counts = np.unique(t_cols, return_inverse=True,
                                       return_counts=True)
     U = u_cols.size
-    # pow-2 occurrence bucket exponent per distinct column (f64 log2 is
-    # exact at powers of two well past any realistic count)
-    e = np.zeros(U, np.int64)
-    big = u_counts > 1
-    e[big] = np.ceil(np.log2(u_counts[big].astype(np.float64))).astype(
-        np.int64)
+    e = _bucket_exponents(u_counts)
     order = np.lexsort((u_cols, e))   # bucket-major, col-id within bucket
     rank = np.empty(U, np.int64)
     rank[order] = np.arange(U)
 
     pcol = (d_sel + rank[inv]).astype(np.int32)   # (m,) prefix ids, row-major
-
-    perm_prefix = np.concatenate([sel, u_cols[order]])
-    untouched = np.setdiff1d(np.arange(d), perm_prefix)
-    perm_cols = np.concatenate([perm_prefix, untouched]).astype(np.int32)
-    inv_perm = np.empty(d, np.int64)
-    inv_perm[perm_cols] = np.arange(d)
-
-    # column-major padded buckets: tail nnz sorted by prefix id groups each
-    # column's occurrences contiguously, in rank (= output) order
-    nnz_order = np.argsort(pcol, kind="stable")
-    rank_per = pcol[nnz_order].astype(np.int64) - d_sel
-    counts_by_rank = u_counts[order]
-    col_offsets = np.concatenate([[0], np.cumsum(counts_by_rank)])
-    pos_within = np.arange(m) - col_offsets[rank_per]
-    es = e[order]                      # exponent per rank, ascending
-    bucket_rows, bucket_vals = [], []
-    for e_v in np.unique(es):
-        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
-        c_b, k_b = int(r1 - r0), 1 << int(e_v)
-        lo, hi = int(col_offsets[r0]), int(col_offsets[r1])
-        br = np.zeros((c_b, k_b), np.int32)
-        bv = np.zeros((c_b, k_b), np.float32)
-        lr = rank_per[lo:hi] - r0
-        pw = pos_within[lo:hi]
-        br[lr, pw] = t_rows[nnz_order[lo:hi]]
-        bv[lr, pw] = t_vals[nnz_order[lo:hi]]
-        bucket_rows.append(br)
-        bucket_vals.append(bv)
+    perm_cols, inv_perm = _column_perm(sel, u_cols, order, d)
+    bucket_rows, bucket_vals = _occurrence_buckets(
+        t_rows, t_vals, pcol, d_sel, e, order, u_counts)
 
     return PermutedHybridRows(
         dense=dense, tail_pcols=pcol, tail_vals=t_vals.astype(np.float32),
         row_bounds=row_bounds,
-        bucket_rows=tuple(bucket_rows), bucket_vals=tuple(bucket_vals),
-        perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+        bucket_rows=bucket_rows, bucket_vals=bucket_vals,
+        perm_cols=perm_cols, inv_perm=inv_perm,
         n_features=d, n_prefix=d_sel + U,
         last_col_pos=int(inv_perm[d - 1]))
+
+
+def to_blocked_ell(X: SparseRows, d_dense: int = 1024,
+                   device_dense_dtype=None) -> BlockedEllRows:
+    """Build the blocked-ELL hybrid (see BlockedEllRows) from padded COO
+    rows.
+
+    One vectorized host pass sharing `_hot_cold_split` and the permuted
+    column machinery with `to_permuted_hybrid`, plus the ELL side: rows
+    bucketed by tail-nnz into the pow2 width ladder (rows sorted by nnz so
+    each bucket is a contiguous id range), every bucket a dense
+    (r_b, W_b) pcols/vals pair filled row-major from the flat tail, and
+    `row_pos` mapping original rows back into the bucket concatenation.
+    `device_dense_dtype` builds the hot block on device from compact COO
+    triples as `to_hybrid` does.
+    """
+    n = np.asarray(X.indices).shape[0]
+    d = X.n_features
+    d_sel = min(d_dense, d)
+    dense, sel, t_rows, t_cols, t_vals = _hot_cold_split(
+        X, d_dense, device_dense_dtype)
+    t_vals = t_vals.astype(np.float32)
+    m = t_rows.size
+
+    if m == 0:
+        perm_cols, inv_perm = _column_perm(
+            sel, np.zeros(0, np.int64), np.zeros(0, np.int64), d)
+        return BlockedEllRows(
+            dense=dense, ell_pcols=(), ell_vals=(),
+            row_pos=np.zeros(n, np.int32),
+            bucket_rows=(), bucket_vals=(),
+            perm_cols=perm_cols, inv_perm=inv_perm,
+            n_features=d, n_prefix=d_sel,
+            last_col_pos=int(inv_perm[d - 1]), tail_nnz=0)
+
+    u_cols, inv, u_counts = np.unique(t_cols, return_inverse=True,
+                                      return_counts=True)
+    U = u_cols.size
+    e = _bucket_exponents(u_counts)
+    order = np.lexsort((u_cols, e))
+    rank = np.empty(U, np.int64)
+    rank[order] = np.arange(U)
+    pcol = (d_sel + rank[inv]).astype(np.int32)
+    perm_cols, inv_perm = _column_perm(sel, u_cols, order, d)
+    bucket_rows, bucket_vals = _occurrence_buckets(
+        t_rows, t_vals, pcol, d_sel, e, order, u_counts)
+
+    row_bounds = np.searchsorted(t_rows, np.arange(n + 1)).astype(np.int64)
+    counts = np.diff(row_bounds)
+    e_row = _row_exponents(counts)
+    widths = [(int(ev), int((e_row == ev).sum()))
+              for ev in np.unique(e_row[e_row >= 0])]
+    # prefix-RELATIVE ids: the device tail gather reads w[d_sel:n_prefix]
+    pcol_rel = (pcol.astype(np.int64) - d_sel).astype(np.int32)
+    pcs, pvs, row_pos = _fill_ell(widths, counts, e_row, row_bounds[:-1],
+                                  pcol_rel, t_vals)
+
+    return BlockedEllRows(
+        dense=dense, ell_pcols=tuple(pcs), ell_vals=tuple(pvs),
+        row_pos=row_pos,
+        bucket_rows=bucket_rows, bucket_vals=bucket_vals,
+        perm_cols=perm_cols, inv_perm=inv_perm,
+        n_features=d, n_prefix=d_sel + U,
+        last_col_pos=int(inv_perm[d - 1]), tail_nnz=int(m))
+
+
+def blocked_ell_from_scipy_csr(csr, d_dense: int = 1024,
+                               device_dense_dtype=None,
+                               strict: bool = False) -> BlockedEllRows:
+    """scipy CSR → BlockedEllRows in one call (the ingestion shortcut):
+    pads to fixed nnz-per-row on host (`from_scipy_csr` — never truncating,
+    k defaults to the max row nnz; ``strict`` is forwarded for callers that
+    cap k upstream) and lays the blocked-ELL hybrid."""
+    return to_blocked_ell(
+        from_scipy_csr(csr, host=True, strict=strict), d_dense,
+        device_dense_dtype=device_dense_dtype)
+
+
+def shard_blocked_ell(X: SparseRows, n_shards: int, d_dense: int = 1024,
+                      device_dense_dtype=None) -> ShardedBlockedEllRows:
+    """Build the SHARDED blocked-ELL hybrid (see ShardedBlockedEllRows)
+    from padded COO rows. Rows must already divide ``n_shards``
+    (`data.dataset.shard_blocked_ell_batch` pads + builds; the streamed
+    chunk ladder rides the same builder with S = n_chunks).
+
+    One vectorized host pass mirroring `shard_permuted_hybrid`: a GLOBAL
+    column permutation (hot prefix from global frequencies, tail ranks by
+    MAX-LOCAL occurrence bucket) and PER-SHARD structures padded to
+    common shapes — the ELL width ladder is the union of per-shard row
+    exponents with r_b = max over shards (absent (shard, width) pairs
+    carry all-zero rows that contribute nothing and are never gathered).
+    """
+    n = np.asarray(X.indices).shape[0]
+    d = X.n_features
+    if n % n_shards != 0:
+        raise ValueError(
+            f"{n} rows do not divide {n_shards} shards; pad the batch first "
+            "(data.dataset.shard_blocked_ell_batch)")
+    n_local = n // n_shards
+    d_sel = min(d_dense, d)
+    dense, sel, t_rows, t_cols, t_vals = _hot_cold_split(
+        X, d_dense, device_dense_dtype)
+    t_vals = t_vals.astype(np.float32)
+    m_tot = t_rows.size
+    S = n_shards
+
+    if m_tot == 0:
+        perm_cols, inv_perm = _column_perm(
+            sel, np.zeros(0, np.int64), np.zeros(0, np.int64), d)
+        return ShardedBlockedEllRows(
+            dense=dense, ell_pcols=(), ell_vals=(),
+            row_pos=np.zeros((S, n_local), np.int32),
+            bucket_rows=(), bucket_vals=(),
+            perm_cols=perm_cols, inv_perm=inv_perm,
+            n_features=d, n_prefix=d_sel,
+            last_col_pos=int(inv_perm[d - 1]), tail_nnz=0)
+
+    s_ids = (t_rows // n_local).astype(np.int64)       # (m,) shard per nnz
+    loc_rows = (t_rows - s_ids * n_local).astype(np.int64)
+
+    u_cols, inv, u_counts = np.unique(t_cols, return_inverse=True,
+                                      return_counts=True)
+    U = u_cols.size
+    # per-(column, shard) occurrence counts -> MAX-LOCAL count per column
+    cs_counts = np.bincount(inv * S + s_ids, minlength=U * S).reshape(U, S)
+    e = _bucket_exponents(cs_counts.max(axis=1))
+    order = np.lexsort((u_cols, e))   # bucket-major, col-id within bucket
+    rank = np.empty(U, np.int64)
+    rank[order] = np.arange(U)
+    pcol = (d_sel + rank[inv]).astype(np.int32)   # (m,) global prefix ids
+    perm_cols, inv_perm = _column_perm(sel, u_cols, order, d)
+    bucket_rows, bucket_vals = _sharded_occurrence_buckets(
+        loc_rows, t_vals, rank[inv], s_ids, S, e, order)
+
+    # per-shard ELL row buckets over a SHARED width ladder (t_rows is
+    # ascending, so shard slices of the flat tail are contiguous and
+    # _fill_ell's `starts` index straight into the global arrays)
+    sb = np.searchsorted(t_rows, np.arange(S + 1) * n_local)
+    shard_layouts = []
+    for s in range(S):
+        lo, hi = int(sb[s]), int(sb[s + 1])
+        rbs = lo + np.searchsorted(loc_rows[lo:hi], np.arange(n_local + 1))
+        counts_s = np.diff(rbs)
+        shard_layouts.append((counts_s, _row_exponents(counts_s),
+                              rbs[:-1].astype(np.int64)))
+    widths: dict[int, int] = {}
+    for counts_s, e_row_s, _ in shard_layouts:
+        for ev in np.unique(e_row_s[e_row_s >= 0]):
+            r_b = int((e_row_s == ev).sum())
+            widths[int(ev)] = max(widths.get(int(ev), 0), r_b)
+    ladder = sorted(widths.items())
+    pcol_rel = (pcol.astype(np.int64) - d_sel).astype(np.int32)
+    per_shard = [_fill_ell(ladder, counts_s, e_row_s, starts_s, pcol_rel,
+                           t_vals)
+                 for counts_s, e_row_s, starts_s in shard_layouts]
+    ell_pcols = tuple(np.stack([p[0][b] for p in per_shard])
+                      for b in range(len(ladder)))
+    ell_vals = tuple(np.stack([p[1][b] for p in per_shard])
+                     for b in range(len(ladder)))
+    row_pos = np.stack([p[2] for p in per_shard])
+
+    return ShardedBlockedEllRows(
+        dense=dense, ell_pcols=ell_pcols, ell_vals=ell_vals,
+        row_pos=row_pos,
+        bucket_rows=bucket_rows, bucket_vals=bucket_vals,
+        perm_cols=perm_cols, inv_perm=inv_perm,
+        n_features=d, n_prefix=d_sel + U,
+        last_col_pos=int(inv_perm[d - 1]), tail_nnz=int(m_tot))
 
 
 def shard_hybrid(X: SparseRows | HybridRows, n_shards: int,
@@ -659,22 +1123,13 @@ def shard_permuted_hybrid(X: SparseRows, n_shards: int,
     U = u_cols.size
     # per-(column, shard) occurrence counts -> MAX-LOCAL count per column
     cs_counts = np.bincount(inv * S + s_ids, minlength=U * S).reshape(U, S)
-    max_local = cs_counts.max(axis=1)
-    e = np.zeros(U, np.int64)
-    big = max_local > 1
-    e[big] = np.ceil(np.log2(max_local[big].astype(np.float64))).astype(
-        np.int64)
+    e = _bucket_exponents(cs_counts.max(axis=1))
     order = np.lexsort((u_cols, e))   # bucket-major, col-id within bucket
     rank = np.empty(U, np.int64)
     rank[order] = np.arange(U)
 
     pcol = (d_sel + rank[inv]).astype(np.int32)   # (m,) global prefix ids
-
-    perm_prefix = np.concatenate([sel, u_cols[order]])
-    untouched = np.setdiff1d(np.arange(d), perm_prefix)
-    perm_cols = np.concatenate([perm_prefix, untouched]).astype(np.int32)
-    inv_perm = np.empty(d, np.int64)
-    inv_perm[perm_cols] = np.arange(d)
+    perm_cols, inv_perm = _column_perm(sel, u_cols, order, d)
 
     # per-shard row-major flat tails (t_rows ascending -> shard slices are
     # contiguous); padding entries (pcol=d_sel, val=0) sit past each
@@ -692,49 +1147,30 @@ def shard_permuted_hybrid(X: SparseRows, n_shards: int,
         row_bounds[s] = np.searchsorted(
             loc_rows[lo:hi], np.arange(n_local + 1)).astype(np.int32)
 
-    # per-shard occurrence-bucket matrices: sort nnz by (rank, shard);
-    # within a (rank, shard) group the row-major source keeps local rows
-    # ascending
-    rank_nnz = rank[inv]
-    nnz_order = np.lexsort((s_ids, rank_nnz))
-    rs_key = (rank_nnz * S + s_ids)[nnz_order]
-    counts_rs = np.bincount(rs_key, minlength=U * S)
-    offsets_rs = np.concatenate([[0], np.cumsum(counts_rs)])
-    pos_within = np.arange(m_tot) - offsets_rs[rs_key]
-    rank_sorted = rank_nnz[nnz_order]
-    es = e[order]                      # exponent per rank, ascending
-    bucket_rows, bucket_vals = [], []
-    for e_v in np.unique(es):
-        r0, r1 = np.searchsorted(es, [e_v, e_v + 1])
-        c_b, k_b = int(r1 - r0), 1 << int(e_v)
-        lo, hi = np.searchsorted(rank_sorted, [r0, r1])
-        br = np.zeros((S, c_b, k_b), np.int32)
-        bv = np.zeros((S, c_b, k_b), np.float32)
-        sel_nnz = nnz_order[lo:hi]
-        ls = s_ids[sel_nnz]
-        lr = rank_nnz[sel_nnz] - r0
-        pw = pos_within[lo:hi]
-        br[ls, lr, pw] = loc_rows[sel_nnz]
-        bv[ls, lr, pw] = t_vals[sel_nnz]
-        bucket_rows.append(br)
-        bucket_vals.append(bv)
+    bucket_rows, bucket_vals = _sharded_occurrence_buckets(
+        loc_rows, t_vals, rank[inv], s_ids, S, e, order)
 
     return ShardedPermutedHybridRows(
         dense=dense, tail_pcols=tail_pcols, tail_vals=tail_vals,
         row_bounds=row_bounds,
-        bucket_rows=tuple(bucket_rows), bucket_vals=tuple(bucket_vals),
-        perm_cols=perm_cols, inv_perm=inv_perm.astype(np.int32),
+        bucket_rows=bucket_rows, bucket_vals=bucket_vals,
+        perm_cols=perm_cols, inv_perm=inv_perm,
         n_features=d, n_prefix=d_sel + U,
         last_col_pos=int(inv_perm[d - 1]))
 
 
-def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
+def from_scipy_csr(csr, k: int | None = None, host: bool = False,
+                   strict: bool = False) -> SparseRows:
     """Pad a scipy CSR matrix to fixed nnz-per-row (fully vectorized —
     no per-row Python loop, so billion-row ingestion is numpy-bound).
 
     If ``k`` is smaller than some row's nnz, the row keeps its k
     largest-|value| entries and a UserWarning reports how many rows were
-    truncated (the reference never truncates; Breeze vectors are exact).
+    truncated and what FRACTION of the total |value| mass was dropped
+    (the honest severity signal — a 0.01% mass drop is padding hygiene, a
+    10% drop is a modeling decision). ``strict=True`` raises ValueError
+    instead of truncating (the reference never truncates; Breeze vectors
+    are exact).
     """
     n, d = csr.shape
     indptr = np.asarray(csr.indptr)
@@ -745,13 +1181,8 @@ def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
     col = np.asarray(csr.indices)
     dat = np.asarray(csr.data, np.float32)
     row = np.repeat(np.arange(n, dtype=np.int64), row_nnz)
-    if max_nnz > k:
-        n_trunc = int((row_nnz > k).sum())
-        warnings.warn(
-            f"from_scipy_csr: {n_trunc} rows exceed k={k} nnz; keeping the "
-            f"k largest-|value| entries per row (max row nnz = {max_nnz})",
-            stacklevel=2,
-        )
+    truncating = max_nnz > k
+    if truncating:
         # Reorder within each row by descending |value| so the first k kept
         # below are the largest-magnitude entries.
         order = np.lexsort((-np.abs(dat), row))
@@ -760,6 +1191,20 @@ def from_scipy_csr(csr, k: int | None = None, host: bool = False) -> SparseRows:
         indptr[:-1].astype(np.int64), row_nnz
     )
     keep = pos < k
+    if truncating:
+        n_trunc = int((row_nnz > k).sum())
+        n_drop = int((~keep).sum())
+        total_mass = float(np.abs(dat).sum())
+        frac = float(np.abs(dat[~keep]).sum()) / total_mass \
+            if total_mass > 0.0 else 0.0
+        detail = (f"{n_trunc} rows exceed k={k} nnz (max row nnz = "
+                  f"{max_nnz}); dropping {n_drop} smallest-|value| entries "
+                  f"= {frac:.4%} of the total |value| mass")
+        if strict:
+            raise ValueError(f"from_scipy_csr(strict=True): {detail}")
+        warnings.warn(
+            f"from_scipy_csr: {detail}; keeping the k largest-|value| "
+            "entries per row", stacklevel=2)
     indices = np.zeros((n, k), np.int32)
     values = np.zeros((n, k), np.float32)
     indices[row[keep], pos[keep]] = col[keep]
@@ -873,6 +1318,130 @@ def _permuted_rmatvec_lanes(X: PermutedHybridRows, R):
     return jnp.concatenate(parts, axis=0)
 
 
+def sorted_segment_sum(data, segment_ids, num_segments: int):
+    """Scatter-free segment sum for ids SORTED ascending: one cumsum plus
+    boundary gathers — the same cumulative-sum-difference machinery as the
+    permuted layouts' tail reduction (`_tail_rowsum`), exposed for the
+    other sorted-reduction consumers (evaluation/grouped.py).
+
+    ``data``: (m,) or (m, G); ``segment_ids``: (m,) nondecreasing ints.
+    Matches ``jax.ops.segment_sum(..., indices_are_sorted=True)`` up to
+    f32 summation order, with zero combining scatters in the traced
+    program (segment boundaries come from a binary-search
+    ``searchsorted``, per-segment sums from cumsum differences)."""
+    bounds = jnp.searchsorted(
+        jnp.asarray(segment_ids),
+        jnp.arange(num_segments + 1, dtype=jnp.int32))
+    return _tail_rowsum(data, bounds)
+
+
+def _bell_compute(v, g):
+    """(values, gathered) in the tail-contraction compute dtype: bf16
+    storage multiplies in bf16 (the MXU recipe — f32 accumulation is
+    pinned at the einsum), f32 storage stays exact f32."""
+    if g.dtype != v.dtype:
+        g = g.astype(v.dtype)
+    return v, g
+
+
+def _bell_tail(X, w):
+    """Blocked-ELL tail matvec: per width bucket one gather of the SMALL
+    contiguous tail-coefficient slice w[d_sel:n_prefix] (ell_pcols are
+    prefix-relative — the gather table is the ~U distinct tail columns,
+    cache-resident at 10M-feature scale) + one dense einsum (f32
+    accumulation), reassembled into original row order by the single
+    `row_pos` gather. w: (d,) or (d, G) permuted; works on the (S, ...)
+    sharded buckets unchanged (the einsum string carries the extra axis).
+    """
+    lanes = w.ndim == 2
+    sharded = isinstance(X, ShardedBlockedEllRows)
+    wt = w[X.d_sel:X.n_prefix]
+    parts = []
+    for pc, pv in zip(X.ell_pcols, X.ell_vals):
+        v, g = _bell_compute(pv, wt[pc])      # ([S,] r_b, W_b[, G])
+        eq = ("srw,srwg->srg" if lanes else "srw,srw->sr") if sharded \
+            else ("rw,rwg->rg" if lanes else "rw,rw->r")
+        parts.append(jnp.einsum(eq, v, g,
+                                preferred_element_type=jnp.float32))
+    return parts
+
+
+def _bell_matvec(X: BlockedEllRows, w):
+    """w: (d,) or (d, G) PERMUTED. Hot block against the contiguous prefix
+    slice, blocked-ELL tail — gathers and dense contractions only."""
+    hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
+                     preferred_element_type=jnp.float32)
+    lanes = w.ndim == 2
+    zero = jnp.zeros((1, w.shape[1]) if lanes else (1,), jnp.float32)
+    cat = jnp.concatenate(_bell_tail(X, w) + [zero], axis=0)
+    return hot + cat[X.row_pos]
+
+
+def _bell_rmatvec(X: BlockedEllRows, r, square: bool = False):
+    """Xᵀr (or (X∘X)ᵀr): hot matmul + per-occurrence-bucket pre-sorted
+    gather/reduce, assembled by concatenation — no scatter. r: (n,) or
+    (n, G)."""
+    f32 = jnp.float32
+    lanes = r.ndim == 2
+    dense = X.dense * X.dense if square else X.dense
+    parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
+                        preferred_element_type=f32)]
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        if square:
+            v = bv.astype(f32)
+            v, g = v * v, r[br].astype(f32)
+        else:
+            v, g = _bell_compute(bv, r[br])
+        eq = "ck,ckg->cg" if lanes else "ck,ck->c"
+        parts.append(jnp.einsum(eq, v, g, preferred_element_type=f32))
+    pad = X.n_features - X.n_prefix
+    if pad:
+        parts.append(jnp.zeros((pad, r.shape[1]) if lanes else (pad,), f32))
+    return jnp.concatenate(parts, axis=0)
+
+
+def _sbell_matvec(X: ShardedBlockedEllRows, w):
+    """Global (plain-jit) view of the sharded blocked-ELL matvec: the
+    per-shard bucket einsums carry the shard axis, the reassembly gather
+    vmaps over shards. Inside shard_map the solver never reaches this —
+    `local()` routes to the single-device ops."""
+    hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
+                     preferred_element_type=jnp.float32)
+    lanes = w.ndim == 2
+    S = X.n_shards
+    zero = jnp.zeros((S, 1, w.shape[1]) if lanes else (S, 1), jnp.float32)
+    cat = jnp.concatenate(_bell_tail(X, w) + [zero], axis=1)
+    tail = jax.vmap(lambda c, rp: c[rp])(cat, jnp.asarray(X.row_pos))
+    return hot + tail.reshape((X.dense.shape[0],) + w.shape[1:])
+
+
+def _sbell_rmatvec(X: ShardedBlockedEllRows, r, square: bool = False):
+    """Global view of the sharded blocked-ELL rmatvec: per-shard
+    occurrence-bucket gather/reduce summed over shards, assembled by
+    concatenation — still no scatter."""
+    f32 = jnp.float32
+    S, n_local = X.n_shards, X.n_local
+    lanes = r.ndim == 2
+    dense = X.dense * X.dense if square else X.dense
+    parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
+                        preferred_element_type=f32)]
+    r2 = r.reshape((S, n_local) + r.shape[1:])
+    s_idx = jnp.arange(S)[:, None, None]
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        g = r2[s_idx, br]                      # (S, c_b, k_b[, G])
+        if square:
+            v = bv.astype(f32)
+            v, g = v * v, g.astype(f32)
+        else:
+            v, g = _bell_compute(bv, g)
+        eq = "sck,sckg->cg" if lanes else "sck,sck->c"
+        parts.append(jnp.einsum(eq, v, g, preferred_element_type=f32))
+    pad = X.n_features - X.n_prefix
+    if pad:
+        parts.append(jnp.zeros((pad, r.shape[1]) if lanes else (pad,), f32))
+    return jnp.concatenate(parts, axis=0)
+
+
 def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     """X @ w -> (n,). The GLM margin hot path.
 
@@ -886,6 +1455,10 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
     docstring; models/training and models/glm translate at their
     boundaries).
     """
+    if isinstance(X, BlockedEllRows):
+        return _bell_matvec(X, w)
+    if isinstance(X, ShardedBlockedEllRows):
+        return _sbell_matvec(X, w)
     if isinstance(X, PermutedHybridRows):
         return _permuted_matvec(X, w)
     if isinstance(X, ShardedPermutedHybridRows):
@@ -918,6 +1491,10 @@ def matvec(X: Matrix, w: jax.Array) -> jax.Array:
 def rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     """X^T @ r -> (d,). The gradient aggregation hot path (f32 accumulation,
     bf16-storage aware like matvec)."""
+    if isinstance(X, BlockedEllRows):
+        return _bell_rmatvec(X, r)
+    if isinstance(X, ShardedBlockedEllRows):
+        return _sbell_rmatvec(X, r)
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec(X, r)
     if isinstance(X, ShardedPermutedHybridRows):
@@ -956,6 +1533,10 @@ def matvec_lanes(X: Matrix, W: jax.Array) -> jax.Array:
     (lane-MAJOR (G, d)) pays both per lane: measured ~3.5× slower at G=4
     on the 10M-feature headline problem (docs/PERF.md).
     """
+    if isinstance(X, BlockedEllRows):
+        return _bell_matvec(X, W)
+    if isinstance(X, ShardedBlockedEllRows):
+        return _sbell_matvec(X, W)
     if isinstance(X, PermutedHybridRows):
         return _permuted_matvec_lanes(X, W)
     if isinstance(X, ShardedPermutedHybridRows):
@@ -991,6 +1572,10 @@ def rmatvec_lanes(X: Matrix, R: jax.Array) -> jax.Array:
     contiguous floats per segment id (one scatter row of width G instead of
     G scalar scatters), the hot block is one (d_sel, n) × (n, G) matmul.
     """
+    if isinstance(X, BlockedEllRows):
+        return _bell_rmatvec(X, R)
+    if isinstance(X, ShardedBlockedEllRows):
+        return _sbell_rmatvec(X, R)
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec_lanes(X, R)
     if isinstance(X, ShardedPermutedHybridRows):
@@ -1029,6 +1614,10 @@ def sq_rmatvec(X: Matrix, r: jax.Array) -> jax.Array:
     (reference: one value per feature name+term per example), so the
     distinction never arises on real data; dedupe the COO if yours can.
     """
+    if isinstance(X, BlockedEllRows):
+        return _bell_rmatvec(X, r, square=True)
+    if isinstance(X, ShardedBlockedEllRows):
+        return _sbell_rmatvec(X, r, square=True)
     if isinstance(X, PermutedHybridRows):
         return _permuted_rmatvec(X, r, square=True)
     if isinstance(X, ShardedPermutedHybridRows):
@@ -1070,10 +1659,10 @@ def weighted_gram(X: Matrix, r: jax.Array) -> jax.Array:
     at the 10M-feature regime a (d, d) Gram is impossible anyway; use
     hess_diag (VarianceComputationType.SIMPLE) there.
     """
-    if isinstance(X, PermutedHybridRows):
+    if isinstance(X, (PermutedHybridRows, BlockedEllRows)):
         if X.n_features > MAX_GRAM_FEATURES:
             raise ValueError(
-                f"weighted_gram densifies PermutedHybridRows: "
+                f"weighted_gram densifies {type(X).__name__}: "
                 f"d={X.n_features} exceeds "
                 f"MAX_GRAM_FEATURES={MAX_GRAM_FEATURES}; use "
                 "hess_diag/SIMPLE variances for large feature spaces"
@@ -1153,7 +1742,7 @@ def last_column_is_intercept(X: Matrix) -> bool:
         # this, not the whole multi-GB block.
         return np.asarray(dense[:, j])
 
-    if isinstance(X, PermutedHybridRows):
+    if isinstance(X, (PermutedHybridRows, BlockedEllRows)):
         if X.last_col_pos < X.d_sel:  # an intercept is maximally hot
             return bool((_host_col(X.dense, X.last_col_pos) == 1.0).all())
         if X.last_col_pos >= X.n_prefix:
@@ -1206,4 +1795,77 @@ def nnz_stats(X: Matrix) -> tuple[int, int]:
         return n, int(np.prod(X.values.shape))
     if isinstance(X, PermutedHybridRows):
         return n, int(np.prod(X.dense.shape)) + int(X.tail_vals.shape[0])
+    if isinstance(X, (BlockedEllRows, ShardedBlockedEllRows)):
+        return n, int(np.prod(X.dense.shape)) + X.tail_nnz
     return n, int(np.prod(X.shape))
+
+
+# ----------------------------------------------------------------- contracts
+# Static-analysis contracts for the blocked-ELL layout, registered NEXT TO
+# the layout they pin (photon_tpu/analysis convention): BOTH X passes are
+# scatter-free — not just combining-scatter-free, the FULL scatter family
+# is forbidden — and every tail dot/einsum accumulates f32 even with bf16
+# storage (`require_f32_accum`, the round-12 dtype rule).
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import SCATTER_PRIMITIVES  # noqa: E402
+
+
+def _contract_blocked_ell(n=48, d=96, k=6, d_dense=16, bf16=False):
+    """A small zipf blocked-ELL matrix (hot block + multi-width ELL tail
+    + occurrence buckets all populated); bf16=True casts feature storage
+    the way dataset.cast_features does."""
+    rng = np.random.default_rng(0)
+    col = (rng.zipf(1.5, size=(n, k)).astype(np.int64) - 1) % (d - 1)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    ind = np.concatenate([col, np.full((n, 1), d - 1)], axis=1).astype(
+        np.int32)
+    va = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+    X = to_blocked_ell(SparseRows(ind, va, d), d_dense)
+    if bf16:
+        bf = jnp.bfloat16
+        X = dataclasses.replace(
+            X, dense=jnp.asarray(X.dense).astype(bf),
+            ell_vals=tuple(jnp.asarray(v).astype(bf) for v in X.ell_vals),
+            bucket_vals=tuple(jnp.asarray(v).astype(bf)
+                              for v in X.bucket_vals))
+    return X
+
+
+@register_contract(
+    name="blocked_ell_x_passes",
+    description="BlockedEllRows matvec + rmatvec (bf16 storage) traced as "
+                "one program: gather-fused tail, ZERO scatters of any "
+                "kind in either X pass, every sparse dot/einsum "
+                "accumulating f32",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("resident", "sparse"))
+def _contract_blocked_ell_x_passes():
+    X = _contract_blocked_ell(bf16=True)
+    n, d = X.shape
+
+    def both(Xb, w, r):
+        z = matvec(Xb, w)                 # X pass 1: the margin
+        return z, rmatvec(Xb, r * z)      # X pass 2: the gradient backprop
+
+    return both, (X, jnp.zeros((d,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32))
+
+
+@register_contract(
+    name="blocked_ell_lane_x_passes",
+    description="BlockedEllRows lane-minor X passes (matvec_lanes + "
+                "rmatvec_lanes, G=4, bf16 storage): scatter-free, f32 "
+                "accumulation — the reg-sweep form of the same law",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("resident", "lane", "sparse"))
+def _contract_blocked_ell_lane_x_passes():
+    X = _contract_blocked_ell(bf16=True)
+    n, d = X.shape
+    G = 4
+
+    def both(Xb, W, R):
+        Z = matvec_lanes(Xb, W)
+        return Z, rmatvec_lanes(Xb, R * Z)
+
+    return both, (X, jnp.zeros((d, G), jnp.float32),
+                  jnp.zeros((n, G), jnp.float32))

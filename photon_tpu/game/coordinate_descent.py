@@ -187,7 +187,8 @@ def _fused_fixed_update(batch, base, scores, w0, obj, l1, y, weights,
 
 def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
     from photon_tpu.data.dataset import ChunkedMatrix
-    from photon_tpu.data.matrix import PermutedHybridRows, ShardedHybridRows
+    from photon_tpu.data.matrix import (BlockedEllRows, PermutedHybridRows,
+                                        ShardedHybridRows)
     from photon_tpu.optim.config import OptimizerType
 
     # PermutedHybridRows keeps the train_glm route: that boundary owns the
@@ -199,7 +200,7 @@ def _fixed_fusable(coord: FixedEffectCoordinate, prior) -> bool:
     return (prior is None and coord.mesh is None
             and not isinstance(coord.dataset.X,
                                (ShardedHybridRows, PermutedHybridRows,
-                                ChunkedMatrix))
+                                BlockedEllRows, ChunkedMatrix))
             and (coord.normalization is None
                  or coord.normalization.is_identity)
             # OWL-QN keeps the train_glm route: its single-device dense

@@ -30,7 +30,7 @@ import numpy as np
 
 from photon_tpu.data.dataset import (ChunkedMatrix, GLMBatch,
                                      make_chunked_batch)
-from photon_tpu.data.matrix import (HybridRows, Matrix,
+from photon_tpu.data.matrix import (BlockedEllRows, HybridRows, Matrix,
                                     PermutedHybridRows, SparseRows)
 
 
@@ -80,7 +80,8 @@ class GameData:
             else jax.device_put
 
         def put_shard(X):
-            if isinstance(X, (HybridRows, PermutedHybridRows)):
+            if isinstance(X, (HybridRows, PermutedHybridRows,
+                              BlockedEllRows)):
                 if sharding is not None:
                     raise ValueError(
                         f"{type(X).__name__} shards cannot be row-sharded "
@@ -108,7 +109,7 @@ def _shard_dim(X: Matrix) -> int:
 
 def _gather_rows(X: Matrix, idx: np.ndarray):
     """Host-side row gather; returns numpy (dense) or numpy-backed SparseRows."""
-    if isinstance(X, (HybridRows, PermutedHybridRows)):
+    if isinstance(X, (HybridRows, PermutedHybridRows, BlockedEllRows)):
         raise TypeError(
             f"{type(X).__name__} shards are not supported for GAME entity bucketing "
             "(single-device fixed-effect representation); use SparseRows or "
@@ -156,7 +157,7 @@ class FixedEffectDataset:
                 shard_name, X, np.asarray(data.y, np.float32),
                 np.asarray(data.weights, np.float32))
         if not isinstance(X, (SparseRows, HybridRows,
-                              PermutedHybridRows)) and not (
+                              PermutedHybridRows, BlockedEllRows)) and not (
                 isinstance(X, jax.Array)
                 and jnp.issubdtype(X.dtype, jnp.floating)):
             # host numpy (and integer device arrays) transfer/normalize as

@@ -421,13 +421,14 @@ class GameEstimator:
         (streamed-objective) shards fall back the same way — the lane grid
         would multiply the per-pass host→device stream per lane."""
         from photon_tpu.data.dataset import ChunkedMatrix
-        from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
+        from photon_tpu.data.matrix import (BlockedEllRows, HybridRows,
+                                            PermutedHybridRows,
                                             ShardedHybridRows)
 
         for cfg in self.coordinate_configs.values():
             X = data.shards[cfg.feature_shard]
             if isinstance(X, (ShardedHybridRows, PermutedHybridRows,
-                              ChunkedMatrix)):
+                              BlockedEllRows, ChunkedMatrix)):
                 return False
             if isinstance(X, HybridRows) and (
                     self.mesh is not None

@@ -18,7 +18,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from photon_tpu.data.matrix import (Matrix, PermutedHybridRows, matvec,
+from photon_tpu.data.matrix import (BlockedEllRows, Matrix,
+                                    PermutedHybridRows, matvec,
                                     matvec_lanes)
 from photon_tpu.ops.losses import TaskType, mean_fn
 
@@ -89,7 +90,7 @@ class GeneralizedLinearModel:
 # the boundary (one gather — see PermutedHybridRows docstring).
 @jax.jit
 def _margin_jit(X, w, offsets):
-    if isinstance(X, PermutedHybridRows):
+    if isinstance(X, (PermutedHybridRows, BlockedEllRows)):
         w = X.from_model_space(w)
     return matvec(X, w) + offsets
 
@@ -112,6 +113,10 @@ def chunked_margins(X, w, offsets=0.0) -> jax.Array:
     import jax as _jax
 
     w = jnp.asarray(w, jnp.float32)
+    if getattr(X, "permuted", False):
+        # blocked-ELL chunk ladder: every chunk shares ONE global column
+        # permutation — translate once for the whole stream.
+        w = w[jnp.asarray(X.perm_cols)]
     parts, nxt = [], _jax.device_put(X.chunks[0])
     for i in range(X.n_chunks):
         cur = nxt
@@ -124,7 +129,7 @@ def chunked_margins(X, w, offsets=0.0) -> jax.Array:
 
 @jax.jit
 def _score_many(W, X, offsets):
-    if isinstance(X, PermutedHybridRows):
+    if isinstance(X, (PermutedHybridRows, BlockedEllRows)):
         return matvec_lanes(X, W[:, X.perm_cols].T).T + offsets
     return jax.vmap(lambda w: matvec(X, w))(W) + offsets
 

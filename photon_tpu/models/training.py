@@ -28,9 +28,20 @@ from photon_tpu.parallel.mesh import shard_map
 
 from photon_tpu.data.dataset import (ChunkedBatch, ChunkedMatrix, GLMBatch,
                                      pad_batch)
-from photon_tpu.data.matrix import (HybridRows, PermutedHybridRows,
+from photon_tpu.data.matrix import (BlockedEllRows, HybridRows,
+                                    PermutedHybridRows,
+                                    ShardedBlockedEllRows,
                                     ShardedHybridRows,
                                     ShardedPermutedHybridRows, SparseRows)
+
+# The permuted-coordinate layouts (solver works in permuted space;
+# translation at this module's public boundary) and their mesh-sharded
+# forms — the blocked-ELL pair joins the round-5 permuted pair.
+_PERMUTED_TYPES = (PermutedHybridRows, ShardedPermutedHybridRows,
+                   BlockedEllRows, ShardedBlockedEllRows)
+_SINGLE_DEVICE_PERMUTED = (PermutedHybridRows, BlockedEllRows)
+_SHARDED_TYPES = (ShardedHybridRows, ShardedPermutedHybridRows,
+                  ShardedBlockedEllRows)
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.models.variance import VarianceComputationType, compute_variances
 from photon_tpu.ops.losses import TaskType
@@ -164,7 +175,18 @@ def _hybrid_specs(X, axes: tuple, wrap=lambda s: s):
     leaf's axis 0 over all mesh axes, global vectors replicated. ``wrap``
     lifts each PartitionSpec (e.g. into a NamedSharding for device_put)."""
     dat, rep = wrap(P(axes)), wrap(P())
-    if isinstance(X, ShardedPermutedHybridRows):
+    if isinstance(X, ShardedBlockedEllRows):
+        x = ShardedBlockedEllRows(
+            dense=dat,
+            ell_pcols=tuple(dat for _ in X.ell_pcols),
+            ell_vals=tuple(dat for _ in X.ell_vals),
+            row_pos=dat,
+            bucket_rows=tuple(dat for _ in X.bucket_rows),
+            bucket_vals=tuple(dat for _ in X.bucket_vals),
+            perm_cols=rep, inv_perm=rep,
+            n_features=X.n_features, n_prefix=X.n_prefix,
+            last_col_pos=X.last_col_pos, tail_nnz=X.tail_nnz)
+    elif isinstance(X, ShardedPermutedHybridRows):
         x = ShardedPermutedHybridRows(
             dense=dat, tail_pcols=dat, tail_vals=dat, row_bounds=dat,
             bucket_rows=tuple(dat for _ in X.bucket_rows),
@@ -241,7 +263,8 @@ def _matrix_dim(X) -> int:
     return (X.n_features
             if isinstance(X, (SparseRows, HybridRows, ShardedHybridRows,
                               PermutedHybridRows,
-                              ShardedPermutedHybridRows, ChunkedMatrix))
+                              ShardedPermutedHybridRows, BlockedEllRows,
+                              ShardedBlockedEllRows, ChunkedMatrix))
             else X.shape[1])
 
 
@@ -477,16 +500,15 @@ def train_glm_grid(
             "multiply the per-pass host→device stream); run the sweep "
             "sequentially — each point is a train_glm(ChunkedBatch) solve")
     d = _matrix_dim(batch.X)
-    sharded_hybrid = mesh is not None and isinstance(
-        batch.X, (ShardedHybridRows, ShardedPermutedHybridRows))
-    permuted = isinstance(batch.X, (PermutedHybridRows,
-                                    ShardedPermutedHybridRows))
-    if isinstance(batch.X, PermutedHybridRows) and mesh is not None:
+    sharded_hybrid = mesh is not None and isinstance(batch.X,
+                                                     _SHARDED_TYPES)
+    permuted = isinstance(batch.X, _PERMUTED_TYPES)
+    if isinstance(batch.X, _SINGLE_DEVICE_PERMUTED) and mesh is not None:
         raise ValueError(
-            "PermutedHybridRows is a single-device representation (its "
-            "bucketed tail cannot be row-sharded); use "
-            "ShardedPermutedHybridRows (data.dataset.shard_permuted_batch) "
-            "or ShardedHybridRows under a mesh")
+            f"{type(batch.X).__name__} is a single-device representation "
+            "(its bucketed tail cannot be row-sharded); use the sharded "
+            "form (data.dataset.shard_permuted_batch / "
+            "shard_blocked_ell_batch) or ShardedHybridRows under a mesh")
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
     norm_obj, intercept_index = norm, -1
@@ -649,9 +671,40 @@ def train_glm_streamed(
         f = np.asarray(norm.factors) if norm.factors is not None else 1.0
         prior_precision = jnp.asarray(
             np.asarray(prior_precision, np.float32) * f * f)
+    # Blocked-ELL chunk ladders (data.dataset.chunk_blocked_ell) carry ONE
+    # global column permutation for the whole stream: translate the
+    # original-space side inputs in, exactly as _permuted_prep does for
+    # the resident permuted layouts, and translate the solution back out
+    # below. Mesh streaming stays SparseRows-only (the per-chunk ELL
+    # buckets are laid for one device).
+    permuted = data.X.permuted
+    norm_obj, intercept_index = norm, -1
+    if permuted:
+        if mesh is not None:
+            raise ValueError(
+                "blocked-ELL chunk ladders are single-device streams "
+                "(per-chunk ELL buckets cannot row-shard); stream "
+                "SparseRows chunks under a mesh, or drop mesh=")
+        perm = np.asarray(data.X.perm_cols)
+        w0 = jnp.asarray(w0)[jnp.asarray(perm)]
+        if prior_mean is not None:
+            prior_mean = jnp.asarray(prior_mean)[jnp.asarray(perm)]
+        if prior_precision is not None:
+            prior_precision = jnp.asarray(prior_precision)[jnp.asarray(perm)]
+        if norm is not None:
+            import dataclasses as _dc
+
+            norm_obj = _dc.replace(
+                norm,
+                factors=(None if norm.factors is None
+                         else np.asarray(norm.factors)[perm]),
+                shifts=(None if norm.shifts is None
+                        else np.asarray(norm.shifts)[perm]))
+        intercept_index = data.X.last_col_pos
     obj = make_objective(task, config, d, prior_mean=prior_mean,
                          prior_precision=prior_precision,
-                         normalization=norm)
+                         normalization=norm_obj,
+                         intercept_index=intercept_index)
     if config.effective_optimizer() is OptimizerType.OWLQN:
         res = minimize_owlqn_streamed(
             obj, data, w0, config.reg.l1_weight(config.reg_weight),
@@ -661,6 +714,11 @@ def train_glm_streamed(
         res = minimize_lbfgs_streamed(
             obj, data, w0, max_iters=config.max_iters,
             tolerance=config.tolerance, history=config.history, mesh=mesh)
+    if permuted:
+        # Back to original column order (one gather) BEFORE the
+        # normalization unfold, as at every permuted boundary.
+        res = res._replace(w=jnp.asarray(res.w)[jnp.asarray(
+            np.asarray(data.X.inv_perm))])
     w_out = res.w
     if norm is not None:
         w_out = jnp.asarray(norm.to_original_space(np.asarray(res.w)))
@@ -724,14 +782,13 @@ def train_glm(
             mesh=mesh)
     d = _matrix_dim(batch.X)
     norm = _active_norm(normalization)
-    permuted = isinstance(batch.X, (PermutedHybridRows,
-                                    ShardedPermutedHybridRows))
-    if isinstance(batch.X, PermutedHybridRows) and mesh is not None:
+    permuted = isinstance(batch.X, _PERMUTED_TYPES)
+    if isinstance(batch.X, _SINGLE_DEVICE_PERMUTED) and mesh is not None:
         raise ValueError(
-            "PermutedHybridRows is a single-device representation (its "
-            "bucketed tail cannot be row-sharded); use "
-            "ShardedPermutedHybridRows (data.dataset.shard_permuted_batch) "
-            "or ShardedHybridRows under a mesh")
+            f"{type(batch.X).__name__} is a single-device representation "
+            "(its bucketed tail cannot be row-sharded); use the sharded "
+            "form (data.dataset.shard_permuted_batch / "
+            "shard_blocked_ell_batch) or ShardedHybridRows under a mesh")
     prior_full_precision = None
     if prior is not None:
         if prior_mean is not None or prior_precision is not None:
@@ -772,14 +829,15 @@ def train_glm(
         if prior_full_precision is not None:
             raise ValueError(
                 "full-covariance priors are not supported with "
-                "PermutedHybridRows (a (d, d) precision at permuted-hybrid "
-                "scale is impractical; use a diagonal prior)")
+                f"{type(batch.X).__name__} (a (d, d) precision at "
+                "permuted-hybrid scale is impractical; use a diagonal "
+                "prior)")
         w0, prior_mean, prior_precision, norm_obj = _permuted_prep(
             batch.X, w0, prior_mean, prior_precision, norm)
         intercept_index = batch.X.last_col_pos
         use_fused = False
-    sharded_hybrid = mesh is not None and isinstance(
-        batch.X, (ShardedHybridRows, ShardedPermutedHybridRows))
+    sharded_hybrid = mesh is not None and isinstance(batch.X,
+                                                     _SHARDED_TYPES)
     axis_name = None
     if sharded_hybrid:
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
@@ -1007,3 +1065,30 @@ def _contract_sharded_permuted_grid_lanes():
     fn = lambda b, w, o, l2v: _train_run_sharded_grid_lanes(  # noqa: E731
         b, w, o, l2v, None, static_cfg, mesh)
     return fn, (batch, jnp.zeros((d,), jnp.float32), obj, l2s)
+
+
+@register_contract(
+    name="sharded_blocked_ell_value_and_grad",
+    description="ShardedBlockedEllRows shard_map evaluation (bf16 "
+                "storage): ONE psum, ZERO scatter ops of any kind, every "
+                "sparse dot/einsum accumulating f32 — the blocked-ELL law "
+                "holds on the mesh path",
+    collectives={"psum": 1}, forbid=SCATTER_PRIMITIVES,
+    require_f32_accum=True, tags=("resident", "mesh", "sparse"))
+def _contract_sharded_blocked_ell_value_and_grad():
+    from photon_tpu.data.dataset import (cast_features,
+                                         shard_blocked_ell_batch)
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    d = 96
+    batch = cast_features(
+        shard_blocked_ell_batch(_contract_sparse_batch(16 * n_sh, d),
+                                n_sh, d_dense=16))
+    cfg = _contract_cfg(reg_weight=0.5)
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d,
+                         axis_name=mesh.axis_names[0],
+                         intercept_index=batch.X.last_col_pos)
+    return _contract_sharded_vg(batch, mesh), \
+        (obj, batch, jnp.zeros((d,), jnp.float32))

@@ -429,6 +429,7 @@ jax.tree_util.register_dataclass(
 # photon_tpu.analysis` and tests/test_analysis_contracts.py). Builders run
 # only when the checker traces them — module import just records the spec.
 from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import SCATTER_PRIMITIVES  # noqa: E402
 
 
 def _contract_batch(n=64, d=8, feature_dtype=None):
@@ -476,6 +477,55 @@ def _contract_resident_value_and_grad_bf16():
     obj = _contract_objective()
     w = jnp.zeros((8,), jnp.float32)
     return (lambda o, wv, b: o.value_and_grad(wv, b)), (obj, w, batch)
+
+
+@register_contract(
+    name="streamed_blocked_ell_chunk_partials",
+    description="Objective.chunk_value_grad_partials on a blocked-ELL "
+                "chunk (the streamed-chunk leaf): communication-free, "
+                "zero scatters of any kind, every sparse dot/einsum "
+                "accumulating f32 — the out-of-HBM face of the "
+                "blocked-ELL law",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("streamed", "sparse"))
+def _contract_streamed_blocked_ell_chunk_partials():
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.data.matrix import _contract_blocked_ell
+
+    X = _contract_blocked_ell(bf16=True)
+    n = X.shape[0]
+    batch = make_batch(X, jnp.zeros((n,), jnp.float32))
+    obj = _contract_objective()
+    w = jnp.zeros((X.n_features,), jnp.float32)
+    return (lambda o, wv, b: o.chunk_value_grad_partials(wv, b)), \
+        (obj, w, batch)
+
+
+@register_contract(
+    name="lane_blocked_ell_value_and_grad",
+    description="lane-minor margin + value_and_grad_at_margin over a "
+                "BlockedEllRows batch (G=3): the reg-sweep evaluation is "
+                "scatter-free with f32 accumulation",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("lane", "sparse"))
+def _contract_lane_blocked_ell_value_and_grad():
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.data.matrix import _contract_blocked_ell
+
+    X = _contract_blocked_ell(bf16=True)
+    n, d = X.shape
+    G = 3
+    batch = make_batch(X, jnp.zeros((n,), jnp.float32))
+    obj = _contract_objective()
+    l2s = jnp.asarray([0.1, 0.5, 1.0], jnp.float32)
+
+    def fn(o, l2v, W, b):
+        from photon_tpu.ops import lane_objective as lo
+
+        z = lo.margin_lanes(o, W, b)
+        return lo.value_and_grad_at_margin_lanes(o, l2v, W, z, b)
+
+    return fn, (obj, l2s, jnp.zeros((d, G), jnp.float32), batch)
 
 
 @register_contract(

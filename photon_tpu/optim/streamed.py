@@ -477,6 +477,15 @@ class _MeshStream:
 
 def _backend(data, mesh, prefetch: int):
     if mesh is not None:
+        if getattr(data.X, "permuted", False):
+            # blocked-ELL chunk ladders (data.dataset.chunk_blocked_ell)
+            # are laid for one device per chunk — their ELL buckets have
+            # no row-sharded form; the gather-fused single-chip stream is
+            # the supported regime.
+            raise ValueError(
+                "blocked-ELL chunk ladders cannot stream over a mesh "
+                "(per-chunk ELL buckets are single-device); stream "
+                "SparseRows chunks under a mesh, or drop mesh=")
         return _MeshStream(data, mesh, prefetch)
     return _SingleDeviceStream(data, prefetch)
 

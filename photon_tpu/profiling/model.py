@@ -24,6 +24,14 @@ Two deliberate conventions:
   records XLA's own ``compiled.cost_analysis()`` view where available,
   and the utilization fraction is computed against the ESTIMATE that
   binds (the model is a ceiling check, not an exact simulator).
+- **Gathers/scatters are costed per SLICE, not per operand** (round 12).
+  A w-gather over a 10M-feature table touches ``n_indices`` granules,
+  not the 40 MB table — the operand-bytes proxy would claim sparse
+  programs are 1000x more bandwidth-hungry than they are. Each slice
+  pays ``max(slice_bytes, GATHER_GRANULE_BYTES)`` (the irregular-access
+  floor: a 4-byte scalar gather still moves a granule), tallied into
+  ``StaticCost.gather_bytes`` so the attribution report can show the
+  irregular-access share of a sparse program's roofline.
 """
 from __future__ import annotations
 
@@ -67,12 +75,54 @@ _REDUCTION = frozenset({
 
 # Data movement with no arithmetic: bytes only.
 _MOVEMENT = frozenset({
-    "gather", "scatter", "scatter-add", "scatter-mul", "scatter-min",
-    "scatter-max", "dynamic_slice", "dynamic_update_slice", "slice",
+    "scatter", "dynamic_update_slice", "slice",
     "concatenate", "reshape", "broadcast_in_dim", "transpose", "rev",
     "pad", "squeeze", "convert_element_type", "bitcast_convert_type",
     "iota", "sort",
 })
+
+# Irregular random-access ops (gathers, combining scatters): costed per
+# SLICE, not per operand — charging a (d,)-table gather its full table
+# bytes would put a 40 MB read on every 10M-feature w-gather and make
+# every sparse program look bandwidth-bound at 1000x its real traffic.
+# Each slice pays at least one access granule (TPU sublane/cache-line
+# scale), which is also what makes narrow scalar gathers honestly more
+# expensive per useful byte than wide ones.
+_IRREGULAR = frozenset({
+    "gather", "scatter-add", "scatter-sub", "scatter-mul", "scatter-min",
+    "scatter-max", "dynamic_slice",
+})
+
+GATHER_GRANULE_BYTES = 32
+
+
+def _irregular_bytes(eqn, name: str) -> tuple[int, int]:
+    """(random_access_bytes, regular_io_bytes) for a gather/scatter eqn:
+    index + produced/consumed bytes move sequentially; the per-slice
+    table traffic pays max(slice_bytes, GATHER_GRANULE_BYTES) per slice."""
+    try:
+        slice_sizes = eqn.params.get("slice_sizes")
+        if slice_sizes is None:  # scatter family: updates operand's window
+            upd = eqn.invars[2].aval
+            slice_elems = 1
+            dnums = eqn.params.get("dimension_numbers")
+            for i in getattr(dnums, "update_window_dims", ()):
+                slice_elems *= int(upd.shape[i])
+            ref = eqn.invars[2]
+        else:
+            slice_elems = int(np.prod(slice_sizes, dtype=np.int64)) or 1
+            ref = eqn.outvars[0]
+        itemsize = np.dtype(eqn.invars[0].aval.dtype).itemsize
+        n_slices = max(_numel(ref) // max(slice_elems, 1), 1)
+        random = n_slices * max(slice_elems * itemsize,
+                                GATHER_GRANULE_BYTES)
+        regular = (sum(_aval_bytes(v) for v in eqn.invars[1:])
+                   + sum(_aval_bytes(v) for v in eqn.outvars))
+        return int(random), int(regular)
+    except Exception:  # noqa: BLE001 — fall back to the io-bytes proxy
+        io = (sum(_aval_bytes(v) for v in eqn.invars)
+              + sum(_aval_bytes(v) for v in eqn.outvars))
+        return 0, int(io)
 
 
 def _aval_bytes(v) -> int:
@@ -120,6 +170,9 @@ class StaticCost:
     collective_bytes: float = 0.0
     transcendentals: float = 0.0
     dot_flops: float = 0.0
+    # random-access traffic of gather/scatter slices (granule-rounded;
+    # included in `bytes`) — the sparse-program share of the roofline
+    gather_bytes: float = 0.0
     eqns: int = 0
     while_loops: int = 0
     while_trips_assumed: int = 1  # the hint applied to un-lengthed loops
@@ -141,7 +194,8 @@ class StaticCost:
             "flops": self.flops, "bytes": self.bytes,
             "collective_bytes": self.collective_bytes,
             "transcendentals": self.transcendentals,
-            "dot_flops": self.dot_flops, "eqns": self.eqns,
+            "dot_flops": self.dot_flops,
+            "gather_bytes": self.gather_bytes, "eqns": self.eqns,
             "while_loops": self.while_loops,
             "while_trips_assumed": self.while_trips_assumed,
             "intensity": round(self.intensity, 4),
@@ -196,6 +250,10 @@ def estimate_jaxpr(jaxpr, while_trips: int = 1) -> StaticCost:
                 cost.collective_bytes += mult * payload
                 cost.flops += mult * sum(_numel(v) for v in eqn.invars)
                 cost.bytes += mult * io_bytes
+            elif name in _IRREGULAR:
+                random, regular = _irregular_bytes(eqn, name)
+                cost.gather_bytes += mult * random
+                cost.bytes += mult * (random + regular)
             elif name in _MOVEMENT:
                 cost.bytes += mult * io_bytes
             # anything else (rng, custom calls, ...): uncounted rather

@@ -66,9 +66,12 @@ _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
                          "stall")
 
 # Config-ish / count legs that are not performance quantities: a changed
-# topology or cadence must not read as a "regression".
+# topology, cadence, or layout split must not read as a "regression".
+# (_frac / _width_buckets: the round-12 sparse hot/tail-split facts — a
+# retuned d_dense would move them by design; pad_waste stays GATED,
+# lower-better, because growing pow2 padding is a real cost.)
 _EXCLUDE_PATTERNS = ("_n_chips", "n_requests", "snapshots", "cadence",
-                     "_vs_baseline")
+                     "_vs_baseline", "_frac", "_width_buckets")
 
 
 def lower_is_better(leg: str) -> bool:

@@ -32,7 +32,10 @@ per dispatched micro-batch; the elastic-runs `checkpoint.*` family —
 snapshots/bytes/restores plus the per-layer scope_restores/
 solver_restores/re_restores/descent_restores and gc_snapshots, with
 `checkpoint.pack`/`checkpoint.write` spans — and its `faults.*` sibling
-— injected_kills/injected_errors/io_retries/backoff_seconds — and HBM
+— injected_kills/injected_errors/io_retries/backoff_seconds — the
+grouped-evaluation `eval.*` family — scatter_elems_saved, the elements
+per metric call that would have entered combining scatters before the
+round-12 sorted-segment rework of `evaluation/grouped.py` — and HBM
 watermarks), and the
 **iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted

@@ -145,6 +145,7 @@ def _register_serializations() -> None:
 
     for cls in (_mx.SparseRows, _mx.HybridRows, _mx.ShardedHybridRows,
                 _mx.PermutedHybridRows, _mx.ShardedPermutedHybridRows,
+                _mx.BlockedEllRows, _mx.ShardedBlockedEllRows,
                 Objective, Coefficients, GeneralizedLinearModel):
         reg(cls)
     for cls in (GLMBatch, OptResult):

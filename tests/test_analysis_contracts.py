@@ -24,13 +24,59 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 25 specs (round 11 added the ledger-off pin) spanning every
-    workload family, now including the profiling attribution ledger."""
-    assert len(_REGISTRY) >= 25
+    """≥ 31 specs (round 12 added the blocked-ELL sparse pins + the
+    scatter-free grouped-evaluation pin) spanning every workload family,
+    now including the sparse layout and evaluation families."""
+    assert len(_REGISTRY) >= 31
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
-                   "serving", "checkpoint", "profiling"):
+                   "serving", "checkpoint", "profiling", "sparse",
+                   "evaluation"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_blocked_ell_specs_are_registered():
+    """The round-12 acceptance pins: BOTH X passes of the blocked-ELL
+    layout forbid the FULL scatter family (not just combining scatters)
+    and require f32 accumulation on every sparse dot/einsum, across the
+    resident, lane, streamed-chunk, and mesh faces."""
+    from photon_tpu.analysis.walker import (SCATTER_ADD_PRIMITIVES,
+                                            SCATTER_PRIMITIVES)
+
+    names = ("blocked_ell_x_passes", "blocked_ell_lane_x_passes",
+             "streamed_blocked_ell_chunk_partials",
+             "lane_blocked_ell_value_and_grad",
+             "sharded_blocked_ell_value_and_grad")
+    for name in names:
+        spec = _REGISTRY[name]
+        assert SCATTER_PRIMITIVES <= spec.forbid, name
+        assert SCATTER_ADD_PRIMITIVES <= spec.forbid, name
+        assert spec.require_f32_accum, name
+        assert not spec.allow_transfers and not spec.allow_f64, name
+    assert dict(_REGISTRY[
+        "sharded_blocked_ell_value_and_grad"].collectives) == {"psum": 1}
+
+
+def test_blocked_ell_contracts_hold_on_cpu_backend():
+    """The ADVICE.md cpu_parity_drift triage forward: the 6 tolerance
+    failures are value-level CPU reduction-order drift, but the NEW
+    sparse programs' STRUCTURAL contracts (scatter-free, f32 accumulation,
+    one psum) must hold on the CPU backend too — the parity-drift escape
+    hatch does not widen to the blocked-ELL layout. (This whole module
+    runs on the CPU backend; this test makes the blocked-ELL subset's
+    zero-violation status an explicit named assertion.)"""
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    for name in ("blocked_ell_x_passes", "blocked_ell_lane_x_passes",
+                 "streamed_blocked_ell_chunk_partials",
+                 "lane_blocked_ell_value_and_grad",
+                 "sharded_blocked_ell_value_and_grad",
+                 "grouped_auc_scatter_free"):
+        violations = check_contract(_REGISTRY[name])
+        assert violations == [], \
+            f"{name} drifted on the CPU backend:\n" + \
+            "\n".join(str(v) for v in violations)
 
 
 def test_checkpoint_off_specs_are_registered():

@@ -1,0 +1,464 @@
+"""BlockedEllRows: the blocked-ELL scatter-free sparse hot path
+(data/matrix.py, round 12). Parity contract: every op and every solve
+must agree with the SparseRows representation of the same matrix, with
+all user-facing vectors in ORIGINAL column order — across resident,
+lane-grid, streamed, and mesh paths.
+
+Mirrors tests/test_permuted.py's representation-invariance suite for the
+round-5 layout (reference: com.linkedin.photon.ml.data — LabeledPoint
+math is identical whatever the underlying vector type).
+"""
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_tpu.data.dataset import (cast_features, chunk_batch,
+                                     chunk_blocked_ell, make_batch,
+                                     pad_batch, shard_blocked_ell_batch)
+from photon_tpu.data.matrix import (BlockedEllRows, ShardedBlockedEllRows,
+                                    SparseRows, blocked_ell_from_scipy_csr,
+                                    from_scipy_csr, last_column_is_intercept,
+                                    matvec, matvec_lanes, rmatvec,
+                                    rmatvec_lanes, shard_blocked_ell,
+                                    sorted_segment_sum, sq_rmatvec,
+                                    to_blocked_ell, weighted_gram)
+from photon_tpu.models.training import train_glm, train_glm_grid
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+
+# The mesh/grid/streamed cases compile multi-device solver programs; drop
+# them at module teardown so the suite stays inside the live-executable
+# envelope (see conftest).
+pytestmark = pytest.mark.release_programs
+
+
+def _power_law_sparse(rng, n=500, d=800, k=10, d_dense=32):
+    """Zipf-ish column frequencies so the hot block, several ELL widths,
+    and the occurrence buckets all fill. Duplicate (row, col) slots get
+    value 0 (the padding convention)."""
+    col = (rng.zipf(1.5, size=(n, k)).astype(np.int64) - 1) % (d - 1)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    order = np.argsort(col, axis=1, kind="stable")
+    sorted_col = np.take_along_axis(col, order, axis=1)
+    dup = sorted_col[:, 1:] == sorted_col[:, :-1]
+    dupmask = np.zeros_like(col, bool)
+    np.put_along_axis(dupmask, order[:, 1:], dup, axis=1)
+    val[dupmask] = 0.0
+    ind = np.concatenate([col, np.full((n, 1), d - 1)], axis=1).astype(
+        np.int32)
+    va = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+    X = SparseRows(jnp.asarray(ind), jnp.asarray(va), d)
+    B = to_blocked_ell(X, d_dense)
+    return X, B
+
+
+def _labels(rng, X):
+    wt = rng.normal(size=X.n_features).astype(np.float32) * 0.5
+    z = np.asarray(matvec(X, jnp.asarray(wt)))
+    return jnp.asarray((rng.random(X.shape[0]) < 1 / (1 + np.exp(-z)))
+                       .astype(np.float32))
+
+
+# ------------------------------------------------------------ layout facts
+def test_bell_roundtrip_and_layout(rng):
+    X, B = _power_law_sparse(rng)
+    d = X.n_features
+    perm = np.asarray(B.perm_cols)
+    inv = np.asarray(B.inv_perm)
+    assert sorted(perm.tolist()) == list(range(d))
+    np.testing.assert_array_equal(perm[inv], np.arange(d))
+    v = rng.normal(size=d).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(B.to_model_space(B.from_model_space(v))), v)
+    # intercept (original last column, in every row) must be hot
+    assert B.last_col_pos < B.d_sel
+    assert np.asarray(B.dense)[:, B.last_col_pos].min() == 1.0
+    # ELL widths are a pow2 ladder, ascending, and every real tail nnz is
+    # laid exactly once: padded slots carry value 0 at column 0
+    widths = [v.shape[1] for v in B.ell_vals]
+    assert widths == sorted(widths)
+    assert all(w & (w - 1) == 0 for w in widths)
+    laid = sum(int((np.asarray(v) != 0.0).sum()) for v in B.ell_vals)
+    total = int((np.asarray(X.values) != 0.0).sum())
+    # every tail nnz is laid exactly once (tail values are nonzero by
+    # construction, padding slots are zero), and the tail is a subset of
+    # the matrix's real nnz
+    assert laid == B.tail_nnz <= total
+    assert B.ell_slots >= B.tail_nnz
+    assert B.tail_pad_waste >= 0.0
+    # row_pos: every row maps into [0, B_total] (B_total = the zero slot)
+    B_total = sum(v.shape[0] for v in B.ell_vals)
+    rp = np.asarray(B.row_pos)
+    assert rp.min() >= 0 and rp.max() <= B_total
+
+
+def test_bell_matvec_rmatvec_parity(rng):
+    X, B = _power_law_sparse(rng)
+    n, d = X.shape
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(matvec(B, B.from_model_space(w))),
+        np.asarray(matvec(X, w)), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(B.to_model_space(rmatvec(B, r))),
+        np.asarray(rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(B.to_model_space(sq_rmatvec(B, r))),
+        np.asarray(sq_rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+
+
+def test_bell_lane_ops_parity(rng):
+    X, B = _power_law_sparse(rng)
+    n, d = X.shape
+    G = 4
+    W = jnp.asarray(rng.normal(size=(d, G)).astype(np.float32))
+    R = jnp.asarray(rng.normal(size=(n, G)).astype(np.float32))
+    perm = jnp.asarray(B.perm_cols)
+    inv = np.asarray(B.inv_perm)
+    np.testing.assert_allclose(
+        np.asarray(matvec_lanes(B, W[perm])),
+        np.asarray(matvec_lanes(X, W)), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(rmatvec_lanes(B, R))[inv],
+        np.asarray(rmatvec_lanes(X, R)), rtol=2e-4, atol=2e-4)
+
+
+def test_bell_weighted_gram_parity(rng):
+    X, B = _power_law_sparse(rng, n=200, d=120, k=6, d_dense=16)
+    r = jnp.asarray(np.abs(rng.normal(size=200)).astype(np.float32))
+    inv = np.asarray(B.inv_perm)
+    g_ref = np.asarray(weighted_gram(X, r))
+    g = np.asarray(weighted_gram(B, r))[inv][:, inv]
+    np.testing.assert_allclose(g, g_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_bell_empty_tail(rng):
+    # d_dense >= d: everything is hot, no ELL buckets at all
+    X, B = _power_law_sparse(rng, n=100, d=40, k=5, d_dense=64)
+    assert B.ell_vals == () and B.tail_nnz == 0
+    assert B.tail_pad_waste == 0.0
+    w = jnp.asarray(rng.normal(size=40).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(matvec(B, B.from_model_space(w))),
+        np.asarray(matvec(X, w)), rtol=2e-4, atol=2e-4)
+    r = jnp.asarray(rng.normal(size=100).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(B.to_model_space(rmatvec(B, r))),
+        np.asarray(rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+
+
+def test_bell_pad_and_cast(rng):
+    X, B = _power_law_sparse(rng, n=100, d=300, k=6)
+    y = jnp.asarray(rng.normal(size=100).astype(np.float32))
+    b = pad_batch(make_batch(B, y), 128)
+    assert b.n == 128
+    w = jnp.asarray(rng.normal(size=300).astype(np.float32))
+    z = np.asarray(matvec(b.X, b.X.from_model_space(w)))
+    np.testing.assert_allclose(z[:100], np.asarray(matvec(X, w)), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(z[100:], 0.0, atol=1e-6)
+    bc = cast_features(b)
+    assert bc.X.dense.dtype == jnp.bfloat16
+    assert all(v.dtype == jnp.bfloat16 for v in bc.X.ell_vals)
+    assert all(v.dtype == jnp.bfloat16 for v in bc.X.bucket_vals)
+    # bf16 multiply / f32 accumulate stays within bf16 quantization noise
+    zb = np.asarray(matvec(bc.X, bc.X.from_model_space(w)))
+    assert zb.dtype == np.float32
+    np.testing.assert_allclose(zb[:100], np.asarray(matvec(X, w)),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_bell_intercept_detection(rng):
+    X, B = _power_law_sparse(rng)
+    assert last_column_is_intercept(B)
+    # break the intercept: scale one row's intercept value
+    va = np.asarray(X.values).copy()
+    va[0, -1] = 2.0
+    B2 = to_blocked_ell(SparseRows(np.asarray(X.indices), va,
+                                   X.n_features), 32)
+    assert not last_column_is_intercept(B2)
+
+
+# ------------------------------------------------------- scipy CSR builder
+def test_bell_from_scipy_csr(rng):
+    n, d = 120, 90
+    M = sp.random(n, d, density=0.08, format="csr", dtype=np.float32,
+                  random_state=np.random.RandomState(0))
+    B = blocked_ell_from_scipy_csr(M, d_dense=12)
+    w = rng.normal(size=d).astype(np.float32)
+    ref = M @ w
+    got = np.asarray(matvec(B, B.from_model_space(jnp.asarray(w))))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    r = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(B.to_model_space(rmatvec(B, jnp.asarray(r)))),
+        M.T @ r, rtol=2e-4, atol=2e-4)
+
+
+def test_from_scipy_csr_warning_reports_mass_fraction():
+    M = sp.csr_matrix(np.array([[1.0, 2.0, 3.0, 4.0],
+                                [0.0, 0.0, 5.0, 0.0]], np.float32))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        S = from_scipy_csr(M, k=2)
+    msgs = [str(w.message) for w in caught
+            if "from_scipy_csr" in str(w.message)]
+    assert len(msgs) == 1
+    # row 0 drops its 2 smallest-|value| entries (1, 2) of total mass 15
+    assert "2 smallest-|value| entries" in msgs[0]
+    assert "20.0000%" in msgs[0]
+    # kept entries are the largest-|value| ones
+    kept = np.sort(np.asarray(S.values)[0])
+    np.testing.assert_array_equal(kept[-2:], [3.0, 4.0])
+
+
+def test_from_scipy_csr_strict_raises():
+    M = sp.csr_matrix(np.array([[1.0, 2.0, 3.0]], np.float32))
+    with pytest.raises(ValueError, match="strict=True.*mass"):
+        from_scipy_csr(M, k=2, strict=True)
+    # strict with no truncation is a no-op
+    S = from_scipy_csr(M, k=3, strict=True)
+    assert S.values.shape == (1, 3)
+
+
+# ---------------------------------------------------------- solver parity
+@pytest.mark.parametrize("task", [TaskType.LOGISTIC_REGRESSION,
+                                  TaskType.LINEAR_REGRESSION,
+                                  TaskType.POISSON_REGRESSION])
+def test_bell_train_glm_parity(rng, task):
+    X, B = _power_law_sparse(rng, n=400, d=400, k=8, d_dense=24)
+    if task is TaskType.LOGISTIC_REGRESSION:
+        y = _labels(rng, X)
+        rtol, atol = 1e-5, 5e-3
+    else:
+        # abs-normal responses: a harder-conditioned fit whose two solves
+        # stop at slightly different points of the same flat optimum —
+        # value parity is the tight pin, coefficients follow looser
+        y = jnp.asarray(np.abs(rng.normal(size=400)).astype(np.float32))
+        rtol, atol = 5e-4, 5e-2
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.1, history=5)
+    m_b, r_b = train_glm(make_batch(B, y), task, cfg)
+    m_s, r_s = train_glm(make_batch(X, y), task, cfg)
+    np.testing.assert_allclose(float(r_b.value), float(r_s.value), rtol=rtol)
+    np.testing.assert_allclose(np.asarray(m_b.coefficients.means),
+                               np.asarray(m_s.coefficients.means), atol=atol)
+    # model scoring translates to permuted space internally
+    np.testing.assert_allclose(np.asarray(m_b.score(B)),
+                               np.asarray(m_b.score(X)), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_bell_grid_lanes_parity(rng):
+    X, B = _power_law_sparse(rng)
+    y = _labels(rng, X)
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-6, reg=l2(),
+                          reg_weight=0.0, history=5)
+    weights = [1e-1, 1.0, 30.0]
+    grid_b = train_glm_grid(make_batch(B, y), TaskType.LOGISTIC_REGRESSION,
+                            cfg, weights)
+    grid_s = train_glm_grid(make_batch(X, y), TaskType.LOGISTIC_REGRESSION,
+                            cfg, weights)
+    for (m_b, r_b), (m_s, r_s) in zip(grid_b, grid_s):
+        np.testing.assert_allclose(float(r_b.value), float(r_s.value),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(m_b.coefficients.means),
+                                   np.asarray(m_s.coefficients.means),
+                                   atol=3e-2)
+
+
+def test_bell_streamed_parity(rng):
+    """chunk_blocked_ell: the streamed solve over a blocked-ELL chunk
+    ladder matches the resident SparseRows solve (one global permutation
+    across chunks, translation at the train_glm boundary)."""
+    X, _ = _power_law_sparse(rng, n=384, d=150, k=6, d_dense=16)
+    y = _labels(rng, X)
+    batch = make_batch(X, y)
+    cb = chunk_blocked_ell(batch, 128, d_dense=16)
+    assert cb.X.permuted and cb.n_chunks == 3
+    # uniform chunk shapes: ONE compiled per-chunk program
+    shapes = {tuple(v.shape for v in c.ell_vals) for c in cb.X.chunks}
+    assert len(shapes) == 1
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.3, history=5)
+    m_c, r_c = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+    m_s, r_s = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)
+    np.testing.assert_allclose(float(r_c.value), float(r_s.value), rtol=5e-5)
+    # the streamed and resident L-BFGS paths diverge on near-flat sparse
+    # directions (chunked accumulation order); value parity is the tight
+    # pin, coefficients agree to ~1e-2 absolute
+    np.testing.assert_allclose(np.asarray(m_c.coefficients.means),
+                               np.asarray(m_s.coefficients.means),
+                               rtol=2e-3, atol=1e-2)
+
+
+@pytest.mark.slow
+def test_bell_streamed_owlqn_and_bf16_chunks(rng):
+    X, _ = _power_law_sparse(rng, n=256, d=200, k=6, d_dense=16)
+    y = _labels(rng, X)
+    batch = make_batch(X, y)
+    from photon_tpu.optim.config import OptimizerType
+    from photon_tpu.optim.regularization import elastic_net
+
+    cfg = OptimizerConfig(max_iters=30, tolerance=1e-7,
+                          reg=elastic_net(0.5), reg_weight=1e-2, history=5,
+                          optimizer=OptimizerType.OWLQN)
+    cb = chunk_blocked_ell(batch, 128, d_dense=16,
+                           feature_dtype=jnp.bfloat16)
+    assert all(c.dense.dtype == jnp.bfloat16 for c in cb.X.chunks)
+    m_c, r_c = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)
+    m_s, r_s = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg)
+    # bf16 feature storage: value parity within quantization noise
+    np.testing.assert_allclose(float(r_c.value), float(r_s.value), rtol=5e-3)
+
+
+def test_bell_streamed_mesh_rejected(rng, mesh8):
+    X, _ = _power_law_sparse(rng, n=160, d=120, k=5, d_dense=8)
+    y = _labels(rng, X)
+    cb = chunk_blocked_ell(make_batch(X, y), 80, d_dense=8)
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.1, history=4)
+    with pytest.raises(ValueError, match="mesh"):
+        train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg, mesh=mesh8)
+
+
+def test_bell_single_device_mesh_rejected(rng, mesh8):
+    X, B = _power_law_sparse(rng, n=160, d=120, k=5, d_dense=8)
+    y = _labels(rng, X)
+    cfg = OptimizerConfig(max_iters=5, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.1, history=4)
+    with pytest.raises(ValueError, match="single-device"):
+        train_glm(make_batch(B, y), TaskType.LOGISTIC_REGRESSION, cfg,
+                  mesh=mesh8)
+
+
+# ----------------------------------------------------------- mesh parity
+class TestShardedBlockedEll:
+    def test_ops_match_single_device(self, rng):
+        X, B = _power_law_sparse(rng, n=256, d=300, k=8, d_dense=16)
+        S = shard_blocked_ell(SparseRows(np.asarray(X.indices),
+                                         np.asarray(X.values),
+                                         X.n_features), 8, d_dense=16)
+        assert isinstance(S, ShardedBlockedEllRows)
+        assert S.n_shards == 8 and S.n_local == 32
+        n, d = X.shape
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(matvec(S, S.from_model_space(w))),
+            np.asarray(matvec(X, w)), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(S.to_model_space(rmatvec(S, r))),
+            np.asarray(rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(S.to_model_space(sq_rmatvec(S, r))),
+            np.asarray(sq_rmatvec(X, r)), rtol=2e-4, atol=2e-4)
+        G = 3
+        W = jnp.asarray(rng.normal(size=(d, G)).astype(np.float32))
+        R = jnp.asarray(rng.normal(size=(n, G)).astype(np.float32))
+        perm = jnp.asarray(S.perm_cols)
+        inv = np.asarray(S.inv_perm)
+        np.testing.assert_allclose(
+            np.asarray(matvec_lanes(S, W[perm])),
+            np.asarray(matvec_lanes(X, W)), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(rmatvec_lanes(S, R))[inv],
+            np.asarray(rmatvec_lanes(X, R)), rtol=2e-4, atol=2e-4)
+        # the local views compose to the global op
+        chunk0 = S.chunk(0)
+        np.testing.assert_allclose(
+            np.asarray(matvec(chunk0, S.from_model_space(w))),
+            np.asarray(matvec(X, w))[:32], rtol=2e-4, atol=2e-4)
+
+    def test_train_glm_mesh_matches_single_device(self, rng, mesh8):
+        X, _ = _power_law_sparse(rng, n=320, d=300, k=8, d_dense=16)
+        y = _labels(rng, X)
+        batch = shard_blocked_ell_batch(
+            make_batch(SparseRows(np.asarray(X.indices),
+                                  np.asarray(X.values), X.n_features),
+                       np.asarray(y)), 8, d_dense=16)
+        cfg = OptimizerConfig(max_iters=40, tolerance=1e-6, reg=l2(),
+                              reg_weight=0.1, history=5)
+        m_m, r_m = train_glm(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                             mesh=mesh8)
+        m_s, r_s = train_glm(make_batch(X, y),
+                             TaskType.LOGISTIC_REGRESSION, cfg)
+        np.testing.assert_allclose(float(r_m.value), float(r_s.value),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m_m.coefficients.means),
+                                   np.asarray(m_s.coefficients.means),
+                                   atol=5e-3)
+
+    @pytest.mark.slow
+    def test_train_glm_grid_lanes_mesh(self, rng, mesh8):
+        X, _ = _power_law_sparse(rng, n=320, d=300, k=8, d_dense=16)
+        y = _labels(rng, X)
+        batch = shard_blocked_ell_batch(
+            make_batch(SparseRows(np.asarray(X.indices),
+                                  np.asarray(X.values), X.n_features),
+                       np.asarray(y)), 8, d_dense=16)
+        cfg = OptimizerConfig(max_iters=40, tolerance=1e-6, reg=l2(),
+                              reg_weight=0.0, history=5)
+        weights = [0.5, 5.0]
+        grid_m = train_glm_grid(batch, TaskType.LOGISTIC_REGRESSION, cfg,
+                                weights, mesh=mesh8)
+        grid_s = train_glm_grid(make_batch(X, y),
+                                TaskType.LOGISTIC_REGRESSION, cfg, weights)
+        for (m_m, r_m), (m_s, r_s) in zip(grid_m, grid_s):
+            np.testing.assert_allclose(float(r_m.value), float(r_s.value),
+                                       rtol=1e-4)
+            np.testing.assert_allclose(np.asarray(m_m.coefficients.means),
+                                       np.asarray(m_s.coefficients.means),
+                                       atol=2e-2)
+
+    def test_cast_features_bf16(self, rng):
+        X, _ = _power_law_sparse(rng, n=64, d=80, k=5, d_dense=8)
+        batch = shard_blocked_ell_batch(
+            make_batch(SparseRows(np.asarray(X.indices),
+                                  np.asarray(X.values), X.n_features),
+                       np.zeros(64, np.float32)), 8, d_dense=8)
+        bc = cast_features(batch)
+        assert bc.X.dense.dtype == jnp.bfloat16
+        assert all(v.dtype == jnp.bfloat16 for v in bc.X.ell_vals)
+        assert all(v.dtype == jnp.bfloat16 for v in bc.X.bucket_vals)
+
+
+# ------------------------------------------------- sorted-segment helper
+def test_sorted_segment_sum_matches_segment_sum(rng):
+    ids = np.sort(rng.integers(0, 17, size=200)).astype(np.int32)
+    dat = rng.normal(size=200).astype(np.float32)
+    ref = np.asarray(jax.ops.segment_sum(jnp.asarray(dat),
+                                         jnp.asarray(ids),
+                                         num_segments=17))
+    got = np.asarray(sorted_segment_sum(jnp.asarray(dat),
+                                        jnp.asarray(ids), 17))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # lane-stacked form
+    dat2 = rng.normal(size=(200, 3)).astype(np.float32)
+    ref2 = np.asarray(jax.ops.segment_sum(jnp.asarray(dat2),
+                                          jnp.asarray(ids),
+                                          num_segments=17))
+    got2 = np.asarray(sorted_segment_sum(jnp.asarray(dat2),
+                                         jnp.asarray(ids), 17))
+    np.testing.assert_allclose(got2, ref2, rtol=1e-5, atol=1e-5)
+
+
+def test_bell_chunked_margins_permuted(rng):
+    """models.glm.chunked_margins translates the ladder's global
+    permutation once for the whole stream."""
+    from photon_tpu.models.glm import chunked_margins
+
+    X, _ = _power_law_sparse(rng, n=200, d=150, k=6, d_dense=8)
+    y = np.zeros(200, np.float32)
+    cb = chunk_blocked_ell(make_batch(X, y), 64, d_dense=8)
+    w = rng.normal(size=150).astype(np.float32)
+    got = np.asarray(chunked_margins(cb.X, w))
+    ref = np.asarray(matvec(X, jnp.asarray(w)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

@@ -238,3 +238,31 @@ def test_aupr_evaluator_wiring():
     _, _, mean = grouped_aupr(s, y, w, g, num_groups)
     np.testing.assert_allclose(ev2.evaluate(s, y, w, g), float(mean),
                                atol=1e-6)
+
+
+def test_grouped_metrics_are_scatter_free_and_counted():
+    """Round 12: the grouped metrics ride the sorted-segment machinery —
+    the traced program contains NO scatter of any kind, and each call
+    books the scatter elements it saved on the telemetry counter."""
+    import jax
+
+    from photon_tpu import telemetry
+    from photon_tpu.analysis.walker import SCATTER_PRIMITIVES, sites
+    from photon_tpu.evaluation.grouped import _grouped_auc
+
+    num_groups = 12
+    s, y, w, g = _random_groups(600, num_groups)
+    jaxpr = jax.make_jaxpr(
+        lambda *a: _grouped_auc(*a, num_groups=num_groups))(s, y, w, g)
+    scatters = [st.name for st in sites(jaxpr)
+                if st.name in SCATTER_PRIMITIVES]
+    assert scatters == []
+
+    run = telemetry.start_run("eval-test")
+    try:
+        grouped_auc(s, y, w, g, num_groups)
+        saved = run.counters.get("eval.scatter_elems_saved", 0)
+        # 6 segment reductions × 600 rows under the old formulation
+        assert saved == 6 * 600
+    finally:
+        telemetry.finish_run()

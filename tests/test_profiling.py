@@ -92,6 +92,39 @@ class TestSentinel:
         assert worse["serving_p99_ms"].status == "regressed"
         assert better["serving_p99_ms"].status == "ok"
 
+    def test_changed_sparse_legs_admit_correctly(self):
+        """The round-12 blocked-ELL swap as the sentinel sees it: a big
+        IMPROVEMENT on the existing sparse throughput legs is 'ok' (the
+        bad side is one-sided), the brand-new pad-waste leg admits as
+        'new', and pad waste gates LOWER-better once it has history."""
+        leg = "sparse10m_single_lane_rows_iters_per_sec_per_chip"
+        hist = _history(leg=leg, base=1.87e7)
+        verdicts = sentinel.gate(
+            {leg: 5 * 1.87e7, "sparse10m_tail_pad_waste": 0.11}, hist)
+        assert verdicts[leg].status == "ok"          # 5x is not a regression
+        assert verdicts[leg].z < 0                   # ... and z says "better"
+        assert verdicts["sparse10m_tail_pad_waste"].status == "new"
+        # pad waste is a lower-better cost once history exists
+        assert sentinel.lower_is_better("sparse10m_tail_pad_waste")
+        whist = _history(leg="sparse10m_tail_pad_waste", base=0.1)
+        worse = sentinel.gate({"sparse10m_tail_pad_waste": 0.9},
+                              whist)["sparse10m_tail_pad_waste"]
+        assert worse.status == "regressed"
+        better = sentinel.gate({"sparse10m_tail_pad_waste": 0.01},
+                               whist)["sparse10m_tail_pad_waste"]
+        assert better.status == "ok"
+
+    def test_layout_split_legs_are_excluded(self):
+        """hot/tail split + width-bucket counts are layout CONFIG facts —
+        a retuned d_dense moves them by design, so they never gate."""
+        verdicts = sentinel.gate(
+            {"sparse10m_hot_nnz_frac": 0.7, "sparse10m_tail_nnz_frac": 0.3,
+             "sparse10m_ell_width_buckets": 3, "dense_rate": 1e8},
+            _history())
+        assert "sparse10m_hot_nnz_frac" not in verdicts
+        assert "sparse10m_tail_nnz_frac" not in verdicts
+        assert "sparse10m_ell_width_buckets" not in verdicts
+
     def test_config_legs_are_not_gated(self):
         hist = _history(leg="streamed_mesh_n_chips", base=8.0)
         verdicts = sentinel.gate({"streamed_mesh_n_chips": 4.0}, hist)
@@ -201,6 +234,33 @@ class TestStaticModel:
         assert c1.while_loops == 1 and c1.lower_bound
         assert not c10.lower_bound
         assert c10.flops > c1.flops  # body cost scales with the hint
+
+    def test_gather_costed_per_slice_not_per_table(self):
+        """Round 12: a w-gather over a big table charges per-index granule
+        traffic (the honest sparse cost), NOT the whole table's bytes."""
+        import jax.numpy as jnp
+
+        from photon_tpu.profiling.model import GATHER_GRANULE_BYTES
+
+        d, m = 100_000, 64
+        table = jnp.zeros((d,), jnp.float32)
+        idx = jnp.zeros((m,), jnp.int32)
+        cost = profiling.estimate_fn(lambda t, i: t[i], (table, idx))
+        table_bytes = d * 4
+        # scalar slices: m granules on the random side
+        assert cost.gather_bytes == m * GATHER_GRANULE_BYTES
+        assert cost.bytes < table_bytes  # the table is NOT charged
+        # index + output move too
+        assert cost.bytes >= cost.gather_bytes + m * 4
+
+    def test_wide_gather_slices_charge_slice_bytes(self):
+        import jax.numpy as jnp
+
+        d, g, m = 1000, 64, 16  # 256-byte slices > the 32 B granule
+        table = jnp.zeros((d, g), jnp.float32)
+        idx = jnp.zeros((m,), jnp.int32)
+        cost = profiling.estimate_fn(lambda t, i: t[i], (table, idx))
+        assert cost.gather_bytes == m * g * 4
 
     def test_collective_payload_bytes(self):
         import jax
