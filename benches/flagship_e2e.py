@@ -62,6 +62,15 @@ def main() -> None:
                         "pod-scale out-of-HBM regime — and the auto-trip "
                         "budgets against the POOLED HBM (per-chip budget "
                         "x N)")
+    p.add_argument("--game-e2e-leg", action="store_true",
+                   help="also run bench.py's game_e2e leg (the composed "
+                        "pod-scale GAME fit: streamed+mesh blocked-ELL "
+                        "fixed effect, entity-sharded random-effect "
+                        "buckets, host margin-cache score exchange — vs "
+                        "the resident single-chip fit) and print its "
+                        "JSON line. The full-driver form of the same "
+                        "regime is --rows past the HBM budget plus "
+                        "--mesh N")
     p.add_argument("--game-re-leg", action="store_true",
                    help="also run bench.py's game_re leg (the pipelined + "
                         "straggler-compacted random-effect block loop vs "
@@ -205,6 +214,28 @@ def main() -> None:
                                   "fixed_only"), mesh=mesh)
         print(f"fixed-only: total {time.perf_counter() - t0:.0f}s  "
               f"AUC {out.best.validation_score:.4f}", flush=True)
+
+    if args.game_e2e_leg:
+        # bench.py's game_e2e leg verbatim: the composed pod-scale GAME
+        # fit measured against its resident twin, beside the full-driver
+        # flagship run above.
+        import bench
+
+        ge = bench.game_e2e_problem()
+        res = bench.run_game_e2e(ge, streamed=False)
+        stm = bench.run_game_e2e(ge, streamed=True)
+        print(json.dumps({
+            "leg": "game_e2e",
+            "rows_iters_per_sec_aggregate":
+                round(stm["rows_iters_per_sec"], 1),
+            "resident_rows_iters_per_sec":
+                round(res["rows_iters_per_sec"], 1),
+            "streamed_over_resident":
+                round(stm["rows_iters_per_sec"]
+                      / res["rows_iters_per_sec"], 3),
+            "n_chips": stm["n_chips"],
+            "beyond_resident_ok": bool(stm.get("beyond_resident_ok",
+                                               False))}), flush=True)
 
     if args.game_re_leg:
         # The SAME leg bench.py's JSON line carries (one problem

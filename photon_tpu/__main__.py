@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the five per-package selftests as subprocesses (each CLI
+Runs the six per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -20,6 +20,11 @@ and one crashed subsystem cannot take the others down):
                    (static estimates + utilization ∈ (0, 1] on a
                    streamed-dense run, compile accounting, the
                    ledger-off-is-free contract)
+- ``game``       — `--selftest`: the pod-scale GAME e2e smoke (tiny
+                   rows, mesh 2) — streamed-mesh vs resident parity,
+                   the blocked-ELL mesh chunk ladder, the
+                   beyond-resident regime completing, and the four
+                   pod-scale GAME contracts
 
 Exit status: 0 iff every suite passed; the summary line names each
 suite's verdict so a red CI run says WHICH plane drifted.
@@ -38,6 +43,7 @@ SUITES: tuple = (
     ("serving", ("photon_tpu.serving", "--selftest", "--json")),
     ("checkpoint", ("photon_tpu.checkpoint", "--selftest", "--json")),
     ("profiling", ("photon_tpu.profiling", "--selftest", "--json")),
+    ("game", ("photon_tpu.game", "--selftest", "--json")),
 )
 
 

@@ -287,12 +287,20 @@ class ChunkedMatrix:
     def shape(self) -> tuple:
         return (self.n_real, self.n_features)
 
+    @property
+    def chunk_shards(self) -> int:
+        """Device shards each chunk was laid for: >1 iff the chunks are
+        ShardedBlockedEllRows groups from a mesh ladder
+        (`chunk_blocked_ell(..., n_shards=D)`), else 1."""
+        c = self.chunks[0]
+        return c.n_shards if isinstance(c, ShardedBlockedEllRows) else 1
+
     def nbytes(self) -> int:
         total = 0
         for c in self.chunks:
             if isinstance(c, SparseRows):
                 total += c.indices.nbytes + c.values.nbytes
-            elif isinstance(c, BlockedEllRows):
+            elif isinstance(c, (BlockedEllRows, ShardedBlockedEllRows)):
                 total += sum(int(leaf.nbytes) for leaf in
                              jax.tree_util.tree_leaves(c))
             else:
@@ -344,22 +352,34 @@ class ChunkedBatch(NamedTuple):
 
         return pad_to_multiple(self.X.chunk_rows, int(mesh.devices.size))
 
-    def mesh_chunk(self, i: int, mesh) -> GLMBatch:
+    def mesh_chunk(self, i: int, mesh, _cache: dict | None = None
+                   ) -> GLMBatch:
         """Chunk i row-sharded over ALL mesh axes: each device slot's host
         slice is device_put straight onto its device (multi-host: this
         process uploads only its own slots' rows — features never cross
-        DCN), pad rows carry weight 0."""
+        DCN), pad rows carry weight 0.
+
+        A ShardedBlockedEllRows chunk (mesh ladder —
+        `chunk_blocked_ell(..., n_shards=D)`) uploads shard-major: its
+        dense block row-shards, the per-shard ELL/occurrence buckets go
+        one leading index per device (`parallel.mesh.shard_stacked`),
+        and the shared column permutation replicates ONCE per stream
+        pass (``_cache``, threaded by `iter_device`)."""
         from photon_tpu.parallel.mesh import shard_rows
 
         pad = self.mesh_chunk_rows(mesh)
         X = self.X.chunks[i]
         if isinstance(X, BlockedEllRows):
             raise TypeError(
-                "blocked-ELL chunks cannot row-shard over a mesh (their "
-                "per-chunk ELL buckets are laid for one device); stream "
-                "SparseRows chunks under a mesh, or solve resident with "
+                "single-device blocked-ELL chunks cannot row-shard over a "
+                "mesh; rebuild the ladder for the mesh with "
+                "data.dataset.chunk_blocked_ell(batch, chunk_rows, "
+                f"n_shards={len(mesh.devices.reshape(-1))}) — or stream "
+                "SparseRows chunks, or solve resident with "
                 "data.dataset.shard_blocked_ell_batch")
-        if isinstance(X, SparseRows):
+        if isinstance(X, ShardedBlockedEllRows):
+            Xs = mesh_chunk_matrix(X, mesh, _cache)
+        elif isinstance(X, SparseRows):
             Xs = SparseRows(shard_rows(X.indices, mesh, pad_rows=pad),
                             shard_rows(X.values, mesh, pad_rows=pad),
                             X.n_features)
@@ -411,7 +431,12 @@ class ChunkedBatch(NamedTuple):
             return
         depth = max(int(prefetch), 1)
         if mesh is not None:
-            put = lambda i: self.mesh_chunk(i, mesh)  # noqa: E731
+            # per-pass upload cache: stream-wide replicated structures
+            # (the blocked-ELL ladder's column permutation) upload once
+            # per pass, not once per chunk
+            mesh_cache: dict = {}
+            put = lambda i: self.mesh_chunk(i, mesh,  # noqa: E731
+                                            _cache=mesh_cache)
         else:
             dput = (lambda b: jax.device_put(b, device)) \
                 if device is not None else jax.device_put
@@ -442,6 +467,48 @@ class ChunkedBatch(NamedTuple):
         telemetry.count("stream.compute_seconds", max(compute, 0.0))
         telemetry.gauge("stream.prefetch_depth", depth)
         _log_stream_stall(stall, compute, n, depth)
+
+
+def mesh_chunk_matrix(X, mesh, _cache: dict | None = None):
+    """Upload one ShardedBlockedEllRows chunk onto the mesh: the dense
+    block row-shards over all mesh axes, every per-shard structure leaf
+    (ELL row buckets, occurrence buckets, row_pos) goes one leading index
+    per device slot, and the shared column permutation replicates —
+    cached across chunks of a pass via ``_cache`` since the whole ladder
+    carries ONE global permutation. Shared by `ChunkedBatch.mesh_chunk`
+    and the GAME streamed scorer (`game.scoring.score_chunked_host`)."""
+    import dataclasses as _dc
+
+    from photon_tpu.data.matrix import ShardedBlockedEllRows as _SB
+    from photon_tpu.parallel.mesh import (replicated, shard_rows,
+                                          shard_stacked)
+
+    if not isinstance(X, _SB):
+        raise TypeError("mesh_chunk_matrix expects ShardedBlockedEllRows")
+    n_dev = len(mesh.devices.reshape(-1))
+    if X.n_shards != n_dev:
+        raise ValueError(
+            f"blocked-ELL chunk ladder was laid for {X.n_shards} device "
+            f"shard(s) but the mesh has {n_dev}; rebuild with "
+            f"data.dataset.chunk_blocked_ell(batch, chunk_rows, "
+            f"n_shards={n_dev})")
+    if _cache is None:
+        _cache = {}
+    perm = _cache.get("perm")
+    if perm is None:
+        rep = replicated(mesh)
+        perm = (jax.device_put(np.asarray(X.perm_cols), rep),
+                jax.device_put(np.asarray(X.inv_perm), rep))
+        _cache["perm"] = perm
+    return _dc.replace(
+        X,
+        dense=shard_rows(X.dense, mesh, pad_rows=X.dense.shape[0]),
+        ell_pcols=tuple(shard_stacked(b, mesh) for b in X.ell_pcols),
+        ell_vals=tuple(shard_stacked(b, mesh) for b in X.ell_vals),
+        row_pos=shard_stacked(X.row_pos, mesh),
+        bucket_rows=tuple(shard_stacked(b, mesh) for b in X.bucket_rows),
+        bucket_vals=tuple(shard_stacked(b, mesh) for b in X.bucket_vals),
+        perm_cols=perm[0], inv_perm=perm[1])
 
 
 def _log_stream_stall(stall: float, compute: float, n_chunks: int,
@@ -552,7 +619,8 @@ def chunk_batch(batch: GLMBatch, chunk_rows: int) -> ChunkedBatch:
 
 def chunk_blocked_ell(batch: GLMBatch, chunk_rows: int,
                       d_dense: int = 1024,
-                      feature_dtype=None) -> ChunkedBatch:
+                      feature_dtype=None,
+                      n_shards: int = 1) -> ChunkedBatch:
     """Re-lay a SparseRows batch as a HOST blocked-ELL chunk ladder: one
     `shard_blocked_ell` pass with S = n_chunks builds a GLOBAL column
     permutation + per-chunk structures padded to COMMON shapes, so the
@@ -560,6 +628,16 @@ def chunk_blocked_ell(batch: GLMBatch, chunk_rows: int,
     each per-chunk program exactly once (the out-of-HBM form of the
     blocked-ELL hot path — `train_glm` on the result dispatches to the
     streamed solvers and translates the permutation at its boundary).
+
+    ``n_shards > 1`` lays the ladder for a MESH of that many devices (the
+    pod-scale GAME fixed-effect regime): the builder runs with
+    S = n_chunks × n_shards and each streamed chunk is the
+    ShardedBlockedEllRows group of its ``n_shards`` consecutive shards —
+    every chunk row-shards over the mesh (`ChunkedBatch.mesh_chunk`) with
+    per-shard ELL/occurrence buckets and ONE global permutation, so the
+    sharded per-chunk programs compile exactly once and each evaluation
+    still closes with one psum. ``chunk_rows`` must be a multiple of
+    ``n_shards``.
 
     ``feature_dtype`` (e.g. jnp.bfloat16) recasts every chunk's value
     storage after the build — half the per-pass host→device feature bytes,
@@ -570,26 +648,40 @@ def chunk_blocked_ell(batch: GLMBatch, chunk_rows: int,
         raise TypeError("chunk_blocked_ell expects SparseRows")
     if chunk_rows < 1:
         raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if chunk_rows % n_shards != 0:
+        raise ValueError(
+            f"chunk_rows={chunk_rows} must be a multiple of "
+            f"n_shards={n_shards} (every device slot streams an equal "
+            "row slice of every chunk)")
     n = batch.n
     n_pad = -(-max(n, 1) // chunk_rows) * chunk_rows
     host = batch._replace(X=_host_sparse(X), y=np.asarray(batch.y),
                           weights=np.asarray(batch.weights),
                           offsets=np.asarray(batch.offsets))
     padded = pad_batch(host, n_pad)
-    S = n_pad // chunk_rows
+    S = (n_pad // chunk_rows) * n_shards
     ladder = shard_blocked_ell(_host_sparse(padded.X), S, d_dense)
-    chunks = []
-    for i in range(S):
-        c = ladder.chunk(i)
-        if feature_dtype is not None:
-            c = dataclasses.replace(
-                c, dense=np.asarray(c.dense).astype(feature_dtype),
-                ell_vals=tuple(np.asarray(v).astype(feature_dtype)
-                               for v in c.ell_vals),
-                bucket_vals=tuple(np.asarray(v).astype(feature_dtype)
-                                  for v in c.bucket_vals))
-        chunks.append(c)
-    cm = ChunkedMatrix(tuple(chunks), n, X.n_features,
+
+    def recast(c):
+        if feature_dtype is None:
+            return c
+        return dataclasses.replace(
+            c, dense=np.asarray(c.dense).astype(feature_dtype),
+            ell_vals=tuple(np.asarray(v).astype(feature_dtype)
+                           for v in c.ell_vals),
+            bucket_vals=tuple(np.asarray(v).astype(feature_dtype)
+                              for v in c.bucket_vals))
+
+    if n_shards == 1:
+        chunks = tuple(recast(ladder.chunk(i))
+                       for i in range(n_pad // chunk_rows))
+    else:
+        chunks = tuple(
+            recast(ladder.shard_slice(i * n_shards, (i + 1) * n_shards))
+            for i in range(n_pad // chunk_rows))
+    cm = ChunkedMatrix(chunks, n, X.n_features,
                        perm_cols=np.asarray(ladder.perm_cols),
                        inv_perm=np.asarray(ladder.inv_perm),
                        last_col_pos=ladder.last_col_pos)

@@ -526,6 +526,33 @@ class ShardedBlockedEllRows:
             tail_nnz=self.tail_nnz,
         )
 
+    def shard_slice(self, lo: int, hi: int) -> "ShardedBlockedEllRows":
+        """Shards ``lo:hi`` as one smaller ShardedBlockedEllRows (host
+        views — no copies of the value blocks). This is how a MESH chunk
+        ladder is cut (`data.dataset.chunk_blocked_ell(..., n_shards=D)`):
+        one `shard_blocked_ell` pass with S = n_chunks × D builds the
+        global permutation and common shapes, and each streamed chunk is
+        the D-shard group [i·D, (i+1)·D) — every chunk then row-shards
+        over the mesh with the SAME per-shard structures, so the sharded
+        per-chunk programs compile exactly once."""
+        nl = self.n_local
+        return ShardedBlockedEllRows(
+            dense=self.dense[lo * nl:hi * nl],
+            ell_pcols=tuple(np.asarray(b)[lo:hi] for b in self.ell_pcols),
+            ell_vals=tuple(np.asarray(b)[lo:hi] for b in self.ell_vals),
+            row_pos=np.asarray(self.row_pos)[lo:hi],
+            bucket_rows=tuple(np.asarray(b)[lo:hi]
+                              for b in self.bucket_rows),
+            bucket_vals=tuple(np.asarray(b)[lo:hi]
+                              for b in self.bucket_vals),
+            perm_cols=self.perm_cols,
+            inv_perm=self.inv_perm,
+            n_features=self.n_features,
+            n_prefix=self.n_prefix,
+            last_col_pos=self.last_col_pos,
+            tail_nnz=self.tail_nnz,
+        )
+
     def from_model_space(self, v):
         return jnp.asarray(v)[self.perm_cols]
 

@@ -538,6 +538,24 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         vectorized_grid=params.vectorized_grid,
     )
 
+    if streamed_obj:
+        re_coords = sorted(n for n, s in params.coordinates.items()
+                           if s.entity_name is not None)
+        if re_coords:
+            # the composed pod-scale GAME regime: streamed fixed-effect
+            # coordinate(s) + resident random-effect buckets (+ mesh)
+            telemetry.count("game_e2e.pod_scale_runs")
+            log.info(
+                "GAME end-to-end streamed regime: fixed-effect "
+                "coordinate(s) solve out-of-HBM on host-chunked shards "
+                "%s; random-effect coordinate(s) %s train resident%s; "
+                "inter-coordinate scores exchange through host margin "
+                "caches",
+                sorted(_streamable_shards(params)), re_coords,
+                ("" if mesh is None else
+                 f" sharded over the {int(mesh.devices.size)}-device "
+                 "mesh"))
+
     ckpt_active = False
     if params.checkpoint_dir:
         from photon_tpu import checkpoint as ckpt_mod

@@ -63,6 +63,51 @@ def _objective_at(task, y, weights, offsets, score):
     return jnp.sum(weights * loss(offsets + score, y))
 
 
+# ------------------------------------------------- streamed (out-of-HBM) face
+# When any fixed-effect coordinate's shard is a host ChunkedMatrix (the
+# pod-scale regime), the inter-coordinate margin exchange goes HOST-side:
+# every coordinate's score lives as a host (n,) f32 cache, offsets are a
+# numpy sum over those caches (never a full-dataset device vector), and
+# the tracking objective accumulates chunk-wise — each slice pays one
+# small device partial, totals sum in f64 on host. The device only ever
+# holds O(chunk) of the scalar columns, matching the streamed solvers'
+# footprint story.
+
+
+def _to_host_score(score) -> "np.ndarray":
+    import numpy as np
+
+    return score if isinstance(score, np.ndarray) else \
+        np.asarray(jax.device_get(score), np.float32)
+
+
+def _sum_scores_host(base, score_tuple):
+    import numpy as np
+
+    out = np.array(base, np.float32, copy=True)
+    for s in score_tuple:
+        out += np.asarray(s)
+    telemetry.count("game_e2e.host_offset_sums")
+    return out
+
+
+def _objective_streamed(task, y, weights, offsets, score,
+                        chunk_rows: int) -> float:
+    """The tracking objective over host-resident columns, chunk-wise:
+    per-slice jitted partial sums (one compile per slice shape), totals
+    accumulated f64 on host — nothing dataset-sized crosses to device."""
+    import numpy as np
+
+    n = int(y.shape[0])
+    parts = []
+    for lo in range(0, n, chunk_rows):
+        sl = slice(lo, min(lo + chunk_rows, n))
+        parts.append(_objective_at(task, y[sl], weights[sl], offsets[sl],
+                                   score[sl]))
+        telemetry.count("game_e2e.objective_chunks")
+    return float(np.sum(np.asarray(jax.device_get(parts), np.float64)))
+
+
 # ------------------------------------------------- checkpoint (de)hydration
 # The descent loop's crash-consistency cut is "coordinate updates 0..k
 # complete": the progress payload carries every updated coordinate's model
@@ -246,9 +291,30 @@ def coordinate_descent(
         if name not in models:
             raise ValueError(f"locked coordinate {name!r} needs an initial model")
 
-    y = jnp.asarray(y, jnp.float32)
-    weights = jnp.asarray(weights, jnp.float32)
-    base = jnp.asarray(base_offsets, jnp.float32)
+    import numpy as np
+
+    from photon_tpu.data.dataset import ChunkedMatrix
+
+    # STREAMED regime: any coordinate whose shard is a host ChunkedMatrix
+    # flips the whole descent's margin exchange host-side — scores live as
+    # host (n,) caches, offsets are numpy sums, objectives accumulate
+    # chunk-wise, and the dataset-sized scalar columns never device-put
+    # whole (the pod-scale GAME composition; module comment above).
+    chunked_coords = {
+        name for name, c in coordinates.items()
+        if isinstance(getattr(c.dataset, "X", None), ChunkedMatrix)
+    }
+    streamed = bool(chunked_coords)
+    if streamed:
+        y = np.asarray(y, np.float32)
+        weights = np.asarray(weights, np.float32)
+        base = np.asarray(base_offsets, np.float32)
+        obj_chunk_rows = min(coordinates[n].dataset.X.chunk_rows
+                             for n in chunked_coords)
+    else:
+        y = jnp.asarray(y, jnp.float32)
+        weights = jnp.asarray(weights, jnp.float32)
+        base = jnp.asarray(base_offsets, jnp.float32)
 
     # Scores of any pre-existing models participate as offsets from the start
     # (reference: CoordinateDescent seeds offsets from the initial GameModel).
@@ -259,6 +325,8 @@ def coordinate_descent(
         for name in coordinates
         if name in models
     }
+    if streamed:
+        scores = {name: _to_host_score(s) for name, s in scores.items()}
 
     objective_history: list = []
     coordinate_stats: dict = {name: [] for name in update_sequence}
@@ -292,8 +360,6 @@ def coordinate_descent(
     with cd_scope:
         progress = ck.restore("progress") if ck is not None else None
         if progress is not None:
-            import numpy as np
-
             done_updates = int(progress["n_done"])
             objective_history = [float(v) for v in progress["objective"]]
             stats_entries = list(progress["stats"])
@@ -301,8 +367,9 @@ def coordinate_descent(
             for name, kind in updated.items():
                 models[name] = _model_from_progress(progress, name, kind,
                                                     coordinates[name], task)
-                scores[name] = jnp.asarray(
-                    np.asarray(progress[f"s.{name}"]))
+                # streamed regime: restored margin caches stay HOST
+                s_np = np.asarray(progress[f"s.{name}"], np.float32)
+                scores[name] = s_np if streamed else jnp.asarray(s_np)
             for e in stats_entries:
                 coordinate_stats[e["name"]].append(
                     _stats_from_entry(e, models))
@@ -330,7 +397,12 @@ def coordinate_descent(
                            else contextlib.nullcontext())
                 stat_entry: Optional[dict] = None
                 with u_scope:
+                    # The streamed regime keeps EVERY update on the
+                    # host-cache exchange (fused device updates would pull
+                    # the (n,) margin vectors back on device): each update
+                    # is still one train dispatch + one scoring stream.
                     if (isinstance(coord, FixedEffectCoordinate)
+                            and not streamed
                             and _fixed_fusable(coord, prior)):
                         ds = coord.dataset
                         w0 = jnp.zeros((ds.dim,), jnp.float32)
@@ -362,11 +434,14 @@ def coordinate_descent(
                     else:
                         # fused_update_program gates itself: it returns
                         # None for mesh / projection / normalization /
-                        # straggler-budget coordinates, which then train
-                        # on the pipelined block loop below.
+                        # straggler-budget coordinates (the budget gate
+                        # logs once at INFO and counts on
+                        # game_re.fused_gate_offs), which then train on
+                        # the pipelined block loop below.
                         fused = (coord.fused_update_program()
                                  if isinstance(coord, RandomEffectCoordinate)
-                                 and prior is None else None)
+                                 and prior is None and not streamed
+                                 else None)
                         if fused is not None:
                             fn, blocks_args, obj, lam = fused
                             ds = coord.dataset
@@ -407,19 +482,38 @@ def coordinate_descent(
                                               "it": it_}
                             objective_history.append(objective)
                         else:
-                            offsets_full = _sum_scores(base, others)
+                            if streamed:
+                                # host margin caches: numpy offsets sum,
+                                # chunk-accumulated objective, score back
+                                # into a host cache (4 B/row; no (n,)
+                                # device vector anywhere in the exchange)
+                                if name in chunked_coords:
+                                    telemetry.count(
+                                        "game_e2e.streamed_fixed_updates")
+                                offsets_full = _sum_scores_host(base,
+                                                                others)
+                            else:
+                                offsets_full = _sum_scores(base, others)
                             model, stats = coord.train(offsets_full,
                                                        warm_start=warm,
                                                        prior=prior)
                             models[name] = model
                             scores[name] = coord.score(model)
                             coordinate_stats[name].append(stats)
-                            # device scalar now; host conversion is
-                            # deferred below so the descent loop never
-                            # blocks on a readback mid-sweep
-                            objective_history.append(
-                                _objective_at(task, y, weights,
-                                              offsets_full, scores[name]))
+                            if streamed:
+                                scores[name] = _to_host_score(scores[name])
+                                objective_history.append(
+                                    _objective_streamed(
+                                        task, y, weights, offsets_full,
+                                        scores[name], obj_chunk_rows))
+                            else:
+                                # device scalar now; host conversion is
+                                # deferred below so the descent loop never
+                                # blocks on a readback mid-sweep
+                                objective_history.append(
+                                    _objective_at(task, y, weights,
+                                                  offsets_full,
+                                                  scores[name]))
                             if ck is not None:
                                 if isinstance(stats, RETrainStats):
                                     stat_entry = {
@@ -524,3 +618,31 @@ def _contract_game_fixed_update():
         b, bs, sc, w, o, None, y, wt, _static_config(cfg), task,
         VarianceComputationType.NONE)
     return fn, (batch, base, scores, w0, obj, batch.y, batch.weights)
+
+
+@register_contract(
+    name="game_streamed_fixed_evaluation",
+    description="the pod-scale GAME fixed-effect coordinate's per-sweep "
+                "collective budget: one streamed-mesh objective "
+                "evaluation — chunk partials accumulated collective-FREE "
+                "across chunks, closed by exactly ONE hierarchical psum "
+                "(the whole evaluation's communication)",
+    collectives={"psum": 1}, tags=("game", "mesh-streamed"))
+def _contract_game_streamed_fixed_evaluation():
+    from photon_tpu.optim.streamed import _contract_problem, _mesh_ops
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ops = _mesh_ops(mesh)
+    obj, w, batch = _contract_problem(mesh)
+
+    def fn(o, wv, b):
+        # two chunks' partials accumulate elementwise (no collective),
+        # then the evaluation closes with finish's single psum — the
+        # exact shape of one fixed-effect evaluation in a GAME sweep
+        _, p1 = ops.chunk_init(o, wv, b)
+        _, p2 = ops.chunk_init(o, wv, b)
+        acc = jax.tree_util.tree_map(jnp.add, p1, p2)
+        return ops.finish(o, wv, acc)
+
+    return fn, (obj, w, batch)

@@ -80,6 +80,12 @@ class GameData:
             else jax.device_put
 
         def put_shard(X):
+            if isinstance(X, ChunkedMatrix):
+                # streamed-objective shards are host-resident BY DESIGN:
+                # scoring streams them chunk by chunk (chunked_margins /
+                # game.scoring.score_chunked_host) — device-putting the
+                # whole chunked shard would defeat the out-of-HBM regime
+                return X
             if isinstance(X, (HybridRows, PermutedHybridRows,
                               BlockedEllRows)):
                 if sharding is not None:

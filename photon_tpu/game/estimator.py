@@ -240,6 +240,11 @@ class GameEstimator:
         grid = config_grid or [self.coordinate_configs]
         evaluator = self.evaluator or default_evaluator(self.task)
         telemetry.count("game.grid_points", len(grid))
+        if self._chunked_shards(data):
+            # the pod-scale (streamed-objective) GAME regime: fixed-effect
+            # coordinates stream their host-chunked shards; the descent
+            # loop runs its host-margin-cache exchange
+            telemetry.count("game_e2e.chunked_fit_points", len(grid))
         dataset_cache, coord_cache = self._caches_for(data)
         if validation is not None:
             # One transfer for the whole grid: every grid point scores the

@@ -72,7 +72,20 @@ class FixedEffectCoordinate:
         )
         return FixedEffectModel(model, self.dataset.shard_name), res
 
-    def score(self, model: FixedEffectModel) -> jax.Array:
+    def score(self, model: FixedEffectModel):
         """Margin contribution of this coordinate alone (no offsets) —
-        reference: FixedEffectCoordinate.score / updateOffsets."""
+        reference: FixedEffectCoordinate.score / updateOffsets.
+
+        A streamed (ChunkedMatrix) shard scores chunk-by-chunk into a
+        HOST (n,) margin cache — row-sharded over the coordinate's mesh
+        when one is set — so the full-dataset score vector never
+        materializes on device (the pod-scale GAME regime; the descent
+        loop sums offsets against the host caches)."""
+        from photon_tpu.data.dataset import ChunkedMatrix
+
+        if isinstance(self.dataset.X, ChunkedMatrix):
+            from photon_tpu.game.scoring import score_chunked_host
+
+            return score_chunked_host(self.dataset.X,
+                                      model.model.weights, self.mesh)
         return model.score(self.dataset.X)

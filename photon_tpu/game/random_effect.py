@@ -603,11 +603,25 @@ class RandomEffectCoordinate:
         if cached is not None:
             return cached
         ds = self.dataset
+        if self._effective_budget() is not None:
+            # the compacted straggler re-solve needs the host repack
+            # between passes — it cannot live inside one jit program, so a
+            # budgeted coordinate takes the pipelined train() path. Said
+            # out loud (once) rather than silently: a user who set BOTH
+            # knobs should know which one won.
+            telemetry.count("game_re.fused_gate_offs")
+            if not getattr(self, "_fused_gate_logged", False):
+                object.__setattr__(self, "_fused_gate_logged", True)
+                from photon_tpu.utils.logging import photon_logger
+
+                photon_logger("photon_tpu.game", propagate=True).info(
+                    "random-effect coordinate %r: straggler_budget=%s "
+                    "disables the fused one-dispatch update (the "
+                    "compacted tail re-solve needs a host repack between "
+                    "passes); training on the pipelined block loop",
+                    ds.entity_name, self.straggler_budget)
+            return None
         if (ds.projection is not None or self.mesh is not None
-                # the compacted straggler re-solve needs the host repack
-                # between passes — it cannot live inside one jit program,
-                # so a budgeted coordinate takes the pipelined train() path
-                or self._effective_budget() is not None
                 or (self.normalization is not None
                     and not self.normalization.is_identity)):
             return None
@@ -729,6 +743,49 @@ def _contract_re_budgeted_first_pass():
     # the capped solver is the same cached family at a smaller static bound.
     raw, obj, batch, w0 = _re_contract_fixture(max_iters=2)
     return (lambda o, b, w: raw(o, None, b, w)), (obj, batch, w0)
+
+
+@register_contract(
+    name="game_re_mesh_bucket_solve",
+    description="a random-effect bucket's vmapped per-entity solves "
+                "SHARDED over the mesh's entity axis (shard_map over all "
+                "axes): B buckets solve on B x lanes chips with ZERO "
+                "collectives — per-entity training is embarrassingly "
+                "parallel and the pod-scale GAME sweep's RE half "
+                "contributes nothing to the collective budget",
+    collectives={}, tags=("game", "lane", "mesh"))
+def _contract_re_mesh_bucket_solve():
+    from jax.sharding import PartitionSpec as P
+
+    from photon_tpu.parallel.mesh import make_mesh, shard_map
+
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)
+    E = 2 * n_dev  # entity lanes divide the mesh
+    from photon_tpu.data.dataset import GLMBatch
+    from photon_tpu.optim.regularization import l2
+
+    m, d = 8, 5
+    cfg = OptimizerConfig(max_iters=4, tolerance=1e-7, reg=l2(),
+                          reg_weight=0.3, history=3)
+    raw = _re_solver(False, _static_config(cfg),
+                     VarianceComputationType.NONE)[1]
+    obj = make_objective(TaskType.LOGISTIC_REGRESSION, cfg, d)
+    batch = GLMBatch(X=jnp.zeros((E, m, d), jnp.float32),
+                     y=jnp.zeros((E, m), jnp.float32),
+                     weights=jnp.ones((E, m), jnp.float32),
+                     offsets=jnp.zeros((E, m), jnp.float32))
+    w0 = jnp.zeros((E, d), jnp.float32)
+    ent = P(tuple(mesh.axis_names))
+
+    def fn(o, b, w):
+        ospec = jax.tree_util.tree_map(lambda _: P(), o)
+        bspec = jax.tree_util.tree_map(lambda _: ent, b)
+        return shard_map(lambda ov, bv, wv: raw(ov, None, bv, wv),
+                         mesh=mesh, in_specs=(ospec, bspec, ent),
+                         out_specs=ent)(o, b, w)
+
+    return fn, (obj, batch, w0)
 
 
 @register_contract(

@@ -5,12 +5,22 @@ data.scoring.{CoordinateDataScores, ModelDataScores} — transform new data by
 summing every coordinate's contribution plus the base offset. Each
 coordinate's pass is one gather + matmul/rowwise-dot XLA program; there is no
 per-entity join.
+
+STREAMED coordinates (a fixed-effect shard living as a host ChunkedMatrix —
+the out-of-HBM GAME regime) score through `score_chunked_host`: every chunk
+uploads (row-sharded over the mesh when one is given), its margin computes
+on device, and the result lands straight in a HOST-resident (n,) margin
+cache — the full-dataset score vector never materializes on device, which
+is what lets inter-coordinate offsets at 1e9-row scale stay a host numpy
+sum (game.coordinate_descent's streamed regime).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.game.dataset import GameData
 from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 
@@ -47,3 +57,151 @@ def score_game(model: GameModel, data: GameData) -> jax.Array:
 def predict_mean(model: GameModel, data: GameData) -> jax.Array:
     """Mean response via the task's inverse link (reference: computeMean)."""
     return model.mean(score_game(model, data))
+
+
+# --------------------------------------------------- streamed margin cache
+# One jitted matvec per chunk; blocked-ELL mesh chunks run under shard_map
+# so each device's ELL buckets stay local (zero collectives — the
+# `game_score_stream_chunk` contract below).
+
+
+@jax.jit
+def _score_chunk(X, w):
+    from photon_tpu.data.matrix import matvec
+
+    return matvec(X, w)
+
+
+_SCORE_PROGRAMS: dict = {}  # (mesh, X treedef) -> jitted shard_map matvec
+
+
+def _mesh_score_program(mesh, X):
+    key = (mesh, jax.tree_util.tree_structure(X))
+    fn = _SCORE_PROGRAMS.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec as P
+
+        from photon_tpu.data.matrix import matvec
+        from photon_tpu.models.training import _hybrid_specs
+        from photon_tpu.parallel.mesh import shard_map
+
+        axes = tuple(mesh.axis_names)
+        xspec = _hybrid_specs(X, axes).X
+
+        def body(Xl, w):
+            return matvec(Xl.local(), w)
+
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=(xspec, P()), out_specs=P(axes)))
+        _SCORE_PROGRAMS[key] = fn
+    return fn
+
+
+def score_chunked_host(X, w, mesh=None) -> np.ndarray:
+    """Margins of a host ChunkedMatrix as a HOST (n_real,) f32 cache.
+
+    Each chunk streams through ONE device matvec — double-buffered like
+    `ChunkedBatch.iter_device`, row-sharded over the mesh when one is
+    given (blocked-ELL mesh ladders run their shard_map program; plain
+    dense/SparseRows chunks shard by rows) — and its margin slice is
+    fetched straight into the host cache. No full-dataset vector ever
+    lives on device; the 4 B/row cache is what the GAME descent loop
+    sums offsets against chunk-wise (the reference's
+    updateOffsets-over-RDD analog)."""
+    from photon_tpu.data.dataset import mesh_chunk_matrix
+    from photon_tpu.data.matrix import ShardedBlockedEllRows
+
+    w = np.asarray(w, np.float32)
+    if X.permuted:
+        # one global permutation for the whole ladder: translate once
+        w = w[np.asarray(X.perm_cols)]
+    w_dev = jnp.asarray(w)
+    c = X.chunk_rows
+    out = np.empty((X.n_real,), np.float32)
+    cache: dict = {}
+
+    def put(i):
+        Xc = X.chunks[i]
+        if isinstance(Xc, ShardedBlockedEllRows):
+            if mesh is None:
+                raise ValueError(
+                    f"this blocked-ELL chunk ladder was laid for a "
+                    f"{Xc.n_shards}-device mesh; pass mesh= to score it "
+                    "(or rebuild with chunk_blocked_ell(n_shards=1))")
+            Xs = mesh_chunk_matrix(Xc, mesh, cache)
+            return _mesh_score_program(mesh, Xs)(Xs, w_dev)
+        if mesh is not None:
+            from photon_tpu.data.matrix import SparseRows
+            from photon_tpu.parallel.mesh import shard_rows
+
+            pad = -(-c // len(mesh.devices.reshape(-1))) * \
+                len(mesh.devices.reshape(-1))
+            if isinstance(Xc, SparseRows):
+                Xs = SparseRows(shard_rows(Xc.indices, mesh, pad_rows=pad),
+                                shard_rows(Xc.values, mesh, pad_rows=pad),
+                                Xc.n_features)
+            else:
+                Xs = shard_rows(Xc, mesh, pad_rows=pad)
+            return _score_chunk(Xs, w_dev)
+        return _score_chunk(jax.device_put(Xc), w_dev)
+
+    nxt = put(0)
+    for i in range(X.n_chunks):
+        cur = nxt
+        if i + 1 < X.n_chunks:
+            nxt = put(i + 1)  # overlap: next chunk uploads during fetch
+        lo = i * c
+        hi = min(lo + c, X.n_real)
+        if hi > lo:
+            out[lo:hi] = np.asarray(cur)[:hi - lo]
+        telemetry.count("game_e2e.score_stream_chunks")
+    telemetry.count("game_e2e.score_stream_rows", int(X.n_real))
+    return out
+
+
+# ----------------------------------------------------------------- contracts
+# The streamed-score chunk program: inter-coordinate offsets at pod scale
+# rest on each chunk's margins computing with ZERO communication (the
+# host cache does the summing), no scatters (blocked-ELL law carries
+# over), and f32 accumulation from bf16 storage.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import SCATTER_PRIMITIVES  # noqa: E402
+
+
+@register_contract(
+    name="game_score_stream_chunk",
+    description="one streamed GAME scoring chunk (score_chunked_host's "
+                "shard_map matvec over a mesh blocked-ELL chunk): margins "
+                "stay device-local — zero collectives, zero scatters, f32 "
+                "accumulation; the host margin cache does the summing",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("game", "mesh-streamed", "sparse"))
+def _contract_game_score_stream_chunk():
+    import numpy as _np
+
+    from photon_tpu.data.dataset import cast_features, make_batch
+    from photon_tpu.data.matrix import SparseRows, shard_blocked_ell
+    from photon_tpu.models.training import _hybrid_specs
+    from photon_tpu.parallel.mesh import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh()
+    n_sh = int(mesh.devices.size)
+    d, k = 96, 4
+    rng = _np.random.default_rng(0)
+    n = 16 * n_sh
+    sp = SparseRows(rng.integers(0, d, size=(n, k)).astype(_np.int32),
+                    rng.normal(size=(n, k)).astype(_np.float32), d)
+    X = cast_features(make_batch(sp, _np.zeros(n, _np.float32))._replace(
+        X=shard_blocked_ell(sp, n_sh, d_dense=16))).X
+    axes = tuple(mesh.axis_names)
+    xspec = _hybrid_specs(X, axes).X
+
+    def fn(Xv, w):
+        from photon_tpu.data.matrix import matvec
+
+        return shard_map(lambda Xl, wv: matvec(Xl.local(), wv), mesh=mesh,
+                         in_specs=(xspec, P()),
+                         out_specs=P(axes))(Xv, w)
+
+    return fn, (X, jnp.zeros((d,), jnp.float32))

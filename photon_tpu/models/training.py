@@ -675,16 +675,14 @@ def train_glm_streamed(
     # global column permutation for the whole stream: translate the
     # original-space side inputs in, exactly as _permuted_prep does for
     # the resident permuted layouts, and translate the solution back out
-    # below. Mesh streaming stays SparseRows-only (the per-chunk ELL
-    # buckets are laid for one device).
+    # below. Under a mesh the ladder must be the MESH form
+    # (chunk_blocked_ell(n_shards=mesh size) — ShardedBlockedEllRows
+    # chunks whose per-device ELL buckets row-shard with the stream);
+    # optim.streamed._backend rejects the single-device form with the
+    # rebuild recipe.
     permuted = data.X.permuted
     norm_obj, intercept_index = norm, -1
     if permuted:
-        if mesh is not None:
-            raise ValueError(
-                "blocked-ELL chunk ladders are single-device streams "
-                "(per-chunk ELL buckets cannot row-shard); stream "
-                "SparseRows chunks under a mesh, or drop mesh=")
         perm = np.asarray(data.X.perm_cols)
         w0 = jnp.asarray(w0)[jnp.asarray(perm)]
         if prior_mean is not None:

@@ -71,7 +71,7 @@ from photon_tpu import checkpoint as _ckpt
 from photon_tpu import profiling
 from photon_tpu import telemetry
 from photon_tpu.data.dataset import GLMBatch
-from photon_tpu.data.matrix import SparseRows
+from photon_tpu.data.matrix import ShardedBlockedEllRows, SparseRows
 from photon_tpu.optim.lbfgs import _Z_REFRESH, two_loop
 from photon_tpu.optim.linesearch import C1, C2
 from photon_tpu.optim.owlqn import pseudo_gradient
@@ -220,9 +220,25 @@ class _MeshChunkOps:
 
         def bspec(b):
             X = b.X
+            if isinstance(X, ShardedBlockedEllRows):
+                # the mesh blocked-ELL chunk: dense block row-sharded,
+                # per-shard ELL/occurrence buckets one leading index per
+                # device, permutation replicated — the same spec tree the
+                # resident sharded solve uses (models.training).
+                from photon_tpu.models.training import _hybrid_specs
+
+                return _hybrid_specs(X, axes)
             xs = (SparseRows(row, row, X.n_features)
                   if isinstance(X, SparseRows) else row)
             return GLMBatch(xs, row, row, row)
+
+        def lview(b):
+            """The device-local view inside shard_map: a sharded
+            blocked-ELL chunk squeezes its shard axis to a plain
+            BlockedEllRows; everything else already IS local."""
+            if isinstance(b.X, ShardedBlockedEllRows):
+                return b._replace(X=b.X.local())
+            return b
 
         def pspec(obj):
             # (loss_sum, gX, gsum-or-None) stacked one block per device
@@ -234,7 +250,7 @@ class _MeshChunkOps:
         @jax.jit
         def chunk_init(obj, w, b):
             def body(obj, w, b):
-                z, parts = obj.chunk_value_grad_partials(w, b)
+                z, parts = obj.chunk_value_grad_partials(w, lview(b))
                 return z, stack(parts)
 
             return shard_map(body, mesh=mesh,
@@ -244,7 +260,7 @@ class _MeshChunkOps:
         @jax.jit
         def chunk_grad(obj, z, b):
             def body(obj, z, b):
-                return stack(obj.chunk_partials_at_margin(z, b))
+                return stack(obj.chunk_partials_at_margin(z, lview(b)))
 
             return shard_map(body, mesh=mesh,
                              in_specs=(ospec(obj), row, bspec(b)),
@@ -253,8 +269,9 @@ class _MeshChunkOps:
         @jax.jit
         def chunk_dz_phi(obj, p, z, a, b):
             def body(obj, p, z, a, b):
-                dz = obj.direction_margin(p, b)
-                wl, wd = obj.chunk_phi_partials(z, dz, a, b.y, b.weights)
+                bl = lview(b)
+                dz = obj.direction_margin(p, bl)
+                wl, wd = obj.chunk_phi_partials(z, dz, a, bl.y, bl.weights)
                 return dz, (wl[None], wd[None])
 
             return shard_map(body, mesh=mesh,
@@ -274,7 +291,7 @@ class _MeshChunkOps:
         @jax.jit
         def chunk_value_many(obj, W, b):
             def body(obj, W, b):
-                return obj.chunk_value_partials_many(W, b)[None]
+                return obj.chunk_value_partials_many(W, lview(b))[None]
 
             return shard_map(body, mesh=mesh,
                              in_specs=(ospec(obj), rep, bspec(b)),
@@ -476,17 +493,34 @@ class _MeshStream:
 
 
 def _backend(data, mesh, prefetch: int):
+    c0 = data.X.chunks[0]
     if mesh is not None:
-        if getattr(data.X, "permuted", False):
-            # blocked-ELL chunk ladders (data.dataset.chunk_blocked_ell)
-            # are laid for one device per chunk — their ELL buckets have
-            # no row-sharded form; the gather-fused single-chip stream is
-            # the supported regime.
+        if isinstance(c0, ShardedBlockedEllRows):
+            n_dev = len(mesh.devices.reshape(-1))
+            if c0.n_shards != n_dev:
+                raise ValueError(
+                    f"blocked-ELL chunk ladder was laid for "
+                    f"{c0.n_shards} device shard(s) but the mesh has "
+                    f"{n_dev}; rebuild with data.dataset."
+                    f"chunk_blocked_ell(batch, chunk_rows, "
+                    f"n_shards={n_dev})")
+        elif getattr(data.X, "permuted", False):
+            # single-device blocked-ELL chunks (n_shards=1) have no
+            # row-sharded form — the MESH ladder is a different layout.
             raise ValueError(
-                "blocked-ELL chunk ladders cannot stream over a mesh "
-                "(per-chunk ELL buckets are single-device); stream "
-                "SparseRows chunks under a mesh, or drop mesh=")
+                "this blocked-ELL chunk ladder was laid for ONE device "
+                "per chunk and cannot row-shard over a mesh; rebuild it "
+                "for the mesh with data.dataset.chunk_blocked_ell(batch, "
+                f"chunk_rows, n_shards={len(mesh.devices.reshape(-1))}) "
+                "— the pod-scale GAME fixed-effect regime — or stream "
+                "SparseRows chunks, or drop mesh=")
         return _MeshStream(data, mesh, prefetch)
+    if isinstance(c0, ShardedBlockedEllRows):
+        raise ValueError(
+            f"this blocked-ELL chunk ladder was laid for a "
+            f"{c0.n_shards}-device mesh (chunk_blocked_ell(n_shards=...)); "
+            "pass the mesh to the solve, or rebuild with n_shards=1 for "
+            "the single-chip stream")
     return _SingleDeviceStream(data, prefetch)
 
 
@@ -1132,6 +1166,7 @@ def _owlqn_streamed(obj, data, w0, l1_weight, max_iters, tolerance,
 # and an evaluation (or a line-search trial's totals) closes with exactly
 # ONE hierarchical psum.
 from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import SCATTER_PRIMITIVES  # noqa: E402
 
 
 def _contract_problem(mesh=None, d=6):
@@ -1192,6 +1227,39 @@ def _contract_streamed_mesh_finish():
     parts = (jnp.zeros((n_slots,), jnp.float32),
              jnp.zeros((n_slots, 6), jnp.float32), None)
     return (lambda o, wv, p: ops.finish(o, wv, p)), (obj, w, parts)
+
+
+@register_contract(
+    name="streamed_mesh_blocked_ell_chunk_partials",
+    description="a mesh blocked-ELL streamed chunk's partial program "
+                "(chunk_blocked_ell(n_shards=D) under _MeshChunkOps): "
+                "each device's ELL/occurrence buckets stay local — ZERO "
+                "collectives per chunk, no scatters of any kind, every "
+                "sparse dot/einsum accumulating f32 from bf16 storage",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("mesh-streamed", "sparse", "game"))
+def _contract_streamed_mesh_blocked_ell_chunk_partials():
+    from photon_tpu.data.dataset import (cast_features, make_batch,
+                                         shard_blocked_ell_batch)
+    from photon_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ops = _mesh_ops(mesh)
+    n_sh = int(mesh.devices.size)
+    d, k = 96, 4
+    rng = np.random.default_rng(0)
+    n = 16 * n_sh
+    sp = SparseRows(rng.integers(0, d, size=(n, k)).astype(np.int32),
+                    rng.normal(size=(n, k)).astype(np.float32), d)
+    batch = cast_features(shard_blocked_ell_batch(
+        make_batch(sp, (rng.uniform(size=n) < 0.5).astype(np.float32)),
+        n_sh, d_dense=16))
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.ops.objective import Objective
+
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.4))
+    return (lambda o, wv, b: ops.chunk_init(o, wv, b)), \
+        (obj, jnp.zeros((d,), jnp.float32), batch)
 
 
 @register_contract(

@@ -220,6 +220,25 @@ def shard_local_rows(local, mesh: Mesh):
         NamedSharding(mesh, P(tuple(mesh.axis_names))), arrays)
 
 
+def shard_stacked(host, mesh: Mesh):
+    """Shard a host ``(n_dev, ...)`` stack one leading index per device
+    slot: slot j gets ``host[j:j+1]`` device_put straight onto its device
+    (multi-host: local slots only). The upload form of per-shard
+    structure blocks whose leading axis IS the shard axis — e.g. a
+    ShardedBlockedEllRows chunk's ELL/occurrence buckets in the
+    mesh-streamed regime — mirroring `shard_rows` for row-major data."""
+    host = np.asarray(host)
+    devices = flat_mesh_devices(mesh)
+    if host.shape[0] != len(devices):
+        raise ValueError(
+            f"stacked leading axis {host.shape[0]} != {len(devices)} mesh "
+            "devices; rebuild the structure for this mesh")
+    arrays = [jax.device_put(host[j:j + 1], devices[j])
+              for j in local_row_slots(mesh)]
+    return jax.make_array_from_single_device_arrays(
+        host.shape, NamedSharding(mesh, P(tuple(mesh.axis_names))), arrays)
+
+
 def fetch_local_rows(arr, mesh: Mesh) -> np.ndarray:
     """The inverse of `shard_local_rows`: this process's row shards of a
     P(axes)-sharded array as one (n_local_slots, s, ...) numpy stack in

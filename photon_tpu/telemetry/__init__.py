@@ -22,8 +22,14 @@ prefetch depth, evaluations, line-search trials, margin-cache hits/
 refreshes, retraces via `analysis.TraceSignatureLog`, GAME sweep stats,
 the random-effect block pipeline's `game_re.*` family —
 blocks/blocks_in_flight/readback_wait_ns plus the straggler compaction's
-straggler_entities/tail_resolves/iters_saved, with per-block
-upload/solve/readback/tail_solve spans; the online serving tier's
+straggler_entities/tail_resolves/iters_saved and the fused-update gate's
+fused_gate_offs, with per-block upload/solve/readback/tail_solve spans;
+the pod-scale GAME composition's `game_e2e.*` family —
+streamed_fixed_updates/host_offset_sums/objective_chunks counters from
+the descent loop's host-margin-cache exchange,
+score_stream_chunks/score_stream_rows from the streamed coordinate
+scorer, chunked_fit_points from the estimator, and pod_scale_runs from
+the training driver; the online serving tier's
 `serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses
 counters (pad_waste is shared with the offline chunked scorer),
 queue_depth/batch_fill/latency_p50_ms/latency_p95_ms/latency_p99_ms

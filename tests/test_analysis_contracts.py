@@ -24,10 +24,11 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 31 specs (round 12 added the blocked-ELL sparse pins + the
-    scatter-free grouped-evaluation pin) spanning every workload family,
-    now including the sparse layout and evaluation families."""
-    assert len(_REGISTRY) >= 31
+    """≥ 35 specs (round 13 added the pod-scale GAME pins: one psum per
+    streamed fixed-effect evaluation, collective-free mesh RE bucket
+    solves, scatter-free mesh blocked-ELL chunk + streamed-score
+    programs) spanning every workload family."""
+    assert len(_REGISTRY) >= 35
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
@@ -77,6 +78,26 @@ def test_blocked_ell_contracts_hold_on_cpu_backend():
         assert violations == [], \
             f"{name} drifted on the CPU backend:\n" + \
             "\n".join(str(v) for v in violations)
+
+
+def test_game_e2e_specs_are_registered():
+    """The round-13 pod-scale GAME acceptance pins: the streamed-mesh
+    fixed-effect evaluation budgets EXACTLY one psum, the mesh RE bucket
+    solve is collective-free, and the mesh blocked-ELL chunk/score
+    programs forbid the full scatter family with f32 accumulation."""
+    from photon_tpu.analysis.walker import SCATTER_PRIMITIVES
+
+    assert dict(_REGISTRY["game_streamed_fixed_evaluation"].collectives) \
+        == {"psum": 1}
+    assert dict(_REGISTRY["game_re_mesh_bucket_solve"].collectives
+                or {}) == {}
+    for name in ("streamed_mesh_blocked_ell_chunk_partials",
+                 "game_score_stream_chunk"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}
+        assert SCATTER_PRIMITIVES <= spec.forbid, name
+        assert spec.require_f32_accum, name
+        assert not spec.allow_transfers and not spec.allow_f64, name
 
 
 def test_checkpoint_off_specs_are_registered():

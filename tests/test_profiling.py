@@ -17,7 +17,7 @@ Three planes:
   `python -m photon_tpu.profiling --report --json` CLI (the acceptance
   criterion's exact command) as a subprocess.
 
-The umbrella selfcheck (5 subprocesses) is marked ``slow`` — tier-1
+The umbrella selfcheck (6 subprocesses) is marked ``slow`` — tier-1
 runs ``-m 'not slow'`` and each sub-CLI is already exercised on its own.
 """
 import json
@@ -129,6 +129,41 @@ class TestSentinel:
         hist = _history(leg="streamed_mesh_n_chips", base=8.0)
         verdicts = sentinel.gate({"streamed_mesh_n_chips": 4.0}, hist)
         assert "streamed_mesh_n_chips" not in verdicts
+
+    def test_game_e2e_leg_admission(self):
+        """The round-13 game_e2e legs as the sentinel sees them: the new
+        throughput legs admit as 'new' without tripping the gate that
+        merges them, the chip count is a config leg (never gated), the
+        beyond-resident bool is skipped by leg_values, and once history
+        exists the aggregate gates like any throughput leg."""
+        verdicts = sentinel.gate(
+            {"game_e2e_rows_iters_per_sec_aggregate": 2.7e5,
+             "game_e2e_resident_rows_iters_per_sec": 4.6e5,
+             "game_e2e_streamed_over_resident": 0.6,
+             "game_e2e_n_chips": 8.0,
+             "dense_rate": 1e8},
+            _history())
+        assert verdicts[
+            "game_e2e_rows_iters_per_sec_aggregate"].status == "new"
+        assert verdicts[
+            "game_e2e_resident_rows_iters_per_sec"].status == "new"
+        assert verdicts["game_e2e_streamed_over_resident"].status == "new"
+        assert "game_e2e_n_chips" not in verdicts
+        assert verdicts["dense_rate"].status == "ok"
+        # bools never become legs (beyond_resident_ok is an existence
+        # proof, not a performance quantity)
+        legs = sentinel.leg_values(
+            {"legs": {"game_e2e_beyond_resident_ok": True,
+                      "game_e2e_rows_iters_per_sec_aggregate": 2.7e5}})
+        assert "game_e2e_beyond_resident_ok" not in legs
+        assert "game_e2e_rows_iters_per_sec_aggregate" in legs
+        # with history, the aggregate gates higher-better
+        hist = _history(leg="game_e2e_rows_iters_per_sec_aggregate",
+                        base=2.7e5)
+        worse = sentinel.gate(
+            {"game_e2e_rows_iters_per_sec_aggregate": 0.5e5}, hist)
+        assert worse[
+            "game_e2e_rows_iters_per_sec_aggregate"].status == "regressed"
 
     def test_leg_values_flattens_headline_and_skips_dups(self):
         legs = sentinel.leg_values({
@@ -391,16 +426,18 @@ class TestLedger:
 
 @pytest.mark.slow
 def test_umbrella_selfcheck_cli():
-    """`python -m photon_tpu --selfcheck --json`: the four existing
-    selftests + the profiling smoke aggregate into one verdict."""
+    """`python -m photon_tpu --selfcheck --json`: every per-package
+    selftest — including the pod-scale GAME e2e smoke (tiny rows,
+    mesh 2) — aggregates into one verdict."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, "-m", "photon_tpu", "--selfcheck", "--json"],
-        capture_output=True, text=True, env=env, timeout=900)
+        capture_output=True, text=True, env=env, timeout=1800)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout.strip().splitlines()[-1])
     assert doc["ok"]
     assert set(doc["suites"]) == {"analysis", "telemetry", "serving",
-                                  "checkpoint", "profiling"}
+                                  "checkpoint", "profiling", "game"}
+    assert doc["suites"]["game"]["ok"]
