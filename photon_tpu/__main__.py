@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the six per-package selftests as subprocesses (each CLI
+Runs the seven per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -25,6 +25,12 @@ and one crashed subsystem cannot take the others down):
                    the blocked-ELL mesh chunk ladder, the
                    beyond-resident regime completing, and the four
                    pod-scale GAME contracts
+- ``continual``  — `--selftest`: the train→serve flywheel — delta plan,
+                   prior warm-started partial refresh (untouched
+                   entities bit-identical, zero new trace signatures),
+                   parity-probed atomic hot-swap with kill-mid-swap
+                   falling back to the old model, and both continual
+                   contracts
 
 Exit status: 0 iff every suite passed; the summary line names each
 suite's verdict so a red CI run says WHICH plane drifted.
@@ -44,6 +50,7 @@ SUITES: tuple = (
     ("checkpoint", ("photon_tpu.checkpoint", "--selftest", "--json")),
     ("profiling", ("photon_tpu.profiling", "--selftest", "--json")),
     ("game", ("photon_tpu.game", "--selftest", "--json")),
+    ("continual", ("photon_tpu.continual", "--selftest", "--json")),
 )
 
 

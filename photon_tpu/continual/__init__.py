@@ -1,0 +1,66 @@
+"""Continual training flywheel: delta ingestion → prior warm-started
+partial re-solves → atomic serving hot-swap.
+
+Reference parity: Photon-ML's incremental training (the headline
+`function.PriorDistribution` feature — previous posterior as Gaussian
+prior + warm start) composed into the production loop the ROADMAP's
+"models refresh hourly" north star demands, closing train→serve:
+
+1. `delta` — diff a new data drop against the previous run's
+   training-row manifest (`data/model_io.py`) → a compact
+   :class:`RefreshPlan` of touched entities per random-effect coordinate.
+2. `refresh` — re-solve ONLY the touched entities: each bucket's touched
+   lanes compact via `parallel.mesh.compact_rows` into one dense block
+   padded to a FIXED lane chunk, warm-started from the saved
+   coefficients with `PriorDistribution.from_variances` priors threaded
+   into `Objective.prior_mean/prior_precision`, dispatched through the
+   SAME `_RE_SOLVERS` programs full training compiled — the hourly delta
+   path adds zero trace signatures (`continual_refresh_no_retrace`).
+3. `swap` — parity-probe old vs new margins on sampled entities, publish
+   the new version directory, swing the ``CURRENT.json`` pointer with
+   the temp+fsync+rename commit primitive, and reload the live
+   `CoefficientStore` atomically — a kill mid-swap leaves the old model
+   serving bit-identically.
+
+Telemetry (`continual.*`, names documented in
+``photon_tpu/telemetry/__init__``): plans/touched_entities/
+new_entities_deferred/touched_buckets/skipped_buckets/refresh_solves/
+refresh_iterations/refreshes/probe_entities/swap_refusals counters and
+delta_diff/refresh/refresh_coordinate/refresh_solve/probe/swap spans
+(the in-process cutover itself counts on ``serving.hot_swaps``).
+
+CLI: ``python -m photon_tpu.continual --selftest [--json]`` runs the
+whole loop on a canned mix (the 7th suite of
+``python -m photon_tpu --selfcheck``).
+"""
+from __future__ import annotations
+
+from photon_tpu.continual.delta import (  # noqa: F401
+    CoordinatePlan,
+    RefreshPlan,
+    build_manifest,
+    diff_manifest,
+)
+from photon_tpu.continual.refresh import (  # noqa: F401
+    REFRESH_LANES,
+    CoordinateRefreshStats,
+    RefreshResult,
+    refresh_game_model,
+)
+from photon_tpu.continual.swap import (  # noqa: F401
+    ParityProbe,
+    ParityReport,
+    SwapRefused,
+    hot_swap,
+    open_current,
+    parity_probe,
+    publish_store,
+)
+
+__all__ = [
+    "CoordinatePlan", "RefreshPlan", "build_manifest", "diff_manifest",
+    "REFRESH_LANES", "CoordinateRefreshStats", "RefreshResult",
+    "refresh_game_model",
+    "ParityProbe", "ParityReport", "SwapRefused", "hot_swap",
+    "open_current", "parity_probe", "publish_store",
+]

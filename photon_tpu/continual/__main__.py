@@ -1,0 +1,231 @@
+"""Continual-flywheel selftest CLI: the whole train→serve loop as one
+smoke.
+
+    python -m photon_tpu.continual --selftest            # one line, exit != 0
+    python -m photon_tpu.continual --selftest --json     # machine report
+
+Runs delta-detect → prior warm-started partial refresh → parity-probed
+atomic hot-swap on a canned mixed-effect mix (the umbrella
+``python -m photon_tpu --selfcheck`` wires this in as the 7th suite):
+
+- ``delta_plan``        — a drop touching ~10% of entities plans exactly
+  those entities (plus the new-entity deferral) from the saved manifest.
+- ``refresh_parity``    — untouched entities stay BIT-identical; touched
+  entities move on the new evidence and re-converge, with refreshed
+  variances for the next turn of the flywheel.
+- ``refresh_no_retrace``— a second refresh with a DIFFERENT touched set
+  adds zero compacted-solve dispatch signatures.
+- ``swap``              — the refreshed store survives the parity probe,
+  publishes a new version + pointer, hot-swaps the live store (counted),
+  and a kill injected at the ``swap_publish`` site leaves the old
+  version serving bit-identically.
+- ``contracts``         — the two continual ContractSpecs trace clean.
+
+Exit status: 0 iff every check passed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+CONTINUAL_CONTRACTS = ("continual_re_refresh_solve",
+                       "continual_refresh_no_retrace")
+
+
+def run_selftest() -> dict:
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu import continual, telemetry
+    from photon_tpu.checkpoint.faults import (FaultPlan, InjectedFault,
+                                              fault_plan)
+    from photon_tpu.continual.swap import open_current
+    from photon_tpu.game.dataset import GameData
+    from photon_tpu.game.estimator import (FixedEffectConfig, GameEstimator,
+                                           RandomEffectConfig)
+    from photon_tpu.models.variance import VarianceComputationType
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.optim.regularization import l2
+    from photon_tpu.serving.store import CoefficientStore
+
+    checks: dict = {}
+    rng = np.random.default_rng(11)
+    n, E, df, dr = 768, 32, 6, 4
+    ent = rng.integers(0, E, size=n)
+    Xf = rng.normal(size=(n, df)).astype(np.float32)
+    Xr = rng.normal(size=(n, dr)).astype(np.float32)
+    w_true = rng.normal(size=df).astype(np.float32) * 0.5
+    u_true = rng.normal(size=(E, dr)).astype(np.float32) * 0.5
+
+    def labels(Xf_, Xr_, ent_):
+        m = Xf_ @ w_true + np.einsum("nd,nd->n", Xr_, u_true[ent_])
+        return (rng.uniform(size=m.shape[0])
+                < 1 / (1 + np.exp(-m))).astype(np.float32)
+
+    cfg_f = OptimizerConfig(max_iters=8, tolerance=1e-6, reg=l2(),
+                            reg_weight=0.5, history=4)
+    cfg_r = OptimizerConfig(max_iters=20, tolerance=1e-7, reg=l2(),
+                            reg_weight=0.5, history=4)
+    data = GameData.build(labels(Xf, Xr, ent), {"fx": Xf, "rs": Xr},
+                          {"e": ent})
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configs={"fixed": FixedEffectConfig("fx", cfg_f),
+                            "re": RandomEffectConfig("e", "rs", cfg_r)},
+        n_sweeps=2, variance=VarianceComputationType.SIMPLE)
+    prev = est.fit(data)[0].model
+    manifest = continual.build_manifest(data)
+
+    run = telemetry.start_run("continual_selftest")
+
+    # --- delta plan --------------------------------------------------------
+    touched = rng.choice(E, size=max(E // 8, 2), replace=False)
+    n2 = 160
+    ent2 = np.concatenate([rng.permutation(np.repeat(
+        touched, n2 // touched.size))[:n2 - 8],
+        np.full(8, E + 7)])  # 8 rows of a brand-new entity
+    Xf2 = rng.normal(size=(n2, df)).astype(np.float32)
+    Xr2 = rng.normal(size=(n2, dr)).astype(np.float32)
+    u_shift = u_true.copy()
+    u_shift[touched] += 1.0  # the touched entities genuinely moved
+    m2 = Xf2 @ w_true + np.einsum(
+        "nd,nd->n", Xr2, np.vstack([u_shift, np.zeros((8, dr),
+                                                      np.float32)])[ent2])
+    y2 = (rng.uniform(size=n2) < 1 / (1 + np.exp(-m2))).astype(np.float32)
+    drop = GameData.build(y2, {"fx": Xf2, "rs": Xr2}, {"e": ent2})
+    plan = continual.diff_manifest(manifest, drop, prev)
+    cp = plan.coordinates["re"]
+    want = {str(k) for k in touched.tolist()}
+    got = set(np.asarray(cp.touched_keys).astype(np.str_).tolist())
+    checks["delta_plan"] = {
+        "ok": got == want and int(cp.new_keys.shape[0]) == 1,
+        "touched": sorted(got), "n_new": int(cp.new_keys.shape[0])}
+
+    # --- refresh parity + fewer-iterations ---------------------------------
+    res = continual.refresh_game_model(prev, drop, plan, {"re": cfg_r})
+    new_re = res.model.coordinates["re"]
+    prev_c = np.asarray(prev.coordinates["re"].coefficients)
+    new_c = np.asarray(new_re.coefficients)
+    untouched = np.setdiff1d(np.arange(E), touched)
+    st = res.stats["re"]
+    checks["refresh_parity"] = {
+        "ok": bool((prev_c[untouched] == new_c[untouched]).all()
+                   and (prev_c[touched] != new_c[touched]).any()
+                   and st.n_converged > 0 and st.n_failed == 0
+                   and new_re.variances is not None),
+        "touched_iters": st.total_iterations,
+        "buckets": [st.buckets_touched, st.buckets_skipped]}
+
+    # --- no-retrace across a second, different touched set ------------------
+    baseline = len(continual.RefreshResult.signatures())
+    touched_b = rng.choice(E, size=max(E // 16, 1), replace=False)
+    n3 = 96
+    ent3 = rng.permutation(np.repeat(touched_b,
+                                     n3 // touched_b.size + 1))[:n3]
+    drop_b = GameData.build(
+        labels(rng.normal(size=(n3, df)).astype(np.float32),
+               rng.normal(size=(n3, dr)).astype(np.float32), ent3),
+        {"fx": rng.normal(size=(n3, df)).astype(np.float32),
+         "rs": rng.normal(size=(n3, dr)).astype(np.float32)},
+        {"e": ent3})
+    plan_b = continual.diff_manifest(manifest, drop_b, prev)
+    continual.refresh_game_model(prev, drop_b, plan_b, {"re": cfg_r})
+    try:
+        n_sigs = continual.RefreshResult.assert_no_retrace(baseline)
+        checks["refresh_no_retrace"] = {"ok": True, "signatures": n_sigs}
+    except AssertionError as e:
+        checks["refresh_no_retrace"] = {"ok": False, "error": str(e)}
+
+    # --- parity-probed atomic swap + kill-mid-swap --------------------------
+    with tempfile.TemporaryDirectory(prefix="photon_continual_") as root:
+        live = CoefficientStore.from_game_model(prev)
+        new_store = CoefficientStore.from_game_model(res.model)
+        out = continual.hot_swap(live, new_store, root=root,
+                                 probe=continual.ParityProbe(bound=100.0))
+        # store blocks are (E+1, d): drop the cold-miss row for parity
+        swapped = np.asarray(live.random["re"].coefficients)[:-1]
+        v0 = out["version"]
+        # a kill at the publish point must leave v0 serving bit-identically
+        killed = False
+        try:
+            with fault_plan(FaultPlan.kill_at("swap_publish", 1)):
+                continual.hot_swap(None, CoefficientStore.from_game_model(
+                    prev), root=root, probe=None)
+        except InjectedFault:
+            killed = True
+        after, v_after = open_current(root)
+        still_old = bool(
+            (np.asarray(after.random["re"].coefficients)
+             == np.asarray(new_store.random["re"].coefficients)).all())
+        refusals0 = run.counters.get("continual.swap_refusals", 0)
+        # a blown-up model must REFUSE
+        import dataclasses as _dc
+
+        broken = CoefficientStore.from_game_model(res.model)
+        broken.random["re"] = _dc.replace(
+            broken.random["re"],
+            coefficients=broken.random["re"].coefficients + 1e6)
+        refused = False
+        try:
+            continual.hot_swap(live, broken, root=root,
+                               probe=continual.ParityProbe(bound=1.0))
+        except continual.SwapRefused:
+            refused = True
+        checks["swap"] = {
+            "ok": bool((swapped == new_c).all() and killed and still_old
+                       and v_after == v0 and refused
+                       and run.counters.get("continual.swap_refusals", 0)
+                       == refusals0 + 1
+                       and run.counters.get("serving.hot_swaps", 0) >= 1),
+            "version": v0, "killed_mid_swap": killed,
+            "old_model_served_after_kill": still_old, "refused": refused}
+    telemetry.finish_run()
+
+    # --- contracts ----------------------------------------------------------
+    from photon_tpu.analysis import check_contract
+    from photon_tpu.analysis.registry import load_registry
+
+    registry = load_registry()
+    bad = {}
+    for name in CONTINUAL_CONTRACTS:
+        violations = check_contract(registry[name])
+        if violations:
+            bad[name] = [str(v) for v in violations]
+    checks["contracts"] = {"ok": not bad, "n": len(CONTINUAL_CONTRACTS),
+                           **({"violations": bad} if bad else {})}
+
+    return {"ok": all(c["ok"] for c in checks.values()), "checks": checks}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" not in argv:
+        print(__doc__)
+        return 2
+    _default_env()
+    import json
+
+    report = run_selftest()
+    if "--json" in argv:
+        print(json.dumps(report))
+    else:
+        parts = [f"{k}={'ok' if v['ok'] else 'FAIL'}"
+                 for k, v in report["checks"].items()]
+        print("continual selftest: " + " ".join(parts))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,9 +106,47 @@ def load_glm_avro(path, imap: IndexMap) -> tuple[np.ndarray, Optional[np.ndarray
     return w, var
 
 
-def save_game_model(out_dir, model: GameModel, index_maps: dict) -> None:
+MANIFEST_NAME = "training_manifest.json"
+
+
+def save_training_manifest(out_dir, manifest: dict) -> None:
+    """Commit the training-row manifest beside a saved model (atomic:
+    checkpoint.store.commit_bytes — readers see old-or-new, never torn).
+
+    The manifest is what the continual-training delta differ
+    (`photon_tpu/continual/delta.py`) diffs a new data drop against:
+    ``{"n_rows": int, "coordinates": {name: {"entity_name": str,
+    "rows_per_entity": {raw key: weight-carrying row count}}}}``. Without
+    it a refresh cannot tell WHICH entities gained rows, so the per-entity
+    row counts must survive the training process alongside the
+    coefficients and variances they condition."""
+    from photon_tpu.checkpoint.store import commit_bytes
+
+    os.makedirs(out_dir, exist_ok=True)
+    commit_bytes(os.path.join(out_dir, MANIFEST_NAME),
+                 json.dumps(manifest, indent=2, sort_keys=True).encode())
+
+
+def load_training_manifest(out_dir) -> Optional[dict]:
+    """The manifest saved beside a model, or None for models saved before
+    (or without) one — callers must treat None as 'no delta baseline'."""
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_game_model(out_dir, model: GameModel, index_maps: dict,
+                    manifest: Optional[dict] = None) -> None:
     """Persist every coordinate + metadata (reference:
-    ModelProcessingUtils.saveGameModelToHDFS)."""
+    ModelProcessingUtils.saveGameModelToHDFS).
+
+    ``manifest``: optional training-row manifest (see
+    `save_training_manifest`) persisted beside the coefficients, so an
+    incremental refresh can build both its priors (variances ride the
+    coordinate Avro records) and its delta plan from the saved model
+    directory alone."""
     os.makedirs(out_dir, exist_ok=True)
     meta: dict = {"task": model.task.name, "coordinates": []}
     for name, cm in model.coordinates.items():
@@ -150,6 +188,8 @@ def save_game_model(out_dir, model: GameModel, index_maps: dict) -> None:
             raise TypeError(f"unknown coordinate model: {type(cm)}")
     with open(os.path.join(out_dir, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
+    if manifest is not None:
+        save_training_manifest(out_dir, manifest)
 
 
 def load_game_model(out_dir) -> tuple[GameModel, dict]:

@@ -627,11 +627,19 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         log.info("validation metrics (best model): %s", validation_metrics)
 
     with timers("save"):
+        # Training-row manifest: the delta baseline the continual
+        # flywheel (photon_tpu/continual) diffs the next data drop
+        # against — persisted beside the coefficients so a refresh needs
+        # only the saved model directory.
+        from photon_tpu.continual.delta import build_manifest
+
+        manifest = build_manifest(data)
         model_dir = os.path.join(params.output_dir, "best_model")
         save_game_model(
             model_dir, best.model,
             {n: index_maps[params.coordinates[n].feature_shard]
              for n in best.model.names()},
+            manifest=manifest,
         )
         if params.output_mode.upper() == "ALL":
             models_dir = os.path.join(params.output_dir, "models")
@@ -660,6 +668,7 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                         point_dir, r.model,
                         {n: index_maps[params.coordinates[n].feature_shard]
                          for n in r.model.names()},
+                        manifest=manifest,
                     )
                 manifest.append(_manifest_row(point_dir, r, best=r is best,
                                               sig=sig))

@@ -179,6 +179,37 @@ def _lane_chunk(e_real: int, n_dev: int = 1) -> int:
                            n_dev)
 
 
+def align_entity_priors(prior: RandomEffectModel, entity_keys, d: int):
+    """A previous run's RandomEffectModel → per-entity Gaussian-prior
+    blocks ``(means (E, d), precisions (E, d))`` aligned by entity KEY to
+    ``entity_keys`` — the reference's per-entity incremental-training
+    semantics, shared by `RandomEffectCoordinate.train` and the continual
+    refresh (`photon_tpu/continual/refresh.py`).
+
+    Entities unseen in the prior get precision 0 everywhere (no prior);
+    with variances present the precision is the Laplace-posterior
+    `optim.prior.PriorDistribution.from_variances` diagonal (variance ≤ 0
+    ⇒ the dim was never estimated ⇒ no prior THERE, not an infinite one);
+    without variances every seen entity gets unit precision (the
+    flat-default incremental weight)."""
+    from photon_tpu.optim.prior import PriorDistribution
+
+    entity_keys = np.asarray(entity_keys)
+    E = int(entity_keys.shape[0])
+    pid = prior.dense_ids(entity_keys)  # (E,) rows in the prior
+    seen = (pid < prior.n_entities).astype(np.float32)[:, None]
+    prior_means = np.asarray(prior.coeffs_for(pid), np.float32)
+    if prior.variances is not None:
+        pvar = np.concatenate(
+            [np.asarray(prior.variances, np.float32),
+             np.ones((1, d), np.float32)])[pid]
+        dist = PriorDistribution.from_variances(prior_means, pvar)
+        prior_precs = (seen * dist.precision_diag).astype(np.float32)
+    else:
+        prior_precs = seen * np.ones((E, d), np.float32)
+    return prior_means, prior_precs
+
+
 @dataclasses.dataclass
 class RETrainStats:
     """Per-train diagnostics (reference: per-entity OptimizationTracker)."""
@@ -360,20 +391,8 @@ class RandomEffectCoordinate:
             )
         prior_means = prior_precs = None
         if prior is not None and prior.dim == d:
-            pid = prior.dense_ids(ds.entity_keys)  # (E,) rows in the prior
-            seen = (pid < prior.n_entities).astype(np.float32)[:, None]
-            prior_means = np.asarray(prior.coeffs_for(pid), np.float32)
-            if prior.variances is not None:
-                pvar = np.concatenate(
-                    [np.asarray(prior.variances, np.float32),
-                     np.ones((1, d), np.float32)])[pid]
-                # variance ≤ 0 means the dim was never estimated (e.g. outside
-                # an INDEX_MAP-projected entity's active set) — no prior there,
-                # NOT infinite precision
-                prior_precs = np.where(
-                    pvar > 0, seen / np.maximum(pvar, 1e-12), 0.0)
-            else:
-                prior_precs = seen * np.ones((E, d), np.float32)
+            prior_means, prior_precs = align_entity_priors(
+                prior, ds.entity_keys, d)
             if norm is not None:
                 prior_means = norm.rows_to_normalized_space(prior_means)
                 if norm.factors is not None:

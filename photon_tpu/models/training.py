@@ -474,6 +474,9 @@ def train_glm_grid(
     variance: VarianceComputationType = VarianceComputationType.NONE,
     normalization=None,
     device_results: bool = False,
+    prior_mean=None,
+    prior_precision=None,
+    prior=None,
 ) -> list[tuple[GeneralizedLinearModel, OptResult]]:
     """Train one GLM per regularization weight — as ONE device program.
 
@@ -493,6 +496,15 @@ def train_glm_grid(
     sweeps (the 10M-feature regime) the (G, d) coefficient block is
     G×40 MB; callers selecting one winning lane (or reducing to metrics)
     should fetch only what they need.
+
+    ``prior`` / ``prior_mean``+``prior_precision``: an informative
+    Gaussian prior SHARED by every lane (incremental training — the
+    continual flywheel re-tuning its reg weight on a refresh). Priors are
+    rejected by the lane-minor lock-step solver
+    (`ops.lane_objective.supports_lanes`), so a prior sweep runs on the
+    general vmapped runner — one single-lane solver program per lane,
+    lock-step but without the shared-X-pass lane-minor layout — and says
+    so at INFO.
     """
     if isinstance(batch, ChunkedBatch):
         raise ValueError(
@@ -511,10 +523,31 @@ def train_glm_grid(
             "shard_blocked_ell_batch) or ShardedHybridRows under a mesh")
     norm = _active_norm(normalization)
     w0 = _init_w0(d, w0, norm)
+    if prior is not None:
+        if prior_mean is not None or prior_precision is not None:
+            raise ValueError("pass prior OR prior_mean/prior_precision")
+        if prior.precision_full is not None:
+            raise ValueError(
+                "full-covariance priors are not supported on the grid "
+                "path; use a diagonal prior (from_variances) or run the "
+                "sweep sequentially via train_glm")
+        prior_mean = prior.mean
+        prior_precision = prior.precision_diag
+    if norm is not None and prior_mean is not None:
+        prior_mean = norm.to_normalized_space(np.asarray(prior_mean))
+        if prior_precision is not None and norm.factors is not None:
+            f = np.asarray(norm.factors)
+            prior_precision = np.asarray(prior_precision,
+                                         np.float32) * f * f
     norm_obj, intercept_index = norm, -1
     if permuted:
-        w0, _, _, norm_obj = _permuted_prep(batch.X, w0, None, None, norm)
+        w0, prior_mean, prior_precision, norm_obj = _permuted_prep(
+            batch.X, w0, prior_mean, prior_precision, norm)
         intercept_index = batch.X.last_col_pos
+    if prior_mean is not None:
+        prior_mean = jnp.asarray(prior_mean, jnp.float32)
+    if prior_precision is not None:
+        prior_precision = jnp.asarray(prior_precision, jnp.float32)
     weights = [float(wt) for wt in reg_weights]
     l2s, l1s, static_cfg = lane_weight_arrays(config, weights)
     axis_name = None
@@ -522,13 +555,28 @@ def train_glm_grid(
         batch, w0, axis_name = _sharded_prep(batch, w0, mesh)
     obj = make_objective(task, config, d, axis_name=axis_name,
                          normalization=norm_obj,
-                         intercept_index=intercept_index)
+                         intercept_index=intercept_index,
+                         prior_mean=prior_mean,
+                         prior_precision=prior_precision)
     telemetry.record_signature("training._train_run_grid",
                                (batch, w0, obj, l2s, l1s))
     # Reg sweeps without variances ride a lane-minor solver (one lock-step
     # program sharing every X pass): smooth sweeps on the margin-cached
     # L-BFGS or TRON lanes, L1/elastic-net sweeps on the OWL-QN lanes.
-    # Variance requests fall back to the general vmapped runner.
+    # Variance requests fall back to the general vmapped runner; so do
+    # informative priors (supports_lanes), SAYING so — a silently slower
+    # sweep is the kind of routing surprise the flywheel cannot afford.
+    if not supports_lanes(obj):
+        from photon_tpu.utils.logging import photon_logger
+
+        photon_logger("photon_tpu.models", propagate=True).info(
+            "train_glm_grid: informative prior present — the lane-minor "
+            "lock-step grid does not support priors "
+            "(ops.lane_objective.supports_lanes); routing the %d-lane "
+            "sweep to the general vmapped single-lane-per-lane runner. "
+            "Drop the prior (or solve points sequentially with "
+            "train_glm(prior=...)) to get the lane-minor path back.",
+            len(weights))
     use_lanes = (variance is VarianceComputationType.NONE
                  and supports_lanes(obj)
                  # lane_weight_arrays pins OWLQN <=> l1s is not None;

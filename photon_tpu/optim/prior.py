@@ -57,6 +57,42 @@ class PriorDistribution:
         return PriorDistribution(means, precision_diag=prec * scale)
 
     @staticmethod
+    def from_variances(
+        means,
+        variances,
+        scale: float = 1.0,
+        min_variance: float = 1e-12,
+    ) -> "PriorDistribution":
+        """The Laplace-posterior → Gaussian-prior step of the continual
+        flywheel: a previous solve's coefficient means + VARIANCES (the
+        diagonal of the inverse Hessian, `models/variance.py`) become the
+        next solve's informative prior with Λ = diag(1/var).
+
+        Unlike `from_coefficients`, variances are REQUIRED (a refresh must
+        never silently fall back to a flat default precision — that is a
+        different model), and a non-positive variance means the dimension
+        was never estimated (e.g. outside an INDEX_MAP-projected entity's
+        active set): its precision is 0 — NO prior there, not an infinite
+        one. Accepts (d,) vectors or stacked (E, d) per-entity blocks (the
+        vmapped random-effect refresh passes whole coefficient matrices).
+        """
+        if variances is None:
+            raise ValueError(
+                "from_variances needs the previous run's coefficient "
+                "variances; train it with variance_type=simple/full (or "
+                "use from_coefficients for the flat-default-precision "
+                "prior)")
+        means = np.asarray(means, np.float32)
+        var = np.asarray(variances, np.float32)
+        if var.shape != means.shape:
+            raise ValueError(
+                f"variances shape {var.shape} != means shape {means.shape}")
+        prec = np.where(var > 0.0,
+                        scale / np.maximum(var, min_variance),
+                        0.0).astype(np.float32)
+        return PriorDistribution(means, precision_diag=prec)
+
+    @staticmethod
     def from_hessian(means, hessian, scale: float = 1.0) -> "PriorDistribution":
         """Full-covariance prior from a dense Hessian (the Laplace posterior
         of the previous solve; VarianceComputationType.FULL analog)."""

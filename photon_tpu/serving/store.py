@@ -30,9 +30,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 
 import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.data.index_map import IndexMap, PalDBIndexMap
 from photon_tpu.game.model import (FixedEffectModel, GameModel,
                                    RandomEffectModel)
@@ -100,6 +102,10 @@ class CoefficientStore:
         self.fixed = fixed    # name -> FixedBlock
         self.random = random  # name -> RandomBlock
         self._device = None   # lazily uploaded (and hot-swappable) blocks
+        # Guards the (fixed, random, _device) generation against concurrent
+        # hot swaps: device_blocks() hands out ONE generation's pair
+        # atomically (see reload_coefficients for the full story).
+        self._swap_lock = threading.Lock()
 
     # ----------------------------------------------------------- construction
     @classmethod
@@ -232,22 +238,40 @@ class CoefficientStore:
     def device_blocks(self) -> tuple:
         """(fixed_ws, re_cs): name-keyed dicts of device-resident blocks,
         uploaded once and reused by every dispatch (the program takes them
-        as arguments, so a swap never retraces)."""
-        if self._device is None:
-            import jax
+        as arguments, so a swap never retraces).
 
-            self._device = (
-                {n: jax.device_put(np.asarray(b.weights, np.float32))
-                 for n, b in self.fixed.items()},
-                {n: jax.device_put(np.asarray(b.coefficients, np.float32))
-                 for n, b in self.random.items()})
-        return self._device
+        Returns ONE coefficient generation atomically (under the swap
+        lock): a dispatcher flush racing a `reload_coefficients` gets
+        either the whole OLD pair or the whole NEW pair — never fixed
+        blocks from one model and random blocks from the other."""
+        with self._swap_lock:
+            if self._device is None:
+                import jax
+
+                self._device = (
+                    {n: jax.device_put(np.asarray(b.weights, np.float32))
+                     for n, b in self.fixed.items()},
+                    {n: jax.device_put(np.asarray(b.coefficients,
+                                                  np.float32))
+                     for n, b in self.random.items()})
+            return self._device
 
     def reload_coefficients(self, other: "CoefficientStore") -> None:
         """Hot-swap coefficient VALUES from another store with identical
         structure (same coordinates, dims, entity spaces) — the online
         model-push path. Shapes must match: the program ladder's AOT
-        signatures are part of the serving contract."""
+        signatures are part of the serving contract.
+
+        CONCURRENCY: safe against in-flight dispatcher flushes. The
+        (fixed, random, device-uploads) generation swings atomically under
+        the swap lock, and scoring programs take the blocks as ARGUMENTS,
+        so a flush that already fetched `device_blocks()` completes
+        bit-identically on the OLD model while the next flush scores the
+        NEW one — requests see old-or-new coherently, never a torn mix
+        (tests/test_serving.py::TestHotSwapConcurrency). Entity→row ids a
+        racing flush resolved against the old directory stay valid because
+        the identical-structure check pins the entity space. Each swap
+        counts on ``serving.hot_swaps``."""
         if (other.order != self.order
                 or any(other.fixed[n].weights.shape
                        != self.fixed[n].weights.shape for n in self.fixed)
@@ -257,9 +281,11 @@ class CoefficientStore:
             raise ValueError(
                 "coefficient reload requires an identically-shaped store "
                 "(new entities or features need a new program ladder)")
-        self.fixed = other.fixed
-        self.random = other.random
-        self._device = None
+        with self._swap_lock:
+            self.fixed = other.fixed
+            self.random = other.random
+            self._device = None
+        telemetry.count("serving.hot_swaps")
 
     # ---------------------------------------------------------------- lookups
     def lookup(self, name: str, raw_ids) -> tuple:

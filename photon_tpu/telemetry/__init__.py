@@ -30,8 +30,9 @@ the descent loop's host-margin-cache exchange,
 score_stream_chunks/score_stream_rows from the streamed coordinate
 scorer, chunked_fit_points from the estimator, and pod_scale_runs from
 the training driver; the online serving tier's
-`serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses
-counters (pad_waste is shared with the offline chunked scorer),
+`serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses/
+hot_swaps counters (pad_waste is shared with the offline chunked scorer;
+hot_swaps counts `CoefficientStore.reload_coefficients` cutovers),
 queue_depth/batch_fill/latency_p50_ms/latency_p95_ms/latency_p99_ms
 gauges, per-flush `serving.flush` spans, and one `serving_batch` event
 per dispatched micro-batch; the elastic-runs `checkpoint.*` family —
@@ -39,6 +40,13 @@ snapshots/bytes/restores plus the per-layer scope_restores/
 solver_restores/re_restores/descent_restores and gc_snapshots, with
 `checkpoint.pack`/`checkpoint.write` spans — and its `faults.*` sibling
 — injected_kills/injected_errors/io_retries/backoff_seconds — the
+continual-flywheel `continual.*` family — plans/touched_entities/
+new_entities_deferred counters from delta ingestion,
+touched_buckets/skipped_buckets/refresh_solves/refresh_iterations/
+refreshes from the partial re-solve, probe_entities/swap_refusals from
+the parity-probed hot swap (the in-process cutover itself counts on
+`serving.hot_swaps`), with delta_diff/refresh/refresh_coordinate/
+refresh_solve/probe/swap spans — the
 grouped-evaluation `eval.*` family — scatter_elems_saved, the elements
 per metric call that would have entered combining scatters before the
 round-12 sorted-segment rework of `evaluation/grouped.py` — and HBM

@@ -24,15 +24,15 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 35 specs (round 13 added the pod-scale GAME pins: one psum per
-    streamed fixed-effect evaluation, collective-free mesh RE bucket
-    solves, scatter-free mesh blocked-ELL chunk + streamed-score
-    programs) spanning every workload family."""
-    assert len(_REGISTRY) >= 35
+    """≥ 37 specs (round 14 added the continual-flywheel pins: the
+    compacted prior warm-started refresh solve is collective-free, and
+    the delta path's fixed-chunk padding keeps dispatch signatures
+    constant across touched sets) spanning every workload family."""
+    assert len(_REGISTRY) >= 37
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
-                   "evaluation"):
+                   "evaluation", "continual"):
         assert family in tags, f"no contract covers the {family} family"
 
 
@@ -98,6 +98,20 @@ def test_game_e2e_specs_are_registered():
         assert SCATTER_PRIMITIVES <= spec.forbid, name
         assert spec.require_f32_accum, name
         assert not spec.allow_transfers and not spec.allow_f64, name
+
+
+def test_continual_specs_are_registered():
+    """The round-14 continual-flywheel acceptance pins: the compacted
+    refresh solve (compact_rows gather + prior-threaded vmapped lanes)
+    budgets ZERO collectives with no transfer/f64 escape hatch, and the
+    no-retrace spec — whose BUILDER asserts signature equality across
+    touched sets of different sizes — is registered and strict too."""
+    for name in ("continual_re_refresh_solve",
+                 "continual_refresh_no_retrace"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}
+        assert not spec.allow_transfers and not spec.allow_f64, name
+        assert "continual" in spec.tags, name
 
 
 def test_checkpoint_off_specs_are_registered():

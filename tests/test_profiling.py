@@ -17,7 +17,7 @@ Three planes:
   `python -m photon_tpu.profiling --report --json` CLI (the acceptance
   criterion's exact command) as a subprocess.
 
-The umbrella selfcheck (6 subprocesses) is marked ``slow`` — tier-1
+The umbrella selfcheck (7 subprocesses) is marked ``slow`` — tier-1
 runs ``-m 'not slow'`` and each sub-CLI is already exercised on its own.
 """
 import json
@@ -164,6 +164,41 @@ class TestSentinel:
             {"game_e2e_rows_iters_per_sec_aggregate": 0.5e5}, hist)
         assert worse[
             "game_e2e_rows_iters_per_sec_aggregate"].status == "regressed"
+
+    def test_refresh_e2e_leg_admission(self):
+        """The round-14 continual legs as the sentinel sees them: the new
+        speedup/wall legs admit as 'new' without tripping the gate that
+        merges them, the touched fraction is a config fact (never
+        gated), the wall legs gate LOWER-better once history exists, and
+        the speedup gates higher-better."""
+        verdicts = sentinel.gate(
+            {"refresh_e2e_speedup_vs_full_retrain": 120.0,
+             "refresh_e2e_wall_ms": 850.0,
+             "refresh_e2e_full_retrain_wall_ms": 95000.0,
+             "refresh_e2e_touched_frac": 0.02,
+             "dense_rate": 1e8},
+            _history())
+        assert verdicts[
+            "refresh_e2e_speedup_vs_full_retrain"].status == "new"
+        assert verdicts["refresh_e2e_wall_ms"].status == "new"
+        assert verdicts["refresh_e2e_full_retrain_wall_ms"].status == "new"
+        assert "refresh_e2e_touched_frac" not in verdicts
+        assert verdicts["dense_rate"].status == "ok"
+        # the refresh wall is a latency-like cost: lower is better
+        assert sentinel.lower_is_better("refresh_e2e_wall_ms")
+        whist = _history(leg="refresh_e2e_wall_ms", base=800.0)
+        worse = sentinel.gate({"refresh_e2e_wall_ms": 9000.0},
+                              whist)["refresh_e2e_wall_ms"]
+        better = sentinel.gate({"refresh_e2e_wall_ms": 200.0},
+                               whist)["refresh_e2e_wall_ms"]
+        assert worse.status == "regressed" and better.status == "ok"
+        # the speedup is a rate: a collapse toward 1x regresses
+        shist = _history(leg="refresh_e2e_speedup_vs_full_retrain",
+                         base=120.0)
+        collapsed = sentinel.gate(
+            {"refresh_e2e_speedup_vs_full_retrain": 2.0},
+            shist)["refresh_e2e_speedup_vs_full_retrain"]
+        assert collapsed.status == "regressed"
 
     def test_leg_values_flattens_headline_and_skips_dups(self):
         legs = sentinel.leg_values({
@@ -428,7 +463,8 @@ class TestLedger:
 def test_umbrella_selfcheck_cli():
     """`python -m photon_tpu --selfcheck --json`: every per-package
     selftest — including the pod-scale GAME e2e smoke (tiny rows,
-    mesh 2) — aggregates into one verdict."""
+    mesh 2) and the continual-flywheel loop — aggregates into one
+    verdict."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -439,5 +475,7 @@ def test_umbrella_selfcheck_cli():
     doc = json.loads(proc.stdout.strip().splitlines()[-1])
     assert doc["ok"]
     assert set(doc["suites"]) == {"analysis", "telemetry", "serving",
-                                  "checkpoint", "profiling", "game"}
+                                  "checkpoint", "profiling", "game",
+                                  "continual"}
     assert doc["suites"]["game"]["ok"]
+    assert doc["suites"]["continual"]["ok"]

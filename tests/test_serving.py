@@ -412,6 +412,92 @@ class TestDispatcherBehavior:
             d.close()
 
 
+# ------------------------------------------------------- hot-swap concurrency
+class TestHotSwapConcurrency:
+    """`CoefficientStore.reload_coefficients` under an in-flight
+    dispatcher flush: every request scores bit-identically under EITHER
+    the old or the new model — one coefficient generation per dispatch,
+    never a torn fixed-from-A/random-from-B mix — and each swap counts
+    on `serving.hot_swaps`."""
+
+    def _scores(self, store, reqs) -> np.ndarray:
+        ladder = serving.ProgramLadder(store, ladder=(8, 16),
+                                       sparse_k={"member": SPARSE_K},
+                                       output_mean=True)
+        d = serving.MicroBatchDispatcher(ladder, max_delay_us=200)
+        try:
+            futs = [d.submit(r) for r in reqs]
+            return np.asarray([f.result(timeout=60) for f in futs])
+        finally:
+            d.close()
+
+    def test_requests_see_old_or_new_never_torn(self):
+        import threading
+
+        model_a, _ = build_demo_model(seed=7)
+        model_b, _ = build_demo_model(seed=21)  # same structure, new values
+        store_a = serving.CoefficientStore.from_game_model(model_a)
+        store_b = serving.CoefficientStore.from_game_model(model_b)
+        rng = np.random.default_rng(11)
+        reqs, _, _ = _requests(rng, model_a, 48)
+        # reference scores under each pure generation (rungs ≥ 8 are
+        # row-stable across batch compositions — docs/SERVING.md)
+        ref_a = self._scores(serving.CoefficientStore.from_game_model(
+            model_a), reqs)
+        ref_b = self._scores(serving.CoefficientStore.from_game_model(
+            model_b), reqs)
+        assert (ref_a != ref_b).any()
+
+        run = telemetry.start_run("hot_swap_test")
+        live = serving.CoefficientStore.from_game_model(model_a)
+        ladder = serving.ProgramLadder(live, ladder=(8, 16),
+                                       sparse_k={"member": SPARSE_K},
+                                       output_mean=True)
+        d = serving.MicroBatchDispatcher(ladder, max_delay_us=100)
+        results: dict = {}
+        stop = threading.Event()
+        n_swaps = 0
+
+        def swapper():
+            nonlocal n_swaps
+            import time as _time
+
+            flip = [store_b, store_a]
+            while not stop.is_set():
+                live.reload_coefficients(flip[n_swaps % 2])
+                n_swaps += 1
+                _time.sleep(0.002)  # don't starve the 1-core CI box
+
+        t = threading.Thread(target=swapper)
+        t.start()
+        try:
+            for rep in range(6):
+                futs = [(i, d.submit(r)) for i, r in enumerate(reqs)]
+                for i, f in futs:
+                    results.setdefault(i, []).append(f.result(timeout=60))
+        finally:
+            stop.set()
+            t.join()
+            d.close()
+        assert n_swaps >= 2
+        for i, got in results.items():
+            for v in got:
+                assert v == ref_a[i] or v == ref_b[i], (
+                    f"request {i} scored {v!r}: neither the old model's "
+                    f"{ref_a[i]!r} nor the new model's {ref_b[i]!r} — "
+                    "a torn coefficient generation")
+        assert run.counters.get("serving.hot_swaps") == n_swaps
+        ladder.assert_no_retrace()  # swaps never retrace the rungs
+
+    def test_reload_still_rejects_mismatched_shapes(self):
+        model, _ = build_demo_model(seed=7)
+        small, _ = build_demo_model(seed=7, n_entities=8)
+        store = serving.CoefficientStore.from_game_model(model)
+        with pytest.raises(ValueError, match="identically-shaped"):
+            store.reload_coefficients(
+                serving.CoefficientStore.from_game_model(small))
+
+
 def test_selftest_cli_end_to_end():
     """`python -m photon_tpu.serving --selftest --json` — the CI smoke
     face of this whole module — exits 0 with every check ok."""
