@@ -669,6 +669,161 @@ def run_serving(ladder, pool) -> dict:
     }
 
 
+# --- open-loop SLO leg (overload round) -----------------------------------
+# serving_qps is CLOSED-loop: clients wait for answers, so offered load
+# can never exceed capacity and overload is unobservable by construction.
+# Production traffic is OPEN-loop — arrivals at a fixed rate, indifferent
+# to our latency — so this leg drives the dispatcher at a swept arrival
+# rate with the admission policy ARMED (per-request deadline, watermark
+# shedding, non-blocking submit; photon_tpu/serving/admission.py) and
+# emits an SLO verdict (the highest offered rate with served p99 <=
+# SLO_TARGET_P99_MS and shed <= SLO_SHED_PASS_FRAC) plus the
+# graceful-degradation curve past saturation: shed fraction RISES while
+# the p99 of requests actually served stays BOUNDED near the deadline,
+# and every submitted future resolves (zero lost). The closing
+# assert_no_retrace spans the admission-OFF serving_qps run and this
+# admission-ON sweep on the same ladder — the on/off program-invariance
+# fact, live (its static twin is the registered
+# serving_admission_program_invariance contract).
+SLO_TARGET_P99_MS = 50.0
+SLO_DEADLINE_MS = 100.0
+SLO_WATERMARK = 512
+SLO_RATE_FACTORS = (0.25, 0.5, 1.0, 2.5)
+SLO_SECONDS_PER_RATE = 1.5
+SLO_MIN_REQUESTS = 256
+SLO_MAX_REQUESTS = 8192
+SLO_SHED_PASS_FRAC = 0.01
+
+
+def _slo_policy():
+    from photon_tpu import serving
+
+    return serving.AdmissionPolicy(deadline_ms=SLO_DEADLINE_MS,
+                                   shed_watermark=SLO_WATERMARK,
+                                   submit_timeout_s=0.0)
+
+
+def _drive_open_loop(ladder, reqs, qps: float) -> dict:
+    """Fixed-arrival-rate driver: request i submits at t0 + i/qps
+    regardless of completions (the open loop), then every future
+    resolves — a float score or a typed `Shed`, never a leak."""
+    from photon_tpu import serving
+
+    d = serving.MicroBatchDispatcher(
+        ladder, max_batch=SV_MAX_BATCH, max_delay_us=SV_MAX_DELAY_US,
+        policy=_slo_policy())
+    period = 1.0 / qps
+    futs = []
+    t0 = time.perf_counter()
+    for i, r in enumerate(reqs):
+        lag = (t0 + i * period) - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        futs.append(d.submit(r))
+    submit_wall = time.perf_counter() - t0
+    results = [f.result(timeout=120) for f in futs]
+    d.close()
+    n = len(results)
+    sheds = [r for r in results if isinstance(r, serving.Shed)]
+    stats = d.latency_stats()
+    return {
+        "offered_qps": round(qps, 1),
+        "achieved_submit_qps": round(n / submit_wall, 1),
+        "n": n,
+        "served": stats["n"],
+        "shed_frac": round(len(sheds) / n, 4),
+        "deadline_expired": sum(
+            1 for s in sheds if s.reason == "deadline_expired"),
+        "served_p99_ms": (None if stats["p99_ms"] is None
+                          else round(stats["p99_ms"], 3)),
+        "lost_futures": sum(1 for f in futs if not f.done()),
+    }
+
+
+def _calibrate_capacity(ladder, reqs) -> float:
+    """Short closed-loop burst (8 clients) → the saturation QPS the
+    open-loop sweep brackets with SLO_RATE_FACTORS."""
+    import threading
+
+    from photon_tpu import serving
+
+    d = serving.MicroBatchDispatcher(
+        ladder, max_batch=SV_MAX_BATCH, max_delay_us=SV_MAX_DELAY_US)
+    it = iter(reqs)
+    lock = threading.Lock()
+
+    def client():
+        while True:
+            with lock:
+                req = next(it, None)
+            if req is None:
+                return
+            d.score(req, timeout=60)
+
+    threads = [threading.Thread(target=client) for _ in range(8)]
+    t0 = time.perf_counter()
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    wall = time.perf_counter() - t0
+    d.close()
+    return len(reqs) / wall
+
+
+def run_serving_slo(ladder, pool, capacity_qps: float | None = None) -> dict:
+    """The open-loop QPS sweep: SLO verdict + degradation curve (see the
+    leg comment above)."""
+    if capacity_qps is None:
+        capacity_qps = _calibrate_capacity(ladder, pool[:512])
+    curve = []
+    for f in SLO_RATE_FACTORS:
+        rate = capacity_qps * f
+        n = int(min(max(rate * SLO_SECONDS_PER_RATE, SLO_MIN_REQUESTS),
+                    SLO_MAX_REQUESTS))
+        reqs = [pool[i % len(pool)] for i in range(n)]
+        curve.append(_drive_open_loop(ladder, reqs, rate))
+    # the retrace bound now spans admission off (serving_qps) AND on
+    ladder.assert_no_retrace()
+    lost = sum(pt["lost_futures"] for pt in curve)
+    passing = [pt for pt in curve
+               if pt["served_p99_ms"] is not None
+               and pt["served_p99_ms"] <= SLO_TARGET_P99_MS
+               and pt["shed_frac"] <= SLO_SHED_PASS_FRAC]
+    sustained = passing[-1] if passing else None
+    overload = curve[-1]
+    # "bounded" past saturation: served requests waited at most their
+    # deadline before dispatch, so p99 must sit near the deadline, not
+    # grow with offered load (2x = deadline + generous program/readback)
+    p99_bound_ms = 2.0 * SLO_DEADLINE_MS
+    bounded = (overload["served_p99_ms"] is not None
+               and overload["served_p99_ms"] <= p99_bound_ms)
+    degradation = (sustained is None
+                   or overload["shed_frac"] >= sustained["shed_frac"])
+    ok = bool(sustained is not None and bounded and degradation
+              and lost == 0)
+    sus_qps = 0.0 if sustained is None else sustained["offered_qps"]
+    sus_p99 = (curve[0]["served_p99_ms"] if sustained is None
+               else sustained["served_p99_ms"]) or 0.0
+    verdict = (
+        f"SLO {'PASS' if ok else 'FAIL'}: served p99 <= "
+        f"{SLO_TARGET_P99_MS:.0f} ms at {sus_qps:.0f} QPS offered "
+        f"(shed <= {100 * SLO_SHED_PASS_FRAC:.0f}%); past saturation "
+        f"({overload['offered_qps']:.0f} QPS): shed "
+        f"{100 * overload['shed_frac']:.1f}%, served p99 "
+        f"{overload['served_p99_ms']} ms (bound {p99_bound_ms:.0f} ms), "
+        f"lost futures {lost}")
+    return {
+        "sustained_qps": sus_qps,
+        "p99_ms": sus_p99,
+        "overload_qps": overload["offered_qps"],
+        "overload_p99_ms": overload["served_p99_ms"] or 0.0,
+        "overload_shed_pct": round(100 * overload["shed_frac"], 2),
+        "lost_futures": lost,
+        "ok": ok,
+        "verdict": verdict,
+        "curve": curve,
+    }
+
+
 # --- checkpoint-overhead leg (round 10) -----------------------------------
 # The elasticity tax: the SAME streamed-dense problem as `streamed_dense`,
 # solved with crash-consistent snapshots every CK_EVERY_EVALS objective
@@ -828,6 +983,9 @@ def main() -> None:
         sv_ladder, sv_pool = serving_problem()
     with telemetry.span("leg.serving_qps"):
         serving_stats = run_serving(sv_ladder, sv_pool)
+    with telemetry.span("leg.serving_slo"):
+        slo_stats = run_serving_slo(sv_ladder, sv_pool,
+                                    capacity_qps=serving_stats["qps"])
     telemetry.finish_run()
     ledger_report = profiling.finish_ledger()
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
@@ -925,7 +1083,26 @@ def main() -> None:
             "serving_p50_ms": round(serving_stats["p50_ms"], 3),
             "serving_p95_ms": round(serving_stats["p95_ms"], 3),
             "serving_p99_ms": round(serving_stats["p99_ms"], 3),
+            # open-loop SLO regime (overload round): fixed arrival rates
+            # with the admission policy armed. sustained_qps/p99 gate as
+            # usual; overload_shed_pct gates LOWER-better ("shed" in the
+            # sentinel direction map — more shedding at the same offered
+            # rate means the tier got slower); slo_target_ms is a config
+            # bar the sentinel excludes; the bool verdict is excluded by
+            # type. Zero lost futures is asserted by the leg itself.
+            "serving_slo_sustained_qps": round(slo_stats["sustained_qps"],
+                                               1),
+            "serving_slo_p99_ms": round(slo_stats["p99_ms"], 3),
+            "serving_slo_overload_p99_ms":
+                round(slo_stats["overload_p99_ms"], 3),
+            "serving_slo_overload_shed_pct": slo_stats["overload_shed_pct"],
+            "serving_slo_target_ms": SLO_TARGET_P99_MS,
+            "serving_slo_ok": bool(slo_stats["ok"]),
         },
+        # the verdict line + full degradation curve ride beside the legs
+        # (strings/lists are invisible to the sentinel's leg_values)
+        "serving_slo": {"verdict": slo_stats["verdict"],
+                        "curve": slo_stats["curve"]},
     }
     # attribution-ledger digest: the top measured programs + compile
     # accounting ride the JSON line next to the wall-clock legs

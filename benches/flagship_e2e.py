@@ -106,6 +106,13 @@ def main() -> None:
                         "dispatcher; QPS + p50/p95/p99 latency, with the "
                         "never-retraces assertion) and print its JSON "
                         "line")
+    p.add_argument("--serving-slo-leg", action="store_true",
+                   help="also run bench.py's open-loop serving_slo leg "
+                        "(fixed arrival-rate sweep with the admission "
+                        "policy armed: SLO verdict line + the graceful-"
+                        "degradation curve past saturation — shed "
+                        "fraction rises, served p99 stays bounded, zero "
+                        "lost futures) and print its JSON line")
     args = p.parse_args()
 
     import _flagship_data as fd
@@ -270,21 +277,40 @@ def main() -> None:
             "snapshot_bytes_per_sec":
                 round(ck["snapshot_bytes_per_sec"], 1)}), flush=True)
 
-    if args.serving_leg:
-        # bench.py's serving_qps leg verbatim: the online-scoring regime
+    if args.serving_leg or args.serving_slo_leg:
+        # bench.py's serving legs verbatim: the online-scoring regime
         # (many tiny micro-batched requests) measured and retrace-checked
         # beside the training flagship it serves.
         import bench
 
         sv_ladder, sv_pool = bench.serving_problem()
-        stats = bench.run_serving(sv_ladder, sv_pool)
-        print(json.dumps({
-            "leg": "serving_qps",
-            "qps": round(stats["qps"], 1),
-            "p50_ms": round(stats["p50_ms"], 3),
-            "p95_ms": round(stats["p95_ms"], 3),
-            "p99_ms": round(stats["p99_ms"], 3),
-            "n_requests": stats["n_requests"]}), flush=True)
+        capacity = None
+        if args.serving_leg:
+            stats = bench.run_serving(sv_ladder, sv_pool)
+            capacity = stats["qps"]
+            print(json.dumps({
+                "leg": "serving_qps",
+                "qps": round(stats["qps"], 1),
+                "p50_ms": round(stats["p50_ms"], 3),
+                "p95_ms": round(stats["p95_ms"], 3),
+                "p99_ms": round(stats["p99_ms"], 3),
+                "n_requests": stats["n_requests"]}), flush=True)
+        if args.serving_slo_leg:
+            # the open-loop overload face: fixed arrival rates, admission
+            # policy armed, SLO verdict + degradation curve. Calibrates
+            # its own capacity unless the closed-loop leg just ran.
+            slo = bench.run_serving_slo(sv_ladder, sv_pool,
+                                        capacity_qps=capacity)
+            print(json.dumps({
+                "leg": "serving_slo",
+                "sustained_qps": round(slo["sustained_qps"], 1),
+                "p99_ms": round(slo["p99_ms"], 3),
+                "overload_p99_ms": round(slo["overload_p99_ms"], 3),
+                "overload_shed_pct": slo["overload_shed_pct"],
+                "lost_futures": slo["lost_futures"],
+                "ok": slo["ok"],
+                "verdict": slo["verdict"],
+                "curve": slo["curve"]}), flush=True)
 
 
 if __name__ == "__main__":

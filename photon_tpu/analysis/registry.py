@@ -24,6 +24,8 @@ HOT_PATH_MODULES = (
     "photon_tpu.drivers.score",       # chunked scoring driver program
     "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
     "photon_tpu.serving.programs",    # online per-request scoring ladder
+    "photon_tpu.serving.admission",   # overload policy: program invariance
+    "photon_tpu.serving.fleet",       # replica-shard per-request path
     "photon_tpu.checkpoint.taps",     # checkpoint-off-is-free guarantee
     "photon_tpu.profiling.ledger",    # ledger-off-is-free guarantee
     "photon_tpu.evaluation.grouped",  # scatter-free per-entity metrics

@@ -7,7 +7,9 @@ GAME block pipeline, snapshot writers) have no lineage to replay, so this
 module supplies the two halves explicitly:
 
 - **kill points** — named sites on the hot paths (``chunk_upload``,
-  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``) where
+  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``, and
+  the serving tier's ``rung_execute``/``replica_dispatch``/``store_open``
+  — docs/SERVING.md "Overload semantics") where
   an armed :class:`FaultPlan` raises :class:`InjectedFault` at a chosen
   occurrence, simulating a preemption at exactly that moment. Sites are
   DETERMINISTIC: the n-th hit of a site is the same program point on every
@@ -16,9 +18,16 @@ module supplies the two halves explicitly:
   module-global load and one branch — the same off-state contract as
   `photon_tpu.telemetry`.
 - **transient errors + retry** — :func:`retry_io` wraps host IO (Avro
-  container opens, snapshot reads/writes) in bounded retry with
+  container opens, serving store opens, snapshot reads/writes, the
+  serving fleet's per-replica dispatch) in bounded retry with
   exponential backoff; an armed plan can inject ``OSError`` a fixed number
-  of times at a site to prove the retry path end to end. Backoff is
+  of times at a site to prove the retry path end to end. A `retry_io`
+  site is a FULL fault site: ``errors[site]`` injects retried transient
+  failures, and ``kills[site]`` injects an :class:`InjectedFault` at that
+  occurrence — by default fatal (InjectedFault is not an OSError), but a
+  caller whose ``retry_on`` includes it recovers, which is exactly how
+  the serving fleet's ``replica_dispatch`` site models "a replica died;
+  the request fails over". Backoff is
   deterministic (no jitter): these are host-side file systems, not a
   thundering-herd RPC fleet, and determinism keeps tests exact.
 
@@ -142,13 +151,20 @@ def kill_point(site: str) -> None:
 
 
 def _maybe_io_error(site: str) -> None:
-    """Transient-error half of a site: raise TransientIOError for the
-    first ``errors[site]`` occurrences (each retry attempt is its own
-    occurrence, so ``errors={"s": 2}`` fails twice then succeeds)."""
+    """The fault half of a `retry_io` site, honoring BOTH plan maps on one
+    occurrence counter: ``kills[site] == n`` raises InjectedFault (a kill
+    at the n-th attempt — NOT retried unless the caller's ``retry_on``
+    includes it, which is how the serving fleet turns a replica death
+    into failover), and ``n <= errors[site]`` raises TransientIOError
+    (each retry attempt is its own occurrence, so ``errors={"s": 2}``
+    fails twice then succeeds)."""
     plan = _PLAN
     if plan is None:
         return
     n = plan.hit(site)
+    if plan.kills.get(site) == n:
+        telemetry.count("faults.injected_kills")
+        raise InjectedFault(site, n)
     if n <= plan.errors.get(site, 0):
         telemetry.count("faults.injected_errors")
         raise TransientIOError(f"injected transient IO failure at "

@@ -24,7 +24,7 @@ prior + warm start) composed into the production loop the ROADMAP's
 
 Telemetry (`continual.*`, names documented in
 ``photon_tpu/telemetry/__init__``): plans/touched_entities/
-new_entities_deferred/touched_buckets/skipped_buckets/refresh_solves/
+deferred_new_keys/touched_buckets/skipped_buckets/refresh_solves/
 refresh_iterations/refreshes/probe_entities/swap_refusals counters and
 delta_diff/refresh/refresh_coordinate/refresh_solve/probe/swap spans
 (the in-process cutover itself counts on ``serving.hot_swaps``).

@@ -157,8 +157,20 @@ def diff_manifest(prev_manifest: dict, drop: GameData,
                 n_touched_rows=int(counts[known & in_model].sum()))
             telemetry.count("continual.touched_entities",
                             int(touched.shape[0]))
-            telemetry.count("continual.new_entities_deferred",
-                            int(new.shape[0]))
+            if new.shape[0]:
+                # new-entity deferral is a DECISION, not an accident: say
+                # it out loud (the ROADMAP "new-entity admission without a
+                # full retrain" breadcrumb starts from this count)
+                telemetry.count("continual.deferred_new_keys",
+                                int(new.shape[0]))
+                from photon_tpu.utils.logging import photon_logger
+
+                photon_logger("photon_tpu.continual", propagate=True).info(
+                    "delta refresh coordinate %r: deferring %d new "
+                    "entities outside the previous model's entity space "
+                    "(the hot-swap contract pins shapes); they serve the "
+                    "cold-miss fixed-effect-only fallback until the next "
+                    "full retrain", cname, int(new.shape[0]))
         telemetry.count("continual.plans")
         return RefreshPlan(plans, n_drop_rows=drop.n,
                            n_prev_rows=int(prev_manifest.get("n_rows", 0)))

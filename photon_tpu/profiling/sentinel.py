@@ -60,18 +60,23 @@ REL_FLOOR = 0.10
 # {"schema", "gate"} (this module's verdicts embedded per leg).
 SCHEMA_VERSION = 2
 
-# Legs where LOWER is better (latency, overhead, waste); everything else
-# is a rate/score where higher is better.
+# Legs where LOWER is better (latency, overhead, waste, shed); everything
+# else is a rate/score where higher is better. "shed": the serving_slo
+# overload legs — a rising shed percentage at the SAME offered rate means
+# the tier got slower, a real regression (the shed-vs-queue TRADE is
+# by design; its cost moving is not).
 _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
-                         "stall")
+                         "stall", "shed")
 
 # Config-ish / count legs that are not performance quantities: a changed
 # topology, cadence, or layout split must not read as a "regression".
 # (_frac / _width_buckets: the round-12 sparse hot/tail-split facts — a
 # retuned d_dense would move them by design; pad_waste stays GATED,
-# lower-better, because growing pow2 padding is a real cost.)
+# lower-better, because growing pow2 padding is a real cost. slo_target:
+# the serving SLO bar is a chosen config, not a measurement.)
 _EXCLUDE_PATTERNS = ("_n_chips", "n_requests", "snapshots", "cadence",
-                     "_vs_baseline", "_frac", "_width_buckets")
+                     "_vs_baseline", "_frac", "_width_buckets",
+                     "slo_target")
 
 
 def lower_is_better(leg: str) -> bool:

@@ -26,6 +26,21 @@ Three planes:
   deadline-based flush (``max_batch`` / ``max_delay_us``), padded
   dispatch into the nearest rung, asynchronous device_get, `serving.*`
   telemetry spans/counters and p50/p95/p99 request latency.
+- **Policy plane** (`admission.AdmissionPolicy` / `admission.Shed`):
+  overload control — per-request deadlines (expired requests resolve to
+  a typed `Shed` instead of occupying a batch slot), watermark load
+  shedding, bounded ``submit(timeout=)`` so callers never block
+  forever; all off by default. The registered
+  ``serving_admission_program_invariance`` contract pins that the
+  policy layer never changes the device program
+  (docs/SERVING.md "Overload semantics").
+- **Fleet plane** (`fleet.ReplicaFleet`): N dispatcher replicas over
+  entity-range-sharded stores (`fleet.shard_store`), hashed routing,
+  and retry/timeout/exponential-backoff failover riding
+  `checkpoint.faults.retry_io` with deterministic fault sites
+  (``replica_dispatch``, ``rung_execute``, ``store_open``) — a kill
+  matrix proves zero hung futures, zero torn responses, and
+  degraded-but-correct cold-miss answers under every fault.
 
 Parity: dispatcher-batched scores are bit-identical to the offline
 `drivers/score.py` path for the same model and rows (tests/test_serving.py).
@@ -49,9 +64,21 @@ on any parity / contract / retrace / latency-accounting failure.
 """
 from __future__ import annotations
 
+from photon_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionPolicy,
+    Shed,
+)
 from photon_tpu.serving.dispatcher import (  # noqa: F401
     MicroBatchDispatcher,
+    RungExecutor,
     ScoreRequest,
+)
+from photon_tpu.serving.fleet import (  # noqa: F401
+    FleetPolicy,
+    Replica,
+    ReplicaFleet,
+    shard_store,
 )
 from photon_tpu.serving.programs import (  # noqa: F401
     LADDER_SCHEMA,
@@ -67,5 +94,7 @@ from photon_tpu.serving.store import (  # noqa: F401
 __all__ = [
     "CoefficientStore", "FixedBlock", "RandomBlock",
     "ProgramLadder", "ShardSpec", "LADDER_SCHEMA",
-    "MicroBatchDispatcher", "ScoreRequest",
+    "MicroBatchDispatcher", "RungExecutor", "ScoreRequest",
+    "AdmissionController", "AdmissionPolicy", "Shed",
+    "FleetPolicy", "Replica", "ReplicaFleet", "shard_store",
 ]

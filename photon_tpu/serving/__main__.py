@@ -14,10 +14,22 @@ mix (mixed batch sizes, seen + unseen entities), and checks:
   fixed-effect-only fallback);
 - **no retrace**: the `TraceSignatureLog` saw at most one signature per
   ladder rung and zero weak-type drift;
-- **contracts**: the registered `serving_request_*` ContractSpecs trace
-  clean (zero collectives / host exits / f64);
+- **contracts**: the registered `serving_request_*`,
+  `serving_admission_program_invariance`, and
+  `serving_fleet_request_path` ContractSpecs trace clean;
 - **latency accounting**: every request produced exactly one recorded
-  latency, percentiles are ordered, and the `serving.*` counters add up.
+  latency, percentiles are ordered, and the `serving.*` counters add up;
+- **overload semantics** (the robustness round): an open-loop burst with
+  the admission policy armed resolves EVERY future (scored or typed
+  `Shed`), deadline-expired requests shed deterministically, watermark
+  shedding engages, the admitted/shed/deadline_expired counters add up,
+  and the ladder's retrace bound holds across admission on AND off;
+- **replica-kill matrix**: a 2-replica entity-range fleet under kills at
+  every serving fault site (`replica_dispatch`, `rung_execute`,
+  `store_open`) × first/middle/last occurrence — zero hung futures, zero
+  torn responses, every answer either exact or the degraded-but-correct
+  fixed-effect-only fallback — plus the transient-error retry/backoff
+  path end to end.
 """
 from __future__ import annotations
 
@@ -145,8 +157,12 @@ def _selftest(as_json: bool) -> int:
 
     # registered serving contracts trace clean
     from photon_tpu.analysis.contracts import REGISTRY, check_contract
+    from photon_tpu.serving import admission as _admission  # noqa: F401
+    from photon_tpu.serving import fleet as _fleet  # noqa: F401 registers
 
-    for name in ("serving_request_program", "serving_request_margin"):
+    for name in ("serving_request_program", "serving_request_margin",
+                 "serving_admission_program_invariance",
+                 "serving_fleet_request_path"):
         spec = REGISTRY.get(name)
         if spec is None:
             check(f"contract_{name}", False, "spec not registered")
@@ -167,6 +183,140 @@ def _selftest(as_json: bool) -> int:
           and counters.get("serving.batches", 0) >= 1
           and counters.get("serving.cold_misses") == float(miss.sum()),
           f"counters: { {k: v for k, v in sorted(counters.items())} }")
+
+    # ---------------- overload semantics (robustness round) ----------------
+    # open-loop burst, admission armed: every future resolves (scored or
+    # typed Shed), deadline-0 requests expire deterministically, a
+    # watermark-0 dispatcher sheds every submit, counters add up, and the
+    # SAME ladder that served admission-off traffic above keeps its
+    # retrace bound — admission on/off never changes the programs.
+    r2 = telemetry.start_run("serving_selftest_overload")
+    burst = serving.MicroBatchDispatcher(
+        ladder, max_batch=16, max_delay_us=2000,
+        policy=serving.AdmissionPolicy(deadline_ms=500.0,
+                                       submit_timeout_s=0.0))
+    try:
+        futs = [burst.submit(q) for q in reqs[:24]]
+        expired = [burst.submit(serving.ScoreRequest(
+            features=q.features, entities=q.entities, offset=q.offset,
+            deadline_ms=0.0)) for q in reqs[24:32]]
+        burst_res = [f.result(timeout=30) for f in futs]
+        expired_res = [f.result(timeout=30) for f in expired]
+    finally:
+        burst.close()
+    shedder = serving.MicroBatchDispatcher(
+        ladder, max_batch=16, max_delay_us=2000,
+        policy=serving.AdmissionPolicy(shed_watermark=0))
+    try:
+        shed_res = [shedder.submit(q).result(timeout=30)
+                    for q in reqs[:8]]
+    finally:
+        shedder.close()
+        telemetry.finish_run()
+    check("overload_all_futures_resolve",
+          len(burst_res) == 24 and len(expired_res) == 8
+          and len(shed_res) == 8
+          and all(isinstance(v, (float, serving.Shed))
+                  for v in burst_res + expired_res + shed_res),
+          "an overload future leaked or resolved to a foreign type")
+    check("overload_deadline_expiry",
+          all(isinstance(v, serving.Shed)
+              and v.reason == "deadline_expired" for v in expired_res),
+          f"deadline-0 requests did not all expire: {expired_res[:3]}")
+    check("overload_watermark_shed",
+          all(isinstance(v, serving.Shed) and v.reason == "watermark"
+              for v in shed_res),
+          f"watermark-0 submits did not all shed: {shed_res[:3]}")
+    c2 = r2.counters
+    scored = sum(1 for v in burst_res if isinstance(v, float))
+    check("overload_counter_accounting",
+          c2.get("serving.admitted", 0) == float(len(futs) + len(expired))
+          and c2.get("serving.deadline_expired", 0) == float(
+              len(expired) + (24 - scored))
+          and c2.get("serving.shed", 0) == 8.0,
+          f"counters: { {k: v for k, v in sorted(c2.items())} }")
+    try:
+        ladder.assert_no_retrace()
+        check("admission_no_retrace_on_off", True)
+    except AssertionError as e:
+        check("admission_no_retrace_on_off", False, str(e))
+
+    # --------------- replica fleet: kill matrix + retry/backoff ------------
+    from photon_tpu import checkpoint
+
+    fleet_policy = serving.FleetPolicy(attempt_timeout_s=30.0,
+                                       base_delay_s=0.001,
+                                       max_delay_s=0.01)
+    lk = dict(ladder=(8,), sparse_k={"member": sparse_k})
+    dk = dict(max_batch=8, max_delay_us=200)
+    fleet = serving.ReplicaFleet.build(store, 2, policy=fleet_policy,
+                                       ladder_kwargs=lk,
+                                       dispatcher_kwargs=dk)
+    kreqs = [serving.ScoreRequest(
+        features={"global": xg[i], "member": (ind[i], val[i])},
+        entities={"memberId": f"e{(2 * i) % 16:03d}"},
+        offset=float(offs[i])) for i in range(8)]
+    freqs = [serving.ScoreRequest(
+        features=q.features, entities={"memberId": "zz-unseen"},
+        offset=q.offset) for q in kreqs]
+    try:
+        clean = [fleet.score(q) for q in kreqs]
+        fixed_only = [fleet.score(q) for q in freqs]
+        check("fleet_parity",
+              all(isinstance(v, float) for v in clean + fixed_only)
+              and any(c != f for c, f in zip(clean, fixed_only)),
+              "fleet baseline scores are broken or degenerate")
+        with checkpoint.record_sites() as rec:
+            dry = [fleet.score(q) for q in kreqs]
+        check("fleet_dry_run_deterministic", dry == clean,
+              "an unarmed recorder changed fleet answers")
+        matrix_ok, matrix_detail = True, []
+        for site in ("replica_dispatch", "rung_execute"):
+            total = rec.hits.get(site, 0)
+            for occ in sorted({1, max(total // 2, 1), max(total, 1)}):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at(site, occ)):
+                    got = [fleet.score(q) for q in kreqs]
+                bad = [i for i, (g, c, f) in enumerate(
+                    zip(got, clean, fixed_only))
+                    if not (g == c or g == f)]
+                if bad:
+                    matrix_ok = False
+                    matrix_detail.append(f"{site}@{occ}: torn rows {bad}")
+        check("fleet_kill_matrix", matrix_ok, "; ".join(matrix_detail))
+        try:
+            fleet.assert_no_retrace()
+            check("fleet_no_retrace_after_kills", True)
+        except AssertionError as e:
+            check("fleet_no_retrace_after_kills", False, str(e))
+    finally:
+        fleet.close()
+
+    # store_open: transient errors retry, kills propagate, reopen clean
+    import tempfile as _tempfile
+
+    with _tempfile.TemporaryDirectory(prefix="photon_selftest_") as root:
+        sdir = os.path.join(root, "shard0")
+        serving.shard_store(store, 2)[0].save(sdir)
+        try:
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan(errors={"store_open": 2})):
+                back = serving.CoefficientStore.open(sdir, mmap=False)
+            check("store_open_transient_retry",
+                  back.order == store.order, "retried open lost the store")
+        except OSError as e:
+            check("store_open_transient_retry", False, str(e))
+        killed = False
+        try:
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan.kill_at("store_open", 1)):
+                serving.CoefficientStore.open(sdir, mmap=False)
+        except checkpoint.InjectedFault:
+            killed = True
+        reopened = serving.CoefficientStore.open(sdir, mmap=False)
+        check("store_open_kill_then_clean_reopen",
+              killed and reopened.order == store.order,
+              "kill did not propagate or poisoned the store")
 
     failures = {k: v for k, v in checks.items() if v}
     if as_json:

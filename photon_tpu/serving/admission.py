@@ -1,0 +1,212 @@
+"""Admission control: the serving tier's overload-policy plane.
+
+PR 6's dispatcher had exactly one overload behavior: ``submit`` blocks on
+a full bounded queue. Under sustained OPEN-LOOP load (arrivals at a fixed
+rate, not closed-loop clients) that means unbounded client latency — the
+queue never shrinks, every request eventually scores, and every score is
+seconds stale. Production serving wants the opposite: **degrade by
+shedding, never by queueing** (docs/SERVING.md "Overload semantics").
+This module is the policy half of that split — pure decisions over
+queue depth and deadlines, no queue, no threads, no device anywhere —
+so the `MicroBatchDispatcher` (queueing + device execution) stays policy
+free and the registered ``serving_admission_program_invariance``
+contract can prove the policy layer changes WHICH requests dispatch but
+never the device program they dispatch into.
+
+Three mechanisms, each off by default (the default `AdmissionPolicy` is
+bit-compatible with the pre-admission dispatcher):
+
+- **watermark shedding**: queue depth ≥ ``shed_watermark`` at submit
+  time resolves the request immediately to a typed :class:`Shed`
+  (reason ``"watermark"``) instead of enqueueing — counted on
+  ``serving.shed``.
+- **deadlines**: a per-request ``deadline_ms`` (request field, else the
+  policy default) turns into an absolute nanosecond deadline at enqueue;
+  an expired request resolves to ``Shed("deadline_expired")`` instead of
+  occupying a batch slot — counted on ``serving.deadline_expired``. The
+  score a client stopped waiting for is pure waste; dropping it is what
+  keeps admitted-request p99 BOUNDED past saturation.
+- **bounded submit**: ``submit(timeout=)`` (or the policy's
+  ``submit_timeout_s`` default) bounds the blocking put — a still-full
+  queue sheds (reason ``"queue_full"``) so callers never block forever.
+
+Admitted requests count on ``serving.admitted``; the open-loop
+``serving_slo`` bench leg (bench.py) reads these three counters as the
+graceful-degradation curve.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+# Shed reasons (the `Shed.reason` vocabulary).
+SHED_WATERMARK = "watermark"
+SHED_QUEUE_FULL = "queue_full"
+SHED_DEADLINE = "deadline_expired"
+SHED_CLOSED = "closed"
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """The typed result a dropped request's Future resolves to — shedding
+    is an ANSWER ("not now"), not an exception: the future always
+    resolves, the caller always learns why, and nothing leaks.
+
+    reason: one of ``watermark`` (queue depth ≥ the shed watermark at
+        submit), ``queue_full`` (bounded submit timed out on a full
+        queue), ``deadline_expired`` (admitted, but its deadline passed
+        before a batch slot), ``closed`` (dispatcher shut down before
+        dispatch).
+    queue_depth: the depth observed when the decision was made.
+    waited_ms: how long the request sat before being shed (0 for
+        submit-time sheds).
+    """
+
+    reason: str
+    queue_depth: int = 0
+    waited_ms: float = 0.0
+
+    def __bool__(self) -> bool:
+        # a Shed is falsy so `if result:` reads as "was it scored"
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """The overload knobs. Every default is None = off: a policy-less
+    dispatcher behaves exactly like the pre-admission one (submit blocks
+    on a full queue, nothing sheds, nothing expires).
+
+    deadline_ms: default per-request deadline (a request's own
+        ``deadline_ms`` overrides); measured from enqueue.
+    shed_watermark: queue depth at/above which submit sheds immediately.
+        Set BELOW ``queue_depth`` — the watermark is the graceful lever,
+        the queue bound is the memory backstop.
+    submit_timeout_s: default bound on a blocking submit (0 = never
+        block: full queue sheds immediately).
+    """
+
+    deadline_ms: Optional[float] = None
+    shed_watermark: Optional[int] = None
+    submit_timeout_s: Optional[float] = None
+
+    @property
+    def active(self) -> bool:
+        return (self.deadline_ms is not None
+                or self.shed_watermark is not None
+                or self.submit_timeout_s is not None)
+
+
+class AdmissionController:
+    """Pure policy evaluation for one dispatcher. Stateless beyond the
+    policy itself; the dispatcher owns futures, queues, and counters —
+    this class only answers "admit?", "what deadline?", "expired?"."""
+
+    def __init__(self, policy: Optional[AdmissionPolicy] = None):
+        self.policy = policy or AdmissionPolicy()
+
+    # ------------------------------------------------------------ decisions
+    def submit_shed_reason(self, queue_depth: int) -> Optional[str]:
+        """Shed reason for a submit seen at ``queue_depth``, or None to
+        admit (watermark check — the queue-full bound is the dispatcher's
+        put timeout)."""
+        wm = self.policy.shed_watermark
+        if wm is not None and queue_depth >= wm:
+            return SHED_WATERMARK
+        return None
+
+    def deadline_ns(self, req, t_enqueue_ns: int) -> Optional[int]:
+        """Absolute perf_counter_ns deadline for one request (request
+        field wins over the policy default; None = no deadline)."""
+        ms = getattr(req, "deadline_ms", None)
+        if ms is None:
+            ms = self.policy.deadline_ms
+        if ms is None:
+            return None
+        return t_enqueue_ns + int(float(ms) * 1e6)
+
+    def submit_timeout_s(self, timeout: Optional[float]) -> Optional[float]:
+        """Effective submit bound: the explicit ``submit(timeout=)`` wins
+        over the policy default; None = block forever (legacy)."""
+        return self.policy.submit_timeout_s if timeout is None else timeout
+
+    @staticmethod
+    def expired(pending, now_ns: Optional[int] = None) -> bool:
+        """Has this pending request's deadline passed? Pure — the
+        dispatcher counts and resolves."""
+        dl = getattr(pending, "deadline_ns", None)
+        if dl is None:
+            return False
+        return (time.perf_counter_ns() if now_ns is None else now_ns) > dl
+
+
+# ----------------------------------------------------------------- contracts
+# The admission layer's law: policy changes WHICH requests reach the
+# device, never the device program. The builder runs the REAL collate
+# path (dispatcher.collate_rung_args) under admission OFF and admission
+# ON (an expired request filtered out, a watermark decision evaluated)
+# and raises if the two dispatch signatures diverge — zero new trace
+# signatures by construction, the live assert_no_retrace fact as a
+# registry-checked contract. No compiles, no threads: signatures are
+# abstract shape/dtype facts (TraceSignatureLog), exactly what the
+# contract engine allows builders to do.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+@register_contract(
+    name="serving_admission_program_invariance",
+    description="admission on vs off over the same rung: deadline-expired "
+                "filtering and watermark decisions change batch membership "
+                "only — identical dispatch signature, identical program, "
+                "zero collectives / host exits / f64",
+    collectives={}, tags=("serving",))
+def _contract_admission_invariance():
+    import numpy as np
+
+    from photon_tpu.analysis.rules import TraceSignatureLog
+    from photon_tpu.serving.dispatcher import (ScoreRequest, _Pending,
+                                               collate_rung_args)
+    from photon_tpu.serving.programs import ProgramLadder, _tiny_store
+
+    ladder = ProgramLadder(_tiny_store(), ladder=(8,),
+                           sparse_k={"member": 3}, output_mean=True)
+
+    def req(i: int) -> ScoreRequest:
+        return ScoreRequest(
+            features={"global": np.zeros(12, np.float32),
+                      "member": (np.zeros(2, np.int32),
+                                 np.zeros(2, np.float32))},
+            entities={"memberId": f"e{i % 5}"})
+
+    log = TraceSignatureLog()
+    now = time.perf_counter_ns()
+    fixed_ws, re_cs = ladder.store.device_blocks()
+    for policy in (AdmissionPolicy(),  # off: the legacy dispatcher
+                   AdmissionPolicy(deadline_ms=5.0, shed_watermark=4)):
+        ctrl = AdmissionController(policy)
+        batch = []
+        for i in range(6):
+            p = _Pending(req(i))
+            p.deadline_ns = ctrl.deadline_ns(p.req, p.t_enqueue)
+            batch.append(p)
+        if policy.active:
+            # one request already expired + a watermark decision taken:
+            # the admission path at work, live
+            batch[0].deadline_ns = now - 1
+            if ctrl.submit_shed_reason(queue_depth=4) != SHED_WATERMARK:
+                raise AssertionError("watermark policy did not engage")
+            batch = [p for p in batch if not ctrl.expired(p, now)]
+            if len(batch) != 5:
+                raise AssertionError("deadline filter dropped nothing")
+        offsets, shards, ids, _ = collate_rung_args(ladder, batch, 8)
+        log.record("serving.score", (offsets, shards, ids, fixed_ws, re_cs))
+    sigs = log.signatures("serving.score")
+    if len(sigs) != 1:
+        raise AssertionError(
+            f"admission on/off produced {len(sigs)} dispatch signatures "
+            "— the policy layer changed the device program")
+    if log.hazards():
+        raise AssertionError(f"weak-type drift across admission: "
+                             f"{log.hazards()}")
+    return ladder._fn, ladder.example_args(8)

@@ -13,15 +13,31 @@ separate retire thread performs the blocking ``device_get`` — dispatch
 of batch i+1 overlaps the readback of batch i, the same one-deep
 software pipeline the offline scorer uses.
 
+Since the overload round this file is only the QUEUEING + DEVICE
+EXECUTION half of the request plane; overload POLICY (deadlines,
+watermark shedding, bounded submit) lives in `serving/admission.py` and
+plugs in via ``MicroBatchDispatcher(policy=AdmissionPolicy(...))``. The
+split is load-bearing: the `serving_admission_program_invariance`
+contract proves the policy layer changes which requests dispatch, never
+the device program — collation into a rung is the module-level
+`collate_rung_args`, shared by the dispatcher and the contract. Device
+execution carries the deterministic ``rung_execute`` fault site
+(`checkpoint.faults`): an injected kill there fails that batch's futures
+(never hangs them), which is what the replica fleet's failover retries
+against (serving/fleet.py).
+
 Telemetry (`serving.*` family, names listed in
 ``photon_tpu/telemetry/__init__``): requests/batches/batch_rows/
-pad_waste/cold_misses counters, queue-depth and batch-fill gauges, one
-``serving_batch`` event per flush, and per-request wall latency recorded
-request-enqueue → score-delivered, summarized as p50/p95/p99 by
-`latency_stats` (gauged at `close`).
+pad_waste/cold_misses/admitted/shed/deadline_expired counters,
+queue-depth and batch-fill gauges, one ``serving_batch`` event per
+flush, and per-request wall latency recorded request-enqueue →
+score-delivered, summarized as p50/p95/p99 by `latency_stats` (gauged at
+`close`).
 
 Thread-safety: `submit`/`score` are safe from any number of client
-threads; results arrive on `concurrent.futures.Future`s.
+threads; results arrive on `concurrent.futures.Future`s — a float score,
+or a typed `admission.Shed` when overload policy dropped the request
+(every future resolves; close() leaks nothing).
 """
 from __future__ import annotations
 
@@ -35,7 +51,11 @@ from typing import Optional
 import numpy as np
 
 from photon_tpu import profiling, telemetry
+from photon_tpu.checkpoint import faults
 from photon_tpu.data.matrix import SparseRows
+from photon_tpu.serving.admission import (SHED_DEADLINE, SHED_QUEUE_FULL,
+                                          AdmissionController,
+                                          AdmissionPolicy, Shed)
 from photon_tpu.serving.programs import ProgramLadder
 from photon_tpu.serving.store import CoefficientStore
 
@@ -49,20 +69,98 @@ class ScoreRequest:
     entities: entity-type name → raw key (e.g. ``{"memberId": "m123"}``).
         A missing or unseen key scores the fixed-effect-only fallback.
     offset: base margin offset (the reference's per-record offset column).
+    deadline_ms: per-request deadline from enqueue (overrides the
+        dispatcher policy's default); past it the request resolves to
+        ``Shed("deadline_expired")`` instead of occupying a batch slot.
     """
 
     features: dict
     entities: dict = dataclasses.field(default_factory=dict)
     offset: float = 0.0
+    deadline_ms: Optional[float] = None
 
 
 class _Pending:
-    __slots__ = ("req", "future", "t_enqueue")
+    __slots__ = ("req", "future", "t_enqueue", "deadline_ns")
 
     def __init__(self, req: ScoreRequest):
         self.req = req
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter_ns()
+        self.deadline_ns: Optional[int] = None
+
+
+def collate_rung_args(ladder: ProgramLadder, batch: list,
+                      bucket: int) -> tuple:
+    """Stack + pad B requests into one full-rung argument set. Pad rows
+    are all-zero (features, offsets) with entity id = the zero row — the
+    offline driver's exact pad convention. Module-level (not dispatcher
+    state) so the admission-invariance contract collates through the
+    SAME code the live dispatcher does.
+
+    Returns ``(offsets, shards, ids, n_cold_misses)``."""
+    store = ladder.store
+    B, n = bucket, len(batch)
+    offsets = np.zeros(B, np.float32)
+    for i, p in enumerate(batch):
+        offsets[i] = p.req.offset
+    shards = {}
+    for s, spec in ladder.shard_specs.items():
+        if spec.sparse_k is None:
+            X = np.zeros((B, spec.d), np.float32)
+            for i, p in enumerate(batch):
+                X[i] = np.asarray(p.req.features[s], np.float32)
+            shards[s] = X
+        else:
+            k = spec.sparse_k
+            ind = np.zeros((B, k), np.int32)
+            val = np.zeros((B, k), np.float32)
+            for i, p in enumerate(batch):
+                ri, rv = p.req.features[s]
+                ri = np.asarray(ri, np.int32)
+                if ri.shape[0] > k:
+                    raise ValueError(
+                        f"request row has {ri.shape[0]} nnz > shard "
+                        f"{s!r} sparse_k={k}")
+                ind[i, :ri.shape[0]] = ri
+                val[i, :ri.shape[0]] = np.asarray(rv, np.float32)
+            shards[s] = SparseRows(ind, val, spec.d)
+    ids = {}
+    misses = 0
+    for name, blk in store.random.items():
+        raw = [p.req.entities.get(blk.entity_name) for p in batch]
+        # absent key == unseen entity: both resolve to the zero row
+        keys = ["\x00missing\x00" if r is None else r for r in raw]
+        dense, n_miss = blk.lookup(keys)
+        col = np.full(B, blk.n_entities, np.int32)
+        col[:n] = dense
+        ids[name] = col
+        misses += n_miss
+    return offsets, shards, ids, misses
+
+
+class RungExecutor:
+    """The device-execution half: collate one admitted batch into its
+    rung and dispatch the program. No queue, no policy — the dispatcher
+    (or a test, or the contract) hands it a batch. Carries the
+    ``rung_execute`` fault site: an injected kill raises BEFORE the
+    program dispatches, simulating the replica dying mid-execution."""
+
+    def __init__(self, ladder: ProgramLadder):
+        self.ladder = ladder
+
+    def execute(self, batch: list) -> tuple:
+        """(device_out, bucket, n_cold_misses) for one non-empty batch."""
+        bucket = self.ladder.bucket_for(len(batch))
+        # per-rung attribution: collate + dispatch wall (the device
+        # readback is the retire thread's, measured by the
+        # request-latency percentiles)
+        with profiling.measure(f"serving.rung_{bucket}", "flush"):
+            offsets, shards, ids, misses = collate_rung_args(
+                self.ladder, batch, bucket)
+            faults.kill_point("rung_execute")
+            out_dev = self.ladder.score_padded(offsets, shards, ids)
+        return out_dev, bucket, misses
 
 
 class MicroBatchDispatcher:
@@ -73,13 +171,18 @@ class MicroBatchDispatcher:
     max_delay_us: oldest-request deadline — the latency the thinnest
         traffic pays to fill batches.
     queue_depth: bound on queued requests; `submit` blocks when full
-        (backpressure, never unbounded memory).
+        (backpressure, never unbounded memory) unless the admission
+        policy bounds the wait.
+    policy: overload policy (`admission.AdmissionPolicy`); the default
+        is everything-off — identical behavior to the pre-admission
+        dispatcher.
     """
 
     def __init__(self, ladder: ProgramLadder, *,
                  max_batch: Optional[int] = None,
                  max_delay_us: int = 500,
-                 queue_depth: int = 4096):
+                 queue_depth: int = 4096,
+                 policy: Optional[AdmissionPolicy] = None):
         self.ladder = ladder
         self.store: CoefficientStore = ladder.store
         self.max_batch = int(max_batch or ladder.max_batch)
@@ -88,6 +191,8 @@ class MicroBatchDispatcher:
                 f"max_batch {self.max_batch} exceeds the ladder top rung "
                 f"{ladder.max_batch}")
         self.max_delay_us = int(max_delay_us)
+        self.admission = AdmissionController(policy)
+        self._executor = RungExecutor(ladder)
         self._q: queue.Queue = queue.Queue(maxsize=int(queue_depth))
         self._retire_q: queue.Queue = queue.Queue(maxsize=4)
         self._latencies_ns: list = []
@@ -101,21 +206,46 @@ class MicroBatchDispatcher:
         self._retire_thread.start()
 
     # ------------------------------------------------------------- client API
-    def submit(self, req: ScoreRequest) -> Future:
-        """Enqueue one request; the Future resolves to its float score."""
+    def submit(self, req: ScoreRequest,
+               timeout: Optional[float] = None) -> Future:
+        """Enqueue one request; the Future resolves to its float score —
+        or to a typed `Shed` when admission drops it (watermark breach,
+        bounded-submit timeout on a full queue, or deadline expiry).
+
+        ``timeout`` bounds the blocking put (overrides the policy's
+        ``submit_timeout_s``; 0 = never block). With no bound anywhere
+        the put blocks — the legacy backpressure behavior."""
         if self._closed:
             raise RuntimeError("dispatcher is closed")
         p = _Pending(req)
-        self._q.put(p)  # blocks when the bounded queue is full
+        p.deadline_ns = self.admission.deadline_ns(req, p.t_enqueue)
+        reason = self.admission.submit_shed_reason(self._q.qsize())
+        if reason is not None:
+            return self._shed(p, reason)
+        bound = self.admission.submit_timeout_s(timeout)
+        if bound is None:
+            self._q.put(p)  # blocks when the bounded queue is full
+        else:
+            try:
+                if bound > 0:
+                    self._q.put(p, timeout=bound)
+                else:
+                    self._q.put_nowait(p)
+            except queue.Full:
+                return self._shed(p, SHED_QUEUE_FULL)
+        telemetry.count("serving.admitted")
         return p.future
 
     def score(self, req: ScoreRequest, timeout: Optional[float] = None):
-        """Synchronous scoring: submit + wait (closed-loop clients)."""
+        """Synchronous scoring: submit + wait (closed-loop clients).
+        Returns the float score, or a `Shed` under overload policy."""
         return self.submit(req).result(timeout=timeout)
 
     def close(self, timeout: float = 30.0) -> None:
         """Flush every queued request, stop both threads, gauge the final
-        latency percentiles into telemetry. Idempotent."""
+        latency percentiles into telemetry. Every outstanding future
+        resolves — scored, or `Shed` for requests whose deadline expired
+        in the queue (never leaked). Idempotent."""
         if self._closed:
             return
         self._closed = True
@@ -130,7 +260,8 @@ class MicroBatchDispatcher:
 
     # ---------------------------------------------------------------- stats
     def latency_stats(self) -> dict:
-        """Request-latency percentiles (ms) over every retired request."""
+        """Request-latency percentiles (ms) over every retired request
+        (shed requests never retire — they have no device latency)."""
         with self._lat_lock:
             lat = np.asarray(self._latencies_ns, np.float64)
         if lat.size == 0:
@@ -142,6 +273,25 @@ class MicroBatchDispatcher:
                 "mean_ms": float(lat.mean() / 1e6)}
 
     # ------------------------------------------------------------- internals
+    def _shed(self, p: _Pending, reason: str) -> Future:
+        """Resolve one pending request as shed (typed result, counted)."""
+        waited_ms = (time.perf_counter_ns() - p.t_enqueue) / 1e6
+        if reason == SHED_DEADLINE:
+            telemetry.count("serving.deadline_expired")
+        else:
+            telemetry.count("serving.shed")
+        if not p.future.done():
+            p.future.set_result(Shed(reason, queue_depth=self._q.qsize(),
+                                     waited_ms=waited_ms))
+        return p.future
+
+    def _expire(self, p: _Pending, now_ns: Optional[int] = None) -> bool:
+        """Shed ``p`` iff its deadline has passed (the batch-slot guard)."""
+        if not self.admission.expired(p, now_ns):
+            return False
+        self._shed(p, SHED_DEADLINE)
+        return True
+
     def _dispatch_loop(self) -> None:
         done = False
         while not done:
@@ -149,19 +299,22 @@ class MicroBatchDispatcher:
             if first is None:
                 done = True
                 # drain without waiting: everything already queued still
-                # scores (close() promises a flush, not an abort)
+                # resolves — scored, or shed if its deadline passed
+                # (close() promises no leaked futures, not an abort)
                 batch = []
                 while True:
                     try:
                         p = self._q.get_nowait()
                     except queue.Empty:
                         break
-                    if p is not None:
+                    if p is not None and not self._expire(p):
                         batch.append(p)
                 while batch:
                     self._flush(batch[:self.max_batch])
                     batch = batch[self.max_batch:]
                 break
+            if self._expire(first):
+                continue
             batch = [first]
             deadline = first.t_enqueue + self.max_delay_us * 1000
             while len(batch) < self.max_batch:
@@ -183,67 +336,23 @@ class MicroBatchDispatcher:
                 if p is None:
                     done = True
                     break
-                batch.append(p)
+                if not self._expire(p):
+                    batch.append(p)
             telemetry.gauge("serving.queue_depth", self._q.qsize())
             self._flush(batch)
         self._retire_q.put(None)
 
-    def _collate(self, batch: list, bucket: int) -> tuple:
-        """Stack + pad B requests into one full-rung argument set. Pad
-        rows are all-zero (features, offsets) with entity id = the zero
-        row — the offline driver's exact pad convention."""
-        B, n = bucket, len(batch)
-        offsets = np.zeros(B, np.float32)
-        for i, p in enumerate(batch):
-            offsets[i] = p.req.offset
-        shards = {}
-        for s, spec in self.ladder.shard_specs.items():
-            if spec.sparse_k is None:
-                X = np.zeros((B, spec.d), np.float32)
-                for i, p in enumerate(batch):
-                    X[i] = np.asarray(p.req.features[s], np.float32)
-                shards[s] = X
-            else:
-                k = spec.sparse_k
-                ind = np.zeros((B, k), np.int32)
-                val = np.zeros((B, k), np.float32)
-                for i, p in enumerate(batch):
-                    ri, rv = p.req.features[s]
-                    ri = np.asarray(ri, np.int32)
-                    if ri.shape[0] > k:
-                        raise ValueError(
-                            f"request row has {ri.shape[0]} nnz > shard "
-                            f"{s!r} sparse_k={k}")
-                    ind[i, :ri.shape[0]] = ri
-                    val[i, :ri.shape[0]] = np.asarray(rv, np.float32)
-                shards[s] = SparseRows(ind, val, spec.d)
-        ids = {}
-        misses = 0
-        for name, blk in self.store.random.items():
-            raw = [p.req.entities.get(blk.entity_name) for p in batch]
-            # absent key == unseen entity: both resolve to the zero row
-            keys = ["\x00missing\x00" if r is None else r for r in raw]
-            dense, n_miss = blk.lookup(keys)
-            col = np.full(B, blk.n_entities, np.int32)
-            col[:n] = dense
-            ids[name] = col
-            misses += n_miss
-        return offsets, shards, ids, misses
-
     def _flush(self, batch: list) -> None:
+        # last-chance deadline check: a request that expired while its
+        # batch assembled must not occupy a slot in the padded program
+        now = time.perf_counter_ns()
+        batch = [p for p in batch if not self._expire(p, now)]
         n = len(batch)
         if n == 0:
             return
         try:
             with telemetry.span("serving.flush", rows=n):
-                bucket = self.ladder.bucket_for(n)
-                # per-rung attribution: collate + dispatch wall (the
-                # device readback is the retire thread's, measured by
-                # the request-latency percentiles)
-                with profiling.measure(f"serving.rung_{bucket}", "flush"):
-                    offsets, shards, ids, misses = self._collate(batch,
-                                                                 bucket)
-                    out_dev = self.ladder.score_padded(offsets, shards, ids)
+                out_dev, bucket, misses = self._executor.execute(batch)
             telemetry.count("serving.requests", n)
             telemetry.count("serving.batches")
             telemetry.count("serving.batch_rows", n)

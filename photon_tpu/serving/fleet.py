@@ -1,0 +1,344 @@
+"""Replica fleet: the serving tier's scale-out plane.
+
+One `MicroBatchDispatcher` over one mmap store is a single process; the
+ROADMAP's "millions of entities" traffic needs the next layer — the
+reference's sharded-PalDB story taken to its conclusion. This module
+runs N dispatcher replicas, each over an ENTITY-RANGE shard of the
+`CoefficientStore` (the existing `data/index_map.py` machinery supplies
+both the full directory the router consults and each shard's local
+directory), with request hashing and retry/timeout/exponential-backoff
+failover riding `checkpoint.faults.retry_io`:
+
+- **Sharding** (`shard_store`): shard ``j`` of ``n`` holds every fixed
+  block (they are everyone's offset — small and read-only) plus the
+  contiguous dense-row range ``[j·E/n, (j+1)·E/n)`` of each random
+  block, re-rooted to a local `IndexMap`. An entity outside a shard's
+  range resolves to that shard's cold-miss zero row — the SAME graceful
+  fixed-effect-only degradation an unseen entity gets, which is what
+  makes failover answers degraded-but-CORRECT rather than wrong.
+- **Routing** (`ReplicaFleet.replica_for`): the request's first routed
+  entity key → dense id through the full directory → the owning range;
+  keyless/unseen requests hash (crc32) across replicas. Routing is pure
+  host arithmetic — the per-request device path stays the single-shard
+  rung program, pinned collective-free by the registered
+  ``serving_fleet_request_path`` contract.
+- **Failover** (`score`/`submit`): each attempt submits to a replica and
+  bounds the wait (``attempt_timeout_s``); a replica error, injected
+  kill, or timeout fails over to the next replica (mod N) under
+  `retry_io`'s bounded exponential backoff at the deterministic
+  ``replica_dispatch`` fault site. Together with the dispatcher's
+  ``rung_execute`` site and the store's ``store_open`` site, a kill
+  matrix can prove: every fault × first/middle/last occurrence leaves
+  zero hung futures, zero torn responses, and degraded-but-correct
+  answers (tests/test_serving_fleet.py, `python -m photon_tpu.serving
+  --selftest`).
+
+Counters (`serving.*` family): ``fleet_dispatches`` (successful replica
+answers), ``fleet_failovers`` (attempts beyond the primary),
+``fleet_degraded`` (answers served off a non-owning replica — the
+cold-miss fallback path).
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import telemetry
+from photon_tpu.checkpoint.faults import retry_io
+from photon_tpu.data.index_map import IndexMap
+from photon_tpu.serving.admission import AdmissionPolicy, Shed
+from photon_tpu.serving.dispatcher import MicroBatchDispatcher, ScoreRequest
+from photon_tpu.serving.programs import ProgramLadder
+from photon_tpu.serving.store import CoefficientStore, RandomBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """Failover knobs.
+
+    attempt_timeout_s: bound on one replica's answer before failing over
+        (covers queueing + dispatch + readback on that replica).
+    failover_retries: extra attempts beyond the primary (each on the
+        next replica, mod N).
+    base_delay_s/max_delay_s: `retry_io` exponential-backoff envelope
+        between attempts.
+    submit_workers: thread pool driving asynchronous `submit` calls.
+    """
+
+    attempt_timeout_s: float = 10.0
+    failover_retries: int = 2
+    base_delay_s: float = 0.005
+    max_delay_s: float = 0.1
+    submit_workers: int = 8
+
+
+def _directory_keys(directory) -> list:
+    if hasattr(directory, "keys_in_order"):
+        return list(directory.keys_in_order())
+    raise ValueError(
+        "entity-range sharding needs an enumerable directory "
+        "(IndexMap/PalDBIndexMap); rebuild the store with one")
+
+
+def shard_bounds(n_entities: int, n_shards: int) -> list:
+    """Contiguous balanced range bounds: shard j owns dense rows
+    ``[bounds[j], bounds[j+1])``."""
+    return [(j * n_entities) // n_shards for j in range(n_shards + 1)]
+
+
+def shard_store(store: CoefficientStore, n_shards: int) -> list:
+    """Split one CoefficientStore into ``n_shards`` entity-range shards.
+
+    Fixed blocks are shared by reference (read-only); each random block
+    is sliced to its range with a fresh zero cold-miss row and a local
+    `IndexMap` directory. The union of shards covers every entity
+    exactly once; any shard answers any request (out-of-range entities
+    degrade to the fixed-effect-only score)."""
+    n_shards = int(n_shards)
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    shards = []
+    for j in range(n_shards):
+        random: dict = {}
+        for name, blk in store.random.items():
+            keys = _directory_keys(blk.directory)
+            bounds = shard_bounds(blk.n_entities, n_shards)
+            lo, hi = bounds[j], bounds[j + 1]
+            C = np.zeros((hi - lo + 1, blk.dim), np.float32)
+            C[:-1] = np.asarray(blk.coefficients[lo:hi], np.float32)
+            local = IndexMap({keys[i]: i - lo for i in range(lo, hi)},
+                             frozen=True)
+            random[name] = RandomBlock(blk.feature_shard, blk.entity_name,
+                                      C, local)
+        shards.append(CoefficientStore(store.task, store.order,
+                                       dict(store.fixed), random))
+    return shards
+
+
+@dataclasses.dataclass
+class _Route:
+    """Router state for one random coordinate: the FULL directory plus
+    the range bounds that map a dense id to its owning replica."""
+
+    name: str
+    entity_name: str
+    block: RandomBlock  # the full (unsharded) block — host lookups only
+    bounds: list
+
+
+class Replica:
+    """One serving node: an entity-range shard behind its own ladder +
+    dispatcher."""
+
+    def __init__(self, index: int, store: CoefficientStore,
+                 ladder: ProgramLadder, dispatcher: MicroBatchDispatcher):
+        self.index = index
+        self.store = store
+        self.ladder = ladder
+        self.dispatcher = dispatcher
+
+    def dispatch(self, req: ScoreRequest, timeout: float):
+        """Submit + bounded wait on this replica (one failover attempt)."""
+        return self.dispatcher.submit(req).result(timeout=timeout)
+
+
+class ReplicaFleet:
+    """N dispatcher replicas over entity-range shards, with hashed
+    routing and retry/backoff failover. Build with
+    `ReplicaFleet.build(store, n)` (in-memory shards) or
+    `ReplicaFleet.open([dir, ...])` (saved shard stores — each open
+    rides the ``store_open`` retry site)."""
+
+    def __init__(self, replicas: list, routes: list,
+                 policy: Optional[FleetPolicy] = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = replicas
+        self.routes = routes
+        self.policy = policy or FleetPolicy()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.policy.submit_workers,
+            thread_name_prefix="serving-fleet")
+        self._closed = False
+        telemetry.gauge("serving.fleet_replicas", len(replicas))
+
+    # ------------------------------------------------------------ builders
+    @classmethod
+    def build(cls, store: CoefficientStore, n_replicas: int, *,
+              policy: Optional[FleetPolicy] = None,
+              admission: Optional[AdmissionPolicy] = None,
+              ladder_kwargs: Optional[dict] = None,
+              dispatcher_kwargs: Optional[dict] = None) -> "ReplicaFleet":
+        """Shard ``store`` into ``n_replicas`` ranges and spin one
+        ladder + dispatcher per shard (the router keeps the full store's
+        directories for range lookups — host memory only, never on a
+        device)."""
+        shards = shard_store(store, n_replicas)
+        lk = dict(ladder_kwargs or {})
+        dk = dict(dispatcher_kwargs or {})
+        replicas = []
+        for j, shard in enumerate(shards):
+            ladder = ProgramLadder(shard, **lk)
+            d = MicroBatchDispatcher(ladder, policy=admission, **dk)
+            replicas.append(Replica(j, shard, ladder, d))
+        routes = [
+            _Route(name, blk.entity_name, blk,
+                   shard_bounds(blk.n_entities, n_replicas))
+            for name, blk in store.random.items()]
+        return cls(replicas, routes, policy=policy)
+
+    @classmethod
+    def open(cls, shard_dirs: list, *, mmap: bool = True,
+             routing_store: Optional[CoefficientStore] = None,
+             policy: Optional[FleetPolicy] = None,
+             admission: Optional[AdmissionPolicy] = None,
+             ladder_kwargs: Optional[dict] = None,
+             dispatcher_kwargs: Optional[dict] = None) -> "ReplicaFleet":
+        """A fleet over saved per-shard store directories (each
+        `CoefficientStore.open` rides the ``store_open`` fault site, so
+        a flaky-FS open retries and an injected kill at any occurrence
+        dies cleanly before any replica thread starts). Routing uses
+        ``routing_store``'s full directories when given; otherwise
+        requests hash across replicas (every shard still answers —
+        out-of-range entities just serve the degraded path)."""
+        stores = [CoefficientStore.open(d, mmap=mmap) for d in shard_dirs]
+        lk = dict(ladder_kwargs or {})
+        dk = dict(dispatcher_kwargs or {})
+        replicas = []
+        for j, shard in enumerate(stores):
+            ladder = ProgramLadder(shard, **lk)
+            d = MicroBatchDispatcher(ladder, policy=admission, **dk)
+            replicas.append(Replica(j, shard, ladder, d))
+        routes = []
+        if routing_store is not None:
+            routes = [
+                _Route(name, blk.entity_name, blk,
+                       shard_bounds(blk.n_entities, len(stores)))
+                for name, blk in routing_store.random.items()]
+        return cls(replicas, routes, policy=policy)
+
+    # ------------------------------------------------------------- routing
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return zlib.crc32(key.encode("utf-8", "surrogateescape"))
+
+    def replica_for(self, req: ScoreRequest) -> int:
+        """The replica owning this request's first routed entity's range;
+        keyless or unseen-entity requests hash across the fleet (any
+        replica serves their fixed-effect-only score identically)."""
+        for route in self.routes:
+            raw = req.entities.get(route.entity_name)
+            if raw is None:
+                continue
+            ids, miss = route.block.lookup([raw])
+            if miss:
+                return self._hash(str(raw)) % self.n_replicas
+            return bisect.bisect_right(route.bounds, int(ids[0])) - 1
+        return self._hash(repr(sorted(req.entities.items()))) \
+            % self.n_replicas
+
+    # ------------------------------------------------------------- serving
+    def score(self, req: ScoreRequest, timeout: Optional[float] = None):
+        """Synchronous fleet scoring with failover: primary replica by
+        range, then next (mod N) on error/kill/timeout, backoff between
+        attempts (`retry_io`, site ``replica_dispatch``). Returns the
+        float score — or the replica's typed `Shed` under overload
+        policy (shedding is an ANSWER; it never fails over, an
+        overloaded fleet must not cascade)."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        primary = self.replica_for(req)
+        state = {"attempt": 0}
+        bound = self.policy.attempt_timeout_s if timeout is None else timeout
+
+        def attempt():
+            idx = (primary + state["attempt"]) % self.n_replicas
+            if state["attempt"]:
+                telemetry.count("serving.fleet_failovers")
+            state["attempt"] += 1
+            out = self.replicas[idx].dispatch(req, timeout=bound)
+            telemetry.count("serving.fleet_dispatches")
+            if idx != primary and not isinstance(out, Shed):
+                telemetry.count("serving.fleet_degraded")
+            return out
+
+        # InjectedFault is a RuntimeError: an injected replica death at
+        # any occurrence fails over exactly like a real one
+        return retry_io(attempt, site="replica_dispatch",
+                        retries=self.policy.failover_retries,
+                        base_delay=self.policy.base_delay_s,
+                        max_delay=self.policy.max_delay_s,
+                        retry_on=(OSError, FutureTimeout, RuntimeError))
+
+    def submit(self, req: ScoreRequest):
+        """Asynchronous fleet scoring: a Future resolving to the score
+        (or `Shed`), driven by the fleet's worker pool through the same
+        failover path as `score`."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        return self._pool.submit(self.score, req)
+
+    # ------------------------------------------------------------ lifecycle
+    def assert_no_retrace(self) -> int:
+        """Every replica's ladder holds its retrace bound; returns the
+        total distinct-signature count across the fleet."""
+        return sum(r.ladder.assert_no_retrace() for r in self.replicas)
+
+    def latency_stats(self) -> dict:
+        """Pooled request-latency percentiles across all replicas."""
+        lats: list = []
+        for r in self.replicas:
+            with r.dispatcher._lat_lock:
+                lats.extend(r.dispatcher._latencies_ns)
+        if not lats:
+            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None}
+        arr = np.asarray(lats, np.float64)
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99]) / 1e6
+        return {"n": int(arr.size), "p50_ms": float(p50),
+                "p95_ms": float(p95), "p99_ms": float(p99)}
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain the submit pool, then close every replica (each close
+        flushes its queue — every outstanding future resolves).
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)
+        for r in self.replicas:
+            r.dispatcher.close(timeout=timeout)
+
+
+# ----------------------------------------------------------------- contracts
+# The fleet's per-request device path IS the single-replica rung program:
+# routing and failover are host arithmetic, sharding only re-roots the
+# coefficient blocks. Pinned as law — zero collectives, zero host exits,
+# no f64 — on a ladder built over a SHARD (not the full store), so the
+# contract walks exactly what a fleet replica dispatches.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+
+
+@register_contract(
+    name="serving_fleet_request_path",
+    description="one fleet replica's rung program over an entity-range "
+                "shard: the per-request path stays collective-free / "
+                "host-exit-free / f64-free — routing and failover never "
+                "enter the device program",
+    collectives={}, tags=("serving",))
+def _contract_fleet_request_path():
+    from photon_tpu.serving.programs import _tiny_store
+
+    shards = shard_store(_tiny_store(), 2)
+    ladder = ProgramLadder(shards[0], ladder=(8,), sparse_k={"member": 3},
+                           output_mean=True)
+    return ladder._fn, ladder.example_args(8)

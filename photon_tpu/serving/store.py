@@ -205,7 +205,28 @@ class CoefficientStore:
     @classmethod
     def open(cls, out_dir, mmap: bool = True) -> "CoefficientStore":
         """Open a saved store; ``mmap=True`` maps every coefficient block
-        read-only instead of copying it into the heap."""
+        read-only instead of copying it into the heap.
+
+        The whole read rides `checkpoint.faults.retry_io` (site
+        ``store_open``, the `avro_open` precedent): a flaky-FS manifest
+        read or mmap open retries with bounded exponential backoff
+        instead of killing the serving process at startup. Opens are
+        pure reads, so a retry restarts the open idempotently; an
+        injected KILL at the site propagates (a replica that dies
+        opening its store never half-opens — the fleet's kill matrix
+        pins this)."""
+        from photon_tpu.checkpoint.faults import retry_io
+
+        if not os.path.exists(os.path.join(out_dir, _META_NAME)):
+            # no manifest = nothing published (or a killed save that never
+            # reached its commit point): a permanent condition, reported
+            # immediately rather than burning the retry budget on it
+            raise FileNotFoundError(
+                f"{os.path.join(out_dir, _META_NAME)}: no store manifest")
+        return retry_io(lambda: cls._open(out_dir, mmap), site="store_open")
+
+    @classmethod
+    def _open(cls, out_dir, mmap: bool) -> "CoefficientStore":
         with open(os.path.join(out_dir, _META_NAME)) as f:
             meta = json.load(f)
         if meta.get("format") != _FORMAT:

@@ -32,7 +32,13 @@ scorer, chunked_fit_points from the estimator, and pod_scale_runs from
 the training driver; the online serving tier's
 `serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses/
 hot_swaps counters (pad_waste is shared with the offline chunked scorer;
-hot_swaps counts `CoefficientStore.reload_coefficients` cutovers),
+hot_swaps counts `CoefficientStore.reload_coefficients` cutovers), the
+overload-round admission counters admitted/shed/deadline_expired
+(admitted = entered the queue; shed = watermark or bounded-submit
+drops; deadline_expired = admitted but dropped before a batch slot —
+each resolves its Future to a typed `serving.Shed`) and the replica
+fleet's fleet_dispatches/fleet_failovers/fleet_degraded counters with
+the fleet_replicas gauge,
 queue_depth/batch_fill/latency_p50_ms/latency_p95_ms/latency_p99_ms
 gauges, per-flush `serving.flush` spans, and one `serving_batch` event
 per dispatched micro-batch; the elastic-runs `checkpoint.*` family —
@@ -41,7 +47,8 @@ solver_restores/re_restores/descent_restores and gc_snapshots, with
 `checkpoint.pack`/`checkpoint.write` spans — and its `faults.*` sibling
 — injected_kills/injected_errors/io_retries/backoff_seconds — the
 continual-flywheel `continual.*` family — plans/touched_entities/
-new_entities_deferred counters from delta ingestion,
+deferred_new_keys counters from delta ingestion (deferred_new_keys also
+logs at INFO — the new-entity-admission breadcrumb),
 touched_buckets/skipped_buckets/refresh_solves/refresh_iterations/
 refreshes from the partial re-solve, probe_entities/swap_refusals from
 the parity-probed hot swap (the in-process cutover itself counts on

@@ -24,11 +24,12 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 37 specs (round 14 added the continual-flywheel pins: the
-    compacted prior warm-started refresh solve is collective-free, and
-    the delta path's fixed-chunk padding keeps dispatch signatures
-    constant across touched sets) spanning every workload family."""
-    assert len(_REGISTRY) >= 37
+    """≥ 39 specs (the overload round added the serving robustness pins:
+    the admission layer's program invariance — policy changes batch
+    membership, never the device program — and the replica fleet's
+    per-request shard path staying collective-free) spanning every
+    workload family."""
+    assert len(_REGISTRY) >= 39
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
@@ -168,6 +169,21 @@ def test_serving_request_specs_are_registered():
         spec = _REGISTRY[name]
         assert dict(spec.collectives or {}) == {}
         assert not spec.allow_transfers and not spec.allow_f64
+
+
+def test_serving_overload_specs_are_registered():
+    """The overload-round pins: the admission layer adds ZERO device-
+    program changes (its builder raises on any signature divergence
+    between admission on and off — traced by test_contract_holds), and
+    a fleet replica's per-request path over an entity-range shard stays
+    collective-free / host-exit-free / f64-free like the unsharded
+    program."""
+    for name in ("serving_admission_program_invariance",
+                 "serving_fleet_request_path"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}
+        assert not spec.allow_transfers and not spec.allow_f64
+        assert "serving" in spec.tags
 
 
 @pytest.mark.parametrize("name", sorted(_REGISTRY))

@@ -153,6 +153,41 @@ class TestDelta:
         assert int(cp.new_keys.shape[0]) == 1  # E + 3, unseen → deferred
         assert cp.n_touched_rows == 128
 
+    def test_new_key_deferral_is_counted_and_logged(self, world, caplog):
+        """new_keys deferral is no longer silent: the diff counts
+        ``continual.deferred_new_keys`` and says so at INFO with the
+        deferred-entity count (the ROADMAP new-entity-admission
+        breadcrumb starts from this signal)."""
+        import logging
+
+        from photon_tpu import telemetry
+
+        r = telemetry.start_run("deferral")
+        try:
+            with caplog.at_level(logging.INFO, logger="photon_tpu.continual"):
+                plan = continual.diff_manifest(world["manifest"],
+                                               world["drop"], world["prev"])
+        finally:
+            telemetry.finish_run()
+        assert r.counters["continual.deferred_new_keys"] == 1.0
+        msgs = [rec.getMessage() for rec in caplog.records
+                if rec.name == "photon_tpu.continual"]
+        assert any("deferring 1 new" in m and "'re'" in m for m in msgs), \
+            msgs
+        # a drop with NO new keys stays silent and uncounted
+        caplog.clear()
+        r2 = telemetry.start_run("no_deferral")
+        try:
+            with caplog.at_level(logging.INFO, logger="photon_tpu.continual"):
+                continual.diff_manifest(world["manifest"], world["data"],
+                                        world["prev"], full=True)
+        finally:
+            telemetry.finish_run()
+        assert "continual.deferred_new_keys" not in r2.counters
+        assert not [rec for rec in caplog.records
+                    if rec.name == "photon_tpu.continual"]
+        assert plan.coordinates["re"].n_touched > 0
+
     def test_full_drop_touches_changed_only(self, world):
         data = world["data"]
         # the full refreshed dataset = the original rows + 8 extra rows

@@ -200,6 +200,48 @@ class TestSentinel:
             shist)["refresh_e2e_speedup_vs_full_retrain"]
         assert collapsed.status == "regressed"
 
+    def test_serving_slo_leg_admission(self):
+        """The overload-round serving_slo legs as the sentinel sees them:
+        new legs admit without tripping the gate that merges them; the
+        direction map gates sustained QPS higher-better, p99 and shed
+        percentage LOWER-better (more shedding at the same offered rate
+        means the tier got slower); the SLO target is a chosen config
+        bar (excluded) and the bool verdict is skipped by type."""
+        verdicts = sentinel.gate(
+            {"serving_slo_sustained_qps": 6500.0,
+             "serving_slo_p99_ms": 9.0,
+             "serving_slo_overload_p99_ms": 130.0,
+             "serving_slo_overload_shed_pct": 55.0,
+             "serving_slo_target_ms": 50.0,
+             "dense_rate": 1e8},
+            _history())
+        for leg in ("serving_slo_sustained_qps", "serving_slo_p99_ms",
+                    "serving_slo_overload_p99_ms",
+                    "serving_slo_overload_shed_pct"):
+            assert verdicts[leg].status == "new", leg
+        assert "serving_slo_target_ms" not in verdicts  # config bar
+        assert verdicts["dense_rate"].status == "ok"
+        legs = sentinel.leg_values(
+            {"legs": {"serving_slo_ok": True,
+                      "serving_slo_sustained_qps": 6500.0}})
+        assert "serving_slo_ok" not in legs  # bool verdict, not a leg
+        # directions
+        assert not sentinel.lower_is_better("serving_slo_sustained_qps")
+        assert sentinel.lower_is_better("serving_slo_p99_ms")
+        assert sentinel.lower_is_better("serving_slo_overload_shed_pct")
+        # a sustained-QPS collapse regresses; shedding MORE at the same
+        # offered rate regresses; shedding less is an improvement
+        qhist = _history(leg="serving_slo_sustained_qps", base=6500.0)
+        assert sentinel.gate({"serving_slo_sustained_qps": 800.0}, qhist)[
+            "serving_slo_sustained_qps"].status == "regressed"
+        shist = _history(leg="serving_slo_overload_shed_pct", base=40.0)
+        assert sentinel.gate({"serving_slo_overload_shed_pct": 90.0},
+                             shist)["serving_slo_overload_shed_pct"
+                                    ].status == "regressed"
+        assert sentinel.gate({"serving_slo_overload_shed_pct": 5.0},
+                             shist)["serving_slo_overload_shed_pct"
+                                    ].status == "ok"
+
     def test_leg_values_flattens_headline_and_skips_dups(self):
         legs = sentinel.leg_values({
             "metric": "headline", "value": 2.0,

@@ -412,6 +412,166 @@ class TestDispatcherBehavior:
             d.close()
 
 
+# ------------------------------------------------------------ overload policy
+class TestAdmission:
+    """serving/admission.py: deadlines, watermark shedding, bounded
+    submit. The invariants: every dropped request resolves to a typed
+    `Shed` (futures never leak, callers never block forever), the
+    counters add up, and the policy layer never changes the device
+    programs (the live half of the registered
+    `serving_admission_program_invariance` contract)."""
+
+    def test_default_policy_is_off(self):
+        p = serving.AdmissionPolicy()
+        assert not p.active
+        ctrl = serving.AdmissionController(p)
+        assert ctrl.submit_shed_reason(10**9) is None
+        assert ctrl.deadline_ns(serving.ScoreRequest(features={}), 0) is None
+        assert ctrl.submit_timeout_s(None) is None  # legacy: block forever
+
+    def test_shed_is_typed_and_falsy(self):
+        s = serving.Shed("watermark", queue_depth=3)
+        assert not s and s.reason == "watermark"
+
+    def test_deadline_expired_resolves_shed(self, demo):
+        """deadline_ms=0.0 expires every request at its first batch-slot
+        check: the future resolves to Shed("deadline_expired"), counted,
+        and the batch dispatches WITHOUT them."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(8)
+        reqs, _, _ = _requests(rng, model, 5)
+        r = telemetry.start_run("admission_deadline")
+        d = serving.MicroBatchDispatcher(
+            ladder, max_batch=8, max_delay_us=500,
+            policy=serving.AdmissionPolicy(deadline_ms=0.0))
+        try:
+            res = [d.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d.close()
+            telemetry.finish_run()
+        assert all(isinstance(v, serving.Shed)
+                   and v.reason == "deadline_expired" for v in res)
+        assert r.counters["serving.deadline_expired"] == 5.0
+        assert r.counters["serving.admitted"] == 5.0
+        assert "serving.requests" not in r.counters  # nothing dispatched
+
+    def test_request_deadline_overrides_policy(self, demo):
+        """A per-request deadline_ms wins over the policy default: the
+        doomed request sheds, its batch-mates score."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(9)
+        reqs, data, _ = _requests(rng, model, 8)
+        reqs[2] = serving.ScoreRequest(
+            features=reqs[2].features, entities=reqs[2].entities,
+            offset=reqs[2].offset, deadline_ms=0.0)
+        d = serving.MicroBatchDispatcher(
+            ladder, max_batch=8, max_delay_us=50_000,
+            policy=serving.AdmissionPolicy(deadline_ms=10_000.0))
+        try:
+            res = [d.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d.close()
+        assert isinstance(res[2], serving.Shed)
+        assert res[2].reason == "deadline_expired"
+        alive = [i for i in range(8) if i != 2]
+        assert all(isinstance(res[i], float) for i in alive)
+        want = np.asarray(model.mean(score_game(model, data)), np.float32)
+        for i in alive:  # survivors land on rung 8: bit-parity territory
+            assert np.float32(res[i]) == want[i]
+
+    def test_watermark_sheds_at_submit(self, demo):
+        model, _, ladder = demo
+        rng = np.random.default_rng(10)
+        reqs, _, _ = _requests(rng, model, 6)
+        r = telemetry.start_run("admission_watermark")
+        d = serving.MicroBatchDispatcher(
+            ladder, max_batch=8, max_delay_us=500,
+            policy=serving.AdmissionPolicy(shed_watermark=0))
+        try:
+            res = [d.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d.close()
+            telemetry.finish_run()
+        assert all(isinstance(v, serving.Shed) and v.reason == "watermark"
+                   for v in res)
+        assert r.counters["serving.shed"] == 6.0
+        assert "serving.admitted" not in r.counters  # never enqueued
+
+    def test_bounded_submit_never_blocks_forever(self, demo):
+        """queue_depth=1 + submit(timeout=0): a full queue sheds
+        ("queue_full") instead of blocking; every future resolves to a
+        float or a typed Shed and the accounting closes."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(12)
+        reqs, _, _ = _requests(rng, model, 200)
+        r = telemetry.start_run("admission_bounded")
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=100, queue_depth=1)
+        try:
+            futs = [d.submit(q, timeout=0.0) for q in reqs]
+            res = [f.result(timeout=60) for f in futs]
+        finally:
+            d.close()
+            telemetry.finish_run()
+        sheds = [v for v in res if isinstance(v, serving.Shed)]
+        scored = [v for v in res if isinstance(v, float)]
+        assert len(sheds) + len(scored) == 200
+        assert sheds and all(s.reason == "queue_full" for s in sheds)
+        assert r.counters["serving.shed"] == float(len(sheds))
+        assert r.counters["serving.admitted"] == float(len(scored))
+
+    def test_close_resolves_expired_inflight_futures(self, demo):
+        """THE close() guarantee with overload policy armed: requests
+        whose deadline expired while batched-but-undispatched resolve at
+        close (shed, never leaked) — the dispatcher holds them in its
+        assembly loop (max_delay 10 s, batch unfilled) until close
+        flushes, and the flush-time deadline check sheds them all."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(13)
+        reqs, _, _ = _requests(rng, model, 6)
+        r = telemetry.start_run("admission_close")
+        d = serving.MicroBatchDispatcher(
+            ladder, max_batch=8, max_delay_us=10_000_000,
+            policy=serving.AdmissionPolicy(deadline_ms=100.0))
+        futs = [d.submit(q) for q in reqs]
+        import time as _time
+
+        _time.sleep(0.15)  # all six expire while awaiting batch-mates
+        d.close()
+        telemetry.finish_run()
+        assert all(f.done() for f in futs)  # nothing leaked
+        res = [f.result(timeout=1) for f in futs]
+        assert all(isinstance(v, serving.Shed)
+                   and v.reason == "deadline_expired" for v in res)
+        assert r.counters["serving.deadline_expired"] == 6.0
+
+    def test_admission_on_off_never_retraces(self, demo):
+        """The same ladder serves admission-off and admission-on traffic
+        with zero new signatures — the live face of the registered
+        program-invariance contract."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(14)
+        reqs, _, _ = _requests(rng, model, 8)
+        before = len(ladder.signature_log.signatures("serving.score"))
+        d_off = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                             max_delay_us=50_000)
+        try:
+            off = [d_off.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d_off.close()
+        d_on = serving.MicroBatchDispatcher(
+            ladder, max_batch=8, max_delay_us=50_000,
+            policy=serving.AdmissionPolicy(deadline_ms=10_000.0,
+                                           shed_watermark=1 << 20,
+                                           submit_timeout_s=5.0))
+        try:
+            on = [d_on.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d_on.close()
+        assert off == on  # same model, same rows, same programs
+        assert ladder.assert_no_retrace() >= before
+
+
 # ------------------------------------------------------- hot-swap concurrency
 class TestHotSwapConcurrency:
     """`CoefficientStore.reload_coefficients` under an in-flight
@@ -488,6 +648,58 @@ class TestHotSwapConcurrency:
                     "a torn coefficient generation")
         assert run.counters.get("serving.hot_swaps") == n_swaps
         ladder.assert_no_retrace()  # swaps never retrace the rungs
+
+    def test_mid_swap_kill_under_load_keeps_old_model(self, tmp_path):
+        """The continual flywheel's crash story under LIVE dispatcher
+        load: a kill at the ``swap_publish`` fault site (after the new
+        version directory is written, before the CURRENT pointer commits)
+        aborts the hot swap with every in-flight request still resolving
+        — all on the OLD model, bit-identically — and nothing published.
+        The next clean swap then cuts the same traffic over to the new
+        model."""
+        from photon_tpu import checkpoint, continual
+
+        model_a, _ = build_demo_model(seed=7)
+        model_b, _ = build_demo_model(seed=21)
+        store_b = serving.CoefficientStore.from_game_model(model_b)
+        rng = np.random.default_rng(17)
+        reqs, _, _ = _requests(rng, model_a, 32)
+        ref_a = self._scores(serving.CoefficientStore.from_game_model(
+            model_a), reqs)
+        ref_b = self._scores(store_b, reqs)
+        assert (ref_a != ref_b).any()
+
+        root = str(tmp_path / "pub")
+        live = serving.CoefficientStore.from_game_model(model_a)
+        ladder = serving.ProgramLadder(live, ladder=(8, 16),
+                                       sparse_k={"member": SPARSE_K},
+                                       output_mean=True)
+        d = serving.MicroBatchDispatcher(ladder, max_delay_us=100)
+        try:
+            futs = [d.submit(r) for r in reqs]  # sustained in-flight load
+            with pytest.raises(checkpoint.InjectedFault):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at("swap_publish", 1)):
+                    continual.hot_swap(live, store_b, root=root,
+                                       probe=continual.ParityProbe(
+                                           bound=1e9))
+            got = np.asarray([f.result(timeout=60) for f in futs])
+            # the killed swap never reloaded: everything served OLD
+            np.testing.assert_array_equal(got, ref_a)
+            from photon_tpu.continual.swap import current_version
+
+            assert current_version(root) is None  # nothing published
+            # the half-written version directory from the kill is swept
+            # by the next successful publish, which also cuts over
+            continual.hot_swap(live, store_b, root=root,
+                               probe=continual.ParityProbe(bound=1e9))
+            assert current_version(root) is not None
+            futs2 = [d.submit(r) for r in reqs]
+            got2 = np.asarray([f.result(timeout=60) for f in futs2])
+            np.testing.assert_array_equal(got2, ref_b)
+        finally:
+            d.close()
+        ladder.assert_no_retrace()  # neither kill nor swap retraced
 
     def test_reload_still_rejects_mismatched_shapes(self):
         model, _ = build_demo_model(seed=7)

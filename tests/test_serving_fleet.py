@@ -1,0 +1,328 @@
+"""Replica fleet (photon_tpu/serving/fleet.py): entity-range sharding
+over the index-map machinery, hashed range routing, retry/backoff
+failover — and THE robustness acceptance: the kill matrix over the new
+serving fault sites (``replica_dispatch``, ``rung_execute``,
+``store_open``) × first/middle/last occurrence leaves zero hung futures,
+zero torn responses, and degraded-but-correct answers (the cold-miss
+fixed-effect-only fallback).
+
+Marked `release_programs`: each fleet replica compiles its rung once;
+teardown drops them (tests/conftest.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu import checkpoint, serving, telemetry
+from photon_tpu.serving.__main__ import build_demo_model
+
+pytestmark = pytest.mark.release_programs
+
+SPARSE_K = 3
+
+# one fast-failover policy for the whole module (backoff in the ms range:
+# the injected faults are deterministic, the waits pure overhead)
+FAST = serving.FleetPolicy(attempt_timeout_s=30.0, failover_retries=2,
+                           base_delay_s=0.001, max_delay_s=0.01)
+LK = dict(ladder=(8,), sparse_k={"member": SPARSE_K}, output_mean=True)
+DK = dict(max_batch=8, max_delay_us=200)
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    yield
+    telemetry.finish_run()
+
+
+@pytest.fixture(scope="module")
+def rig():
+    """(model, full store, fleet, requests, clean refs, fixed-only refs):
+    one 2-replica fleet for the whole module — two rung-8 programs total.
+
+    The reference scores come through the fleet itself on a clean run;
+    `fixed_only` re-scores the same feature rows under an unseen entity
+    (the degraded answer a non-owning replica must produce)."""
+    model, _ = build_demo_model(seed=7)
+    store = serving.CoefficientStore.from_game_model(model)
+    fleet = serving.ReplicaFleet.build(store, 2, policy=FAST,
+                                       ladder_kwargs=LK,
+                                       dispatcher_kwargs=DK)
+    rng = np.random.default_rng(3)
+    xg = rng.normal(size=(8, 6)).astype(np.float32)
+    ind = rng.integers(0, 4, size=(8, SPARSE_K)).astype(np.int32)
+    val = rng.normal(size=(8, SPARSE_K)).astype(np.float32)
+
+    def req(i, ent):
+        return serving.ScoreRequest(
+            features={"global": xg[i], "member": (ind[i], val[i])},
+            entities={"memberId": ent})
+
+    reqs = [req(i, f"e{(2 * i) % 16:03d}") for i in range(8)]  # both ranges
+    clean = [fleet.score(q) for q in reqs]
+    fixed_only = [fleet.score(req(i, "zz-unseen")) for i in range(8)]
+    assert any(c != f for c, f in zip(clean, fixed_only))
+    yield model, store, fleet, reqs, clean, fixed_only
+    fleet.close()
+
+
+# ------------------------------------------------------------------ sharding
+class TestShardStore:
+    def test_ranges_partition_the_entity_space(self, rig):
+        model, store, _, _, _, _ = rig
+        shards = serving.shard_store(store, 3)
+        E = store.random["perEntity"].n_entities
+        seen: dict = {}
+        for j, s in enumerate(shards):
+            blk = s.random["perEntity"]
+            for k in blk.directory.keys_in_order():
+                assert k not in seen, f"{k} owned by shards {seen[k]},{j}"
+                seen[k] = j
+        assert len(seen) == E  # the union covers everything exactly once
+        # shard coefficient rows match the full store's, row for row
+        full = np.asarray(store.random["perEntity"].coefficients)
+        for s in shards:
+            blk = s.random["perEntity"]
+            for k in blk.directory.keys_in_order():
+                i_local = blk.directory.get(k)
+                i_full, miss = store.random["perEntity"].lookup([k])
+                assert not miss
+                np.testing.assert_array_equal(
+                    np.asarray(blk.coefficients)[i_local],
+                    full[int(i_full[0])])
+            # the local cold-miss row stays all-zero
+            assert (np.asarray(blk.coefficients)[-1] == 0).all()
+
+    def test_out_of_range_entity_degrades_to_zero_row(self, rig):
+        _, store, _, _, _, _ = rig
+        shards = serving.shard_store(store, 2)
+        # e015 lives in the upper range: shard 0 must cold-miss it
+        ids, miss = shards[0].random["perEntity"].lookup(["e015"])
+        assert miss == 1
+        assert ids[0] == shards[0].random["perEntity"].n_entities
+        ids1, miss1 = shards[1].random["perEntity"].lookup(["e015"])
+        assert miss1 == 0
+
+    def test_more_shards_than_entities_is_fine(self, rig):
+        _, store, _, _, _, _ = rig
+        tiny, _ = build_demo_model(seed=1, n_entities=2)
+        tstore = serving.CoefficientStore.from_game_model(tiny)
+        shards = serving.shard_store(tstore, 4)
+        owned = sum(s.random["perEntity"].n_entities for s in shards)
+        assert owned == 2  # empty shards carry just the zero row
+
+    def test_rejects_bad_shard_count(self, rig):
+        _, store, _, _, _, _ = rig
+        with pytest.raises(ValueError, match="n_shards"):
+            serving.shard_store(store, 0)
+
+
+# ------------------------------------------------------------------- routing
+class TestRouting:
+    def test_entities_route_to_their_owning_range(self, rig):
+        model, store, fleet, _, _, _ = rig
+        bounds = serving.fleet.shard_bounds(16, 2)
+        for i in range(16):
+            q = serving.ScoreRequest(features={},
+                                     entities={"memberId": f"e{i:03d}"})
+            want = 0 if i < bounds[1] else 1
+            assert fleet.replica_for(q) == want
+            # ... and the routed replica actually OWNS the entity
+            rep = fleet.replicas[fleet.replica_for(q)]
+            _, miss = rep.store.random["perEntity"].lookup([f"e{i:03d}"])
+            assert miss == 0
+
+    def test_unseen_and_keyless_requests_hash_deterministically(self, rig):
+        _, _, fleet, _, _, _ = rig
+        q1 = serving.ScoreRequest(features={},
+                                  entities={"memberId": "never-seen"})
+        q2 = serving.ScoreRequest(features={}, entities={})
+        assert fleet.replica_for(q1) == fleet.replica_for(q1)
+        assert fleet.replica_for(q2) == fleet.replica_for(q2)
+        assert 0 <= fleet.replica_for(q1) < 2
+        assert 0 <= fleet.replica_for(q2) < 2
+
+
+# ------------------------------------------------- failover + the kill matrix
+class TestFleetServing:
+    def test_clean_scores_are_exact(self, rig):
+        """Routing sends every entity to its owning shard, so a healthy
+        fleet is bit-identical to the unsharded dispatcher path (the
+        demo-model parity the single-replica tests already pin)."""
+        model, store, fleet, reqs, clean, _ = rig
+        ladder = serving.ProgramLadder(store, **LK)
+        d = serving.MicroBatchDispatcher(ladder, **DK)
+        try:
+            want = [d.submit(q).result(timeout=30) for q in reqs]
+        finally:
+            d.close()
+        assert clean == want
+
+    def test_async_submit_resolves(self, rig):
+        _, _, fleet, reqs, clean, _ = rig
+        futs = [fleet.submit(q) for q in reqs]
+        got = [f.result(timeout=60) for f in futs]
+        assert got == clean
+
+    def test_kill_matrix_no_hangs_no_torn_responses(self, rig):
+        """THE acceptance: for every new serving fault site ×
+        first/middle/last occurrence, every request resolves (zero hung
+        futures) to either its exact score or the degraded-but-correct
+        fixed-effect-only fallback (zero torn responses), and the fleet
+        keeps serving afterwards."""
+        _, _, fleet, reqs, clean, fixed_only = rig
+        with checkpoint.record_sites() as rec:
+            dry = [fleet.score(q) for q in reqs]
+        assert dry == clean  # the recorder injects nothing
+        for site in ("replica_dispatch", "rung_execute"):
+            total = rec.hits[site]
+            assert total >= len(reqs)
+            for occ in sorted({1, total // 2, total}):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at(site, occ)):
+                    got = [fleet.score(q, timeout=30) for q in reqs]
+                for i, (g, c, f) in enumerate(zip(got, clean, fixed_only)):
+                    assert g == c or g == f, (
+                        f"kill {site}@{occ}: request {i} scored {g!r} — "
+                        f"neither exact {c!r} nor degraded {f!r} (torn)")
+        # disarmed again: back to exact
+        assert [fleet.score(q) for q in reqs] == clean
+
+    def test_rung_execute_kill_serves_degraded_and_counts(self, rig):
+        """A replica dying mid-execution fails over to a NON-owning
+        replica: the answer is the cold-miss fixed-effect-only score —
+        degraded, correct, counted on serving.fleet_degraded/failovers."""
+        _, _, fleet, reqs, clean, fixed_only = rig
+        r = telemetry.start_run("fleet_kill")
+        with checkpoint.fault_plan(
+                checkpoint.FaultPlan.kill_at("rung_execute", 1)):
+            got = fleet.score(reqs[0], timeout=30)
+        telemetry.finish_run()
+        assert got == fixed_only[0] and got != clean[0]
+        assert r.counters["serving.fleet_failovers"] == 1.0
+        assert r.counters["serving.fleet_degraded"] == 1.0
+
+    def test_transient_errors_retry_with_backoff(self, rig):
+        """errors at the replica_dispatch site: the first two attempts
+        fail, the third answers — io_retries/backoff counted, the answer
+        still exact-or-degraded."""
+        _, _, fleet, reqs, clean, fixed_only = rig
+        r = telemetry.start_run("fleet_retry")
+        with checkpoint.fault_plan(
+                checkpoint.FaultPlan(errors={"replica_dispatch": 2})):
+            got = fleet.score(reqs[0], timeout=30)
+        telemetry.finish_run()
+        assert got == clean[0] or got == fixed_only[0]
+        assert r.counters["faults.io_retries"] == 2.0
+        assert r.counters["faults.io_retries.replica_dispatch"] == 2.0
+        assert r.counters["faults.backoff_seconds"] > 0
+
+    def test_exhausted_failover_reraises(self, rig):
+        """More consecutive kills than the retry budget: the final
+        failure surfaces (bounded retry, never an infinite loop) and the
+        fleet still serves afterwards."""
+        _, _, fleet, reqs, clean, _ = rig
+        n_kill = FAST.failover_retries + 1
+        with checkpoint.fault_plan(checkpoint.FaultPlan(
+                errors={"replica_dispatch": 10_000})):
+            with pytest.raises(OSError):
+                fleet.score(reqs[0], timeout=30)
+        assert n_kill >= 1
+        assert fleet.score(reqs[0]) == clean[0]
+
+    def test_no_retrace_across_the_whole_module(self, rig):
+        """Kills, failovers, and retries never retrace a replica rung."""
+        _, _, fleet, _, _, _ = rig
+        assert fleet.assert_no_retrace() <= sum(
+            len(rep.ladder.ladder) for rep in fleet.replicas)
+
+    def test_shed_is_an_answer_not_a_failover(self, rig):
+        """A replica shedding under overload policy must NOT cascade the
+        request onto other replicas — shedding is load control."""
+        model, store, _, reqs, _, _ = rig
+        fleet = serving.ReplicaFleet.build(
+            store, 2, policy=FAST, ladder_kwargs=LK, dispatcher_kwargs=DK,
+            admission=serving.AdmissionPolicy(shed_watermark=0))
+        r = telemetry.start_run("fleet_shed")
+        try:
+            got = fleet.score(reqs[0], timeout=30)
+        finally:
+            fleet.close()
+            telemetry.finish_run()
+        assert isinstance(got, serving.Shed)
+        assert "serving.fleet_failovers" not in r.counters
+
+    def test_closed_fleet_rejects(self, rig):
+        model, store, _, reqs, _, _ = rig
+        fleet = serving.ReplicaFleet.build(store, 2, policy=FAST,
+                                           ladder_kwargs=LK,
+                                           dispatcher_kwargs=DK)
+        fleet.close()
+        fleet.close()  # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            fleet.score(reqs[0])
+        with pytest.raises(RuntimeError, match="closed"):
+            fleet.submit(reqs[0])
+
+
+# ------------------------------------------------------- store_open fault site
+class TestStoreOpenFaults:
+    def test_transient_open_errors_retry(self, rig, tmp_path):
+        _, store, _, _, _, _ = rig
+        sdir = tmp_path / "shard0"
+        serving.shard_store(store, 2)[0].save(sdir)
+        r = telemetry.start_run("store_open_retry")
+        with checkpoint.fault_plan(
+                checkpoint.FaultPlan(errors={"store_open": 2})):
+            back = serving.CoefficientStore.open(sdir, mmap=False)
+        telemetry.finish_run()
+        assert back.order == store.order
+        assert r.counters["faults.io_retries.store_open"] == 2.0
+
+    def test_kill_at_every_occurrence_dies_clean_reopens_clean(
+            self, rig, tmp_path):
+        """Kills at the store_open site (fleet startup from saved shard
+        dirs): first/middle/last occurrence each aborts the open with
+        nothing half-built, and an immediate clean retry serves."""
+        _, store, _, _, _, _ = rig
+        dirs = []
+        for j, s in enumerate(serving.shard_store(store, 2)):
+            d = tmp_path / f"s{j}"
+            s.save(d)
+            dirs.append(str(d))
+        with checkpoint.record_sites() as rec:
+            fleet = serving.ReplicaFleet.open(
+                dirs, mmap=False, routing_store=store, policy=FAST,
+                ladder_kwargs=LK, dispatcher_kwargs=DK)
+            fleet.close()
+        total = rec.hits["store_open"]
+        assert total == 2  # one per shard dir
+        for occ in sorted({1, max(total // 2, 1), total}):
+            with pytest.raises(checkpoint.InjectedFault):
+                with checkpoint.fault_plan(
+                        checkpoint.FaultPlan.kill_at("store_open", occ)):
+                    serving.ReplicaFleet.open(
+                        dirs, mmap=False, routing_store=store, policy=FAST,
+                        ladder_kwargs=LK, dispatcher_kwargs=DK)
+        fleet = serving.ReplicaFleet.open(
+            dirs, mmap=False, routing_store=store, policy=FAST,
+            ladder_kwargs=LK, dispatcher_kwargs=DK)
+        try:
+            q = serving.ScoreRequest(
+                features={"global": np.ones(6, np.float32),
+                          "member": (np.zeros(1, np.int32),
+                                     np.zeros(1, np.float32))},
+                entities={"memberId": "e003"})
+            assert isinstance(fleet.score(q, timeout=30), float)
+        finally:
+            fleet.close()
+
+    def test_missing_manifest_fails_fast_without_retry_burn(self, tmp_path):
+        """No manifest = permanent, not transient: FileNotFoundError
+        surfaces immediately instead of spending the backoff budget."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with pytest.raises(FileNotFoundError, match="manifest"):
+            serving.CoefficientStore.open(tmp_path / "nothing")
+        assert _time.perf_counter() - t0 < 0.2
