@@ -899,6 +899,116 @@ def run_checkpoint_overhead(chunk_rows: int = 1 << 16,
     }
 
 
+# --- ingest data plane leg (round 14): decode-once vs cold Avro -----------
+# The cold leg decodes a real Avro container through the sharded worker
+# pool (data/ingest_plane.py) while committing the columnar chunk cache;
+# the cached leg re-opens the SAME dataset from the mmap'd cache (Avro
+# untouched — the decode-once regime every epoch after the first pays).
+# Acceptance: cached >= 5x cold on this container. The stall leg runs the
+# streamed solve's chunk stream under the stall-driven AdaptivePrefetch
+# controller and reports the upload-stall share of the pass wall — the
+# telemetry-proven "stalled_passes -> ~0" claim in PERF.md round 14.
+ING_ROWS = 60_000
+ING_NNZ = 8
+ING_FILES = 2
+ING_CHUNK_ROWS = 1 << 13
+ING_SPARSE_K = ING_NNZ + 1
+ING_WORKERS = 2
+
+
+def ingest_problem(seed: int = 0):
+    """(avro dir, GameDataConfig, IngestScan) — a wide sparse bag + an
+    entity column, written as real deflate containers."""
+    import tempfile
+
+    from photon_tpu.data.avro_io import write_avro
+    from photon_tpu.data.feature_bags import FeatureShardConfig
+    from photon_tpu.data.ingest import (GameDataConfig,
+                                        training_example_schema)
+    from photon_tpu.data.streaming import scan_ingest
+
+    rng = np.random.default_rng(seed)
+    root = tempfile.mkdtemp(prefix="photon_ingest_bench_")
+    schema = training_example_schema(feature_bags=("features",),
+                                     entity_fields=("memberId",))
+    per_file = ING_ROWS // ING_FILES
+    for fi in range(ING_FILES):
+        names = rng.integers(0, 50_000, size=(per_file, ING_NNZ))
+        vals = rng.normal(size=(per_file, ING_NNZ))
+        records = [{
+            "response": float(rng.integers(0, 2)),
+            "offset": None, "weight": None, "uid": str(i),
+            "memberId": f"m{rng.integers(0, 5000)}",
+            "features": [
+                {"name": f"f{names[i, j]}", "term": "",
+                 "value": float(vals[i, j])} for j in range(ING_NNZ)],
+        } for i in range(per_file)]
+        write_avro(os.path.join(root, f"part-{fi:03d}.avro"), records,
+                   schema, block_records=2048)
+    config = GameDataConfig(
+        shards={"features": FeatureShardConfig(bags=("features",),
+                                               has_intercept=True,
+                                               dense_threshold=64)},
+        entity_fields=("memberId",))
+    return root, config, scan_ingest(root, config)
+
+
+def run_ingest(problem) -> dict:
+    """{cold_rows_per_sec, cached_rows_per_sec, cached_over_cold,
+    upload_stall_pct, stalled_passes} — see the leg comment above."""
+    import shutil
+    import tempfile
+
+    from photon_tpu import telemetry
+    from photon_tpu.data.ingest_plane import (AdaptivePrefetch,
+                                              open_chunk_source)
+
+    root, config, scan = problem
+    cache_dir = tempfile.mkdtemp(prefix="photon_ingest_cache_")
+
+    def one_pass(cache):
+        t0 = time.perf_counter()
+        _, chunks = open_chunk_source(
+            root, config, scan.index_maps, chunk_rows=ING_CHUNK_ROWS,
+            sparse_k=ING_SPARSE_K, workers=ING_WORKERS, cache_dir=cache,
+            block_index=scan.block_index)
+        rows = sum(c.n for c in chunks)
+        return rows, time.perf_counter() - t0
+
+    # cold epoch: worker-pool decode + cache build (what a first run pays)
+    rows, cold_s = one_pass(cache_dir)
+    # cached epochs: mmap open, Avro untouched; best-of like every leg
+    best_cached = float("inf")
+    for _ in range(REPS):
+        r2, dt = one_pass(cache_dir)
+        assert r2 == rows
+        best_cached = min(best_cached, dt)
+    shutil.rmtree(cache_dir, ignore_errors=True)
+
+    # upload-stall share of a streamed pass under the adaptive controller:
+    # the same host-chunked stream the streamed solvers ride, a trivial
+    # per-chunk consumer, stall/(stall+compute) from the run's counters.
+    cb, _ = _streamed_problem(1 << 16)
+    ctl = AdaptivePrefetch()
+    run = telemetry.start_run("ingest_stall")
+    for _ in range(4):
+        for _, b in cb.iter_device(prefetch=ctl):
+            jax.block_until_ready(b.y)
+    telemetry.finish_run()
+    stall = float(run.counters.get("stream.stall_seconds", 0.0))
+    compute = float(run.counters.get("stream.compute_seconds", 0.0))
+    stalled = int(run.counters.get("stream.stalled_passes", 0))
+    return {
+        "rows": rows,
+        "cold_rows_per_sec": rows / cold_s,
+        "cached_rows_per_sec": rows / best_cached,
+        "cached_over_cold": cold_s / best_cached,
+        "upload_stall_pct": 100.0 * stall / max(stall + compute, 1e-9),
+        "stalled_passes": stalled,
+        "prefetch_depth_final": int(ctl.depth),
+    }
+
+
 def run_dense(batch, grid_weights) -> float:
     cfg = OptimizerConfig(max_iters=D_ITERS, tolerance=0.0, reg=l2(),
                           reg_weight=0.0)
@@ -965,6 +1075,10 @@ def main() -> None:
         ck_stats = run_checkpoint_overhead(baseline_rate=streamed_value)
     with telemetry.span("leg.streamed_mesh"):
         streamed_mesh_value, streamed_mesh_chips = run_streamed_mesh()
+    with telemetry.span("leg.ingest_data"):
+        ing_problem = ingest_problem()
+    with telemetry.span("leg.ingest_throughput"):
+        ing_stats = run_ingest(ing_problem)
     with telemetry.span("leg.game_re_data"):
         gr_ds, gr_rows = game_re_problem()
     with telemetry.span("leg.game_re_sequential"):
@@ -1039,6 +1153,21 @@ def main() -> None:
             "streamed_mesh_n_chips": streamed_mesh_chips,
             "streamed_mesh_vs_baseline": round(streamed_mesh_value / base,
                                                3),
+            # ingest data plane (round 14): cold worker-pool Avro decode
+            # (incl. the cache build) vs the decode-once mmap'd cache —
+            # acceptance cached_over_cold >= 5 — plus the stall-driven
+            # prefetch's upload-stall share of a streamed pass ("stall" in
+            # the name gates it LOWER-better; stalled_passes is the
+            # telemetry-proven ~0 claim)
+            "ingest_throughput_cold_rows_per_sec":
+                round(ing_stats["cold_rows_per_sec"], 1),
+            "ingest_throughput_cached_rows_per_sec":
+                round(ing_stats["cached_rows_per_sec"], 1),
+            "ingest_throughput_cached_over_cold":
+                round(ing_stats["cached_over_cold"], 2),
+            "ingest_throughput_upload_stall_pct":
+                round(ing_stats["upload_stall_pct"], 2),
+            "ingest_stalled_passes": ing_stats["stalled_passes"],
             # GAME random-effect regime (round 8): skewed entity sizes +
             # ill-conditioned stragglers; pipelined = double-buffered block
             # loop + compacted straggler re-solve, sequential = the

@@ -106,6 +106,12 @@ def main() -> None:
                         "dispatcher; QPS + p50/p95/p99 latency, with the "
                         "never-retraces assertion) and print its JSON "
                         "line")
+    p.add_argument("--ingest-leg", action="store_true",
+                   help="also run bench.py's ingest_throughput leg (cold "
+                        "worker-pool Avro decode + cache build vs the "
+                        "decode-once mmap'd chunk cache, plus the "
+                        "stall-driven prefetch's upload-stall share of a "
+                        "streamed pass) and print its JSON line")
     p.add_argument("--serving-slo-leg", action="store_true",
                    help="also run bench.py's open-loop serving_slo leg "
                         "(fixed arrival-rate sweep with the admission "
@@ -276,6 +282,20 @@ def main() -> None:
             "snapshots": ck["snapshots"],
             "snapshot_bytes_per_sec":
                 round(ck["snapshot_bytes_per_sec"], 1)}), flush=True)
+
+    if args.ingest_leg:
+        # bench.py's ingest_throughput leg verbatim: the round-14 data
+        # plane measured beside the flagship run it feeds.
+        import bench
+
+        ing = bench.run_ingest(bench.ingest_problem())
+        print(json.dumps({
+            "leg": "ingest_throughput",
+            "cold_rows_per_sec": round(ing["cold_rows_per_sec"], 1),
+            "cached_rows_per_sec": round(ing["cached_rows_per_sec"], 1),
+            "cached_over_cold": round(ing["cached_over_cold"], 2),
+            "upload_stall_pct": round(ing["upload_stall_pct"], 2),
+            "stalled_passes": ing["stalled_passes"]}), flush=True)
 
     if args.serving_leg or args.serving_slo_leg:
         # bench.py's serving legs verbatim: the online-scoring regime

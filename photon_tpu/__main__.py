@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the seven per-package selftests as subprocesses (each CLI
+Runs the eight per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -31,6 +31,14 @@ and one crashed subsystem cannot take the others down):
                    parity-probed atomic hot-swap with kill-mid-swap
                    falling back to the old model, and both continual
                    contracts
+- ``ingest``     — `--selftest`: the round-14 ingest data plane —
+                   one-pass scan, worker-pool decode parity (incl.
+                   worker-kill degrade), decode-once chunk cache
+                   (cold==cached bitwise, torn-commit fallback, CRC
+                   corruption detection, key invalidation), the
+                   blocked-ELL ladder cache round-trip, the
+                   stall-driven prefetch controller, and the
+                   chunk-program-invariance contract
 
 Exit status: 0 iff every suite passed; the summary line names each
 suite's verdict so a red CI run says WHICH plane drifted.
@@ -51,6 +59,7 @@ SUITES: tuple = (
     ("profiling", ("photon_tpu.profiling", "--selftest", "--json")),
     ("game", ("photon_tpu.game", "--selftest", "--json")),
     ("continual", ("photon_tpu.continual", "--selftest", "--json")),
+    ("ingest", ("photon_tpu.ingest", "--selftest", "--json")),
 )
 
 

@@ -7,9 +7,11 @@ GAME block pipeline, snapshot writers) have no lineage to replay, so this
 module supplies the two halves explicitly:
 
 - **kill points** — named sites on the hot paths (``chunk_upload``,
-  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``, and
-  the serving tier's ``rung_execute``/``replica_dispatch``/``store_open``
-  — docs/SERVING.md "Overload semantics") where
+  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``, the
+  serving tier's ``rung_execute``/``replica_dispatch``/``store_open``
+  — docs/SERVING.md "Overload semantics" — and the ingest plane's
+  ``ingest_worker``/``cache_open``/``cache_commit`` — docs/INGEST.md
+  "Crash semantics") where
   an armed :class:`FaultPlan` raises :class:`InjectedFault` at a chosen
   occurrence, simulating a preemption at exactly that moment. Sites are
   DETERMINISTIC: the n-th hit of a site is the same program point on every

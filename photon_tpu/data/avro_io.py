@@ -324,14 +324,33 @@ class AvroContainerReader:
             self.sync = f.read(SYNC_SIZE)
             self._data_offset = f.tell()
 
+    def _decompress(self, payload: bytes) -> bytes:
+        """Apply the file's codec to one raw block payload — shared by the
+        sequential `blocks` walk and the random-access `blocks_at` reads
+        (the ingest plane's per-worker block slices)."""
+        if self.codec == "deflate":
+            return zlib.decompress(payload, -15)
+        if self.codec == "snappy":
+            return _snappy_block_uncompress(self.path, payload)
+        return payload
+
     def blocks(self, skip_payload: bool = False) -> Iterator[tuple[int, bytes]]:
         """(record count, decompressed payload) per container block — the
         unit the native C++ decoder consumes. With ``skip_payload`` the
         payload is seeked over without reading or decompressing (the
         streaming layer's header-only row-count scan) and b"" is yielded."""
+        for _, count, _, payload in self.walk_blocks(skip_payload):
+            yield count, payload
+
+    def walk_blocks(self, skip_payload: bool = False):
+        """(offset of the block's count varint, record count, compressed
+        size, decompressed payload) per block — `blocks` plus the
+        offset/size entries the ingest plane's block index records, so one
+        walk can decode AND index (the map-building scan collects both)."""
         with open(self.path, "rb") as f:
             f.seek(self._data_offset)
             while True:
+                offset = f.tell()
                 head = f.read(1)
                 if not head:
                     return
@@ -348,11 +367,40 @@ class AvroContainerReader:
                 sync = f.read(SYNC_SIZE)
                 if sync != self.sync:
                     raise ValueError(f"{self.path}: bad sync marker")
-                if not skip_payload and self.codec == "deflate":
-                    payload = zlib.decompress(payload, -15)
-                elif not skip_payload and self.codec == "snappy":
-                    payload = _snappy_block_uncompress(self.path, payload)
-                yield count, payload
+                if not skip_payload:
+                    payload = self._decompress(payload)
+                yield offset, count, size, payload
+
+    def block_index(self) -> list:
+        """[(offset, count, compressed size)] of every container block —
+        a header-only scan (no payload read or decompress). The unit the
+        ingest plane's chunk-task planner splits across decode workers,
+        and the row-count source `scan_row_counts` reuses so a cold start
+        touches each file's headers once."""
+        return [(off, count, size) for off, count, size, _
+                in self.walk_blocks(skip_payload=True)]
+
+    def blocks_at(self, entries) -> Iterator[tuple[int, bytes]]:
+        """(record count, decompressed payload) for the given block-index
+        ``entries`` ([(offset, count, size)]) — random access, one seek
+        per block, sync-marker-verified. A decode worker reads ONLY its
+        slice of the container this way; nothing else is touched."""
+        with open(self.path, "rb") as f:
+            for offset, count, size in entries:
+                f.seek(offset)
+                got_count = _read_long(f)
+                got_size = _read_long(f)
+                if got_count != count or got_size != size:
+                    raise ValueError(
+                        f"{self.path}: block at offset {offset} does not "
+                        f"match its index entry (file changed since the "
+                        "index was built?)")
+                payload = f.read(size)
+                if len(payload) != size:
+                    raise EOFError(f"{self.path}: truncated block")
+                if f.read(SYNC_SIZE) != self.sync:
+                    raise ValueError(f"{self.path}: bad sync marker")
+                yield count, self._decompress(payload)
 
     def __iter__(self) -> Iterator[dict]:
         for count, payload in self.blocks():

@@ -1,0 +1,483 @@
+"""Decode-once columnar chunk cache: the ingest analog of the AOT store.
+
+Reference parity: the GLMix production pipeline (Zhang et al., KDD'16)
+preprocesses its Avro training data ONCE into a reusable columnar form and
+every subsequent run reads that, never the raw records. photon-tpu's
+version: the chunk stream a cold decode produces (`data.streaming.
+iter_game_chunks` output — scalars, per-shard dense/SparseRows arrays,
+entity-id columns, response masks) is committed to disk as one mmap-able
+``.npy`` file per array plus a MANIFEST.json, and a second epoch or a
+re-run opens the mmap'd chunks and never touches Avro again.
+
+Durability mirrors `photon_tpu.checkpoint.store` exactly:
+
+- payload arrays are written + fsync'd FIRST, the manifest is committed
+  LAST through :func:`checkpoint.store.commit_bytes` — a kill anywhere
+  before the manifest commit leaves a manifest-less directory, which
+  reads as a MISS (the ingest plane falls back to Avro decode), never as
+  a torn cache serving a partial chunk. Both IO edges ride
+  :func:`checkpoint.faults.retry_io` (sites ``cache_open`` /
+  ``cache_commit``), so transient storage hiccups back off and the fault
+  matrix can kill mid-commit deterministically.
+- a manifest written by a NEWER photon-tpu is refused with
+  :class:`ChunkCacheSchemaError` (the checkpoint store's newer-schema
+  refusal), never mis-read.
+
+Keys: :func:`cache_key` hashes the source files' fingerprints
+(name/size/mtime), the full `GameDataConfig`, every frozen index map's
+key order, and the chunk layout (chunk_rows / sparse_k / kind) — change
+any of them and the cache misses, re-decodes, and commits a fresh entry
+under a new key. Corrupted payloads are caught by a per-file CRC32
+verified on first access (:class:`ChunkCacheCorrupt`).
+
+Two entry kinds:
+
+- ``game_chunks`` — the GameData chunk sequence (the general training /
+  streaming read path);
+- ``ladder`` — a finished blocked-ELL chunk ladder (`ChunkedBatch` from
+  `data.dataset.chunk_blocked_ell`), so the EXPENSIVE global-permutation
+  sparse layout build also happens once, off the training critical path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from photon_tpu import telemetry
+from photon_tpu.checkpoint import faults
+
+__all__ = [
+    "CACHE_FORMAT", "CACHE_SCHEMA_VERSION", "ChunkCacheSchemaError",
+    "ChunkCacheCorrupt", "cache_key", "index_map_digest", "ChunkCacheWriter",
+    "CachedBag", "open_cache", "save_game_chunks_start", "save_ladder",
+    "open_ladder", "iter_cached_chunks",
+]
+
+CACHE_FORMAT = "photon_tpu-chunk-cache-v1"
+CACHE_SCHEMA_VERSION = 1
+_MANIFEST = "MANIFEST.json"
+
+
+class ChunkCacheSchemaError(ValueError):
+    """A cache entry this build cannot read (written by a NEWER
+    photon-tpu) — a clear refusal, mirroring the checkpoint store."""
+
+
+class ChunkCacheCorrupt(ValueError):
+    """A committed cache payload failed its CRC — the entry is damaged;
+    delete the directory (or change cache_dir) and re-run to rebuild."""
+
+
+# --------------------------------------------------------------------- keys
+
+
+def index_map_digest(imap) -> str:
+    """Stable digest of one frozen index map: the exact column order plus
+    the intercept flag — any id reassignment changes the decoded chunks,
+    so it must change the key."""
+    h = hashlib.sha256()
+    for k in imap.keys_in_order():
+        h.update(k.encode("utf-8"))
+        h.update(b"\x00")
+    h.update(f"|intercept:{int(bool(imap.has_intercept))}".encode())
+    return h.hexdigest()
+
+
+def _config_canon(config) -> dict:
+    return {
+        "shards": {
+            s: {"bags": list(cfg.bags),
+                "has_intercept": bool(cfg.has_intercept),
+                "dense_threshold": int(cfg.dense_threshold)}
+            for s, cfg in config.shards.items()},
+        "entity_fields": list(config.entity_fields),
+        "response_field": config.response_field,
+        "offset_field": config.offset_field,
+        "weight_field": config.weight_field,
+        "optional_entity_fields": list(config.optional_entity_fields),
+        "allow_missing_response": bool(config.allow_missing_response),
+    }
+
+
+def _file_fingerprints(path) -> list:
+    from photon_tpu.data.avro_io import avro_paths
+
+    out = []
+    for p in avro_paths(path):
+        st = os.stat(p)
+        out.append([os.path.basename(str(p)), int(st.st_size),
+                    int(st.st_mtime_ns)])
+    return out
+
+
+def cache_key(path, config, index_maps: dict, chunk_rows: int,
+              sparse_k: Optional[int], kind: str = "game_chunks",
+              extra: Optional[dict] = None) -> str:
+    """The full cache key: source fingerprints + `GameDataConfig` +
+    frozen index maps + chunk layout + entry kind (+ layout extras like
+    the blocked-ELL ladder's d_dense/n_shards). Anatomy in
+    docs/INGEST.md."""
+    doc = {
+        "schema": CACHE_SCHEMA_VERSION,
+        "kind": kind,
+        "files": _file_fingerprints(path),
+        "config": _config_canon(config),
+        "index_maps": {s: index_map_digest(index_maps[s])
+                       for s in sorted(config.shards)},
+        "chunk_rows": int(chunk_rows),
+        "sparse_k": None if sparse_k is None else int(sparse_k),
+        "extra": extra or {},
+    }
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+# ------------------------------------------------------------ the array bag
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _write_fsync(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class ChunkCacheWriter:
+    """Accumulate named arrays under ``<root>/<key16>/``, then commit the
+    manifest LAST (the crash-consistency point). Payload files land
+    durable before the manifest ever exists; `commit` sweeps leftovers of
+    a previous dead attempt out of the entries it publishes."""
+
+    def __init__(self, root, key: str, kind: str,
+                 meta: Optional[dict] = None):
+        self.root = os.fspath(root)
+        self.key = key
+        self.kind = kind
+        self.dir = entry_dir(root, key)
+        self.meta = dict(meta or {})
+        self._entries: list = []
+        self._committed = False
+        os.makedirs(self.dir, exist_ok=True)
+        # a manifest from a PREVIOUS commit at this key must not survive
+        # alongside fresh half-written payloads: remove it first so a
+        # kill mid-rebuild reads as a miss, not as the stale entry over
+        # torn files
+        stale = os.path.join(self.dir, _MANIFEST)
+        if os.path.exists(stale):
+            os.unlink(stale)
+
+    def add_array(self, name: str, arr) -> None:
+        data = _npy_bytes(arr)
+        fname = f"{len(self._entries):05d}.npy"
+        faults.retry_io(
+            lambda: _write_fsync(os.path.join(self.dir, fname), data),
+            site="cache_commit")
+        self._entries.append({"name": name, "file": fname,
+                              "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                              "nbytes": len(data)})
+        telemetry.count("ingest.cache_bytes", len(data))
+
+    def commit(self) -> str:
+        """Publish: MANIFEST.json last, via the repo-wide atomic commit
+        primitive (``cache_commit`` retry/kill site wraps it — a kill here
+        leaves NO manifest and the next open falls back to Avro)."""
+        from photon_tpu.checkpoint.store import commit_bytes
+
+        manifest = {"format": CACHE_FORMAT, "schema": CACHE_SCHEMA_VERSION,
+                    "key": self.key, "kind": self.kind, "meta": self.meta,
+                    "entries": self._entries}
+        data = json.dumps(manifest).encode()
+        faults.retry_io(
+            lambda: commit_bytes(os.path.join(self.dir, _MANIFEST), data),
+            site="cache_commit")
+        self._committed = True
+        telemetry.count("ingest.cache_commits")
+        return self.dir
+
+
+def entry_dir(root, key: str) -> str:
+    return os.path.join(os.fspath(root), key[:24])
+
+
+class CachedBag:
+    """An open committed cache entry: named arrays, mmap'd on access,
+    CRC-verified once per file on first touch."""
+
+    def __init__(self, dir_: str, manifest: dict, mmap: bool = True,
+                 verify: bool = True):
+        self.dir = dir_
+        self.manifest = manifest
+        self.meta = manifest.get("meta", {})
+        self.kind = manifest.get("kind")
+        self.mmap = mmap
+        self.verify = verify
+        self._by_name = {e["name"]: e for e in manifest["entries"]}
+        self._verified: set = set()
+
+    def names(self) -> list:
+        return [e["name"] for e in self.manifest["entries"]]
+
+    def array(self, name: str) -> np.ndarray:
+        e = self._by_name[name]
+        path = os.path.join(self.dir, e["file"])
+        if self.verify and e["file"] not in self._verified:
+            def _check(p=path, want_crc=e["crc32"], want_n=e["nbytes"],
+                       nm=name):
+                with open(p, "rb") as f:
+                    raw = f.read()
+                if len(raw) != want_n or \
+                        (zlib.crc32(raw) & 0xFFFFFFFF) != want_crc:
+                    raise ChunkCacheCorrupt(
+                        f"{p}: cached array {nm!r} failed its CRC/size "
+                        "check — the entry is damaged; delete "
+                        f"{self.dir} (or point cache_dir elsewhere) and "
+                        "re-run to rebuild from Avro")
+
+            faults.retry_io(_check, site="cache_open",
+                            retry_on=(OSError,))
+            self._verified.add(e["file"])
+
+        def _load(p=path):
+            return np.load(p, mmap_mode="r" if self.mmap else None,
+                           allow_pickle=False)
+
+        return faults.retry_io(_load, site="cache_open")
+
+
+def open_cache(root, key: str, kind: str, mmap: bool = True,
+               verify: bool = True) -> Optional[CachedBag]:
+    """Open the committed entry for ``key``, or None on a miss — which a
+    torn (manifest-less) directory, a stale key, or an unreadable
+    manifest all read as. A manifest written by a NEWER build raises
+    :class:`ChunkCacheSchemaError` (refusal, not silent re-decode of a
+    cache this build merely fails to parse)."""
+    d = entry_dir(root, key)
+    mpath = os.path.join(d, _MANIFEST)
+    if not os.path.exists(mpath):
+        return None
+
+    def _read():
+        with open(mpath) as f:
+            return json.load(f)
+
+    try:
+        manifest = faults.retry_io(_read, site="cache_open")
+    except (json.JSONDecodeError, OSError):
+        telemetry.count("ingest.cache_invalid")
+        return None
+    if manifest.get("format") != CACHE_FORMAT:
+        telemetry.count("ingest.cache_invalid")
+        return None
+    if int(manifest.get("schema", 0)) > CACHE_SCHEMA_VERSION:
+        raise ChunkCacheSchemaError(
+            f"{d}: chunk-cache schema v{manifest['schema']} is newer than "
+            f"this build's v{CACHE_SCHEMA_VERSION}: read it with a "
+            "photon-tpu at least as new as the one that wrote it, or "
+            "point cache_dir at a fresh directory")
+    if manifest.get("key") != key or manifest.get("kind") != kind:
+        telemetry.count("ingest.cache_invalid")
+        return None
+    return CachedBag(d, manifest, mmap=mmap, verify=verify)
+
+
+# ------------------------------------------------- kind: game chunk stream
+
+
+def save_game_chunks_start(root, key: str, config) -> ChunkCacheWriter:
+    """Writer for a ``game_chunks`` entry; the ingest plane adds each
+    decoded chunk as it streams past (`add_game_chunk`) and commits at
+    exhaustion."""
+    w = ChunkCacheWriter(root, key, "game_chunks", meta={
+        "n_chunks": 0, "n_rows": 0,
+        "entity_fields": list(config.entity_fields),
+        "shards": list(config.shards),
+        "saw_missing_response": False,
+    })
+    return w
+
+
+def add_game_chunk(w: ChunkCacheWriter, chunk, response_mask=None,
+                   entity_presence=None) -> None:
+    """Append one GameData chunk (plus the stream handle's per-chunk
+    response mask / optional-entity presence, when present) to a
+    ``game_chunks`` writer."""
+    from photon_tpu.data.matrix import SparseRows
+
+    i = w.meta["n_chunks"]
+    pre = f"c{i:05d}."
+    w.add_array(pre + "y", chunk.y)
+    w.add_array(pre + "weights", chunk.weights)
+    w.add_array(pre + "offsets", chunk.offsets)
+    kinds = w.meta.setdefault("shard_kinds", {})
+    for s, X in chunk.shards.items():
+        if isinstance(X, SparseRows):
+            kinds[s] = "sparse"
+            w.add_array(pre + f"shard.{s}.indices", X.indices)
+            w.add_array(pre + f"shard.{s}.values", X.values)
+            w.meta.setdefault("shard_features", {})[s] = int(X.n_features)
+        else:
+            kinds[s] = "dense"
+            w.add_array(pre + f"shard.{s}", X)
+    for e, col in chunk.entity_ids.items():
+        w.add_array(pre + f"ent.{e}", np.asarray(col, dtype=np.str_))
+    if response_mask is not None:
+        w.add_array(pre + "rmask", np.asarray(response_mask, bool))
+    for e, pres in (entity_presence or {}).items():
+        w.add_array(pre + f"pres.{e}", np.asarray(pres, bool))
+    w.meta["n_chunks"] = i + 1
+    w.meta["n_rows"] += int(chunk.n)
+    telemetry.count("ingest.cache_chunks")
+
+
+def iter_cached_chunks(bag: CachedBag, stream=None):
+    """Yield the cached GameData chunks in order — bit-identical to the
+    cold decode that committed them. With a ChunkStream handle, the
+    per-chunk response mask / entity presence / saw_missing flags are
+    restored onto it exactly as a live decode would set them."""
+    from photon_tpu.data.matrix import SparseRows
+    from photon_tpu.game.dataset import GameData
+
+    meta = bag.meta
+    kinds = meta.get("shard_kinds", {})
+    feats = meta.get("shard_features", {})
+    names = set(bag.names())
+    if stream is not None:
+        stream.saw_missing_response = bool(
+            meta.get("saw_missing_response", False))
+    for i in range(int(meta["n_chunks"])):
+        pre = f"c{i:05d}."
+        shards = {}
+        for s in meta["shards"]:
+            if kinds.get(s) == "sparse":
+                shards[s] = SparseRows(
+                    np.asarray(bag.array(pre + f"shard.{s}.indices")),
+                    np.asarray(bag.array(pre + f"shard.{s}.values")),
+                    int(feats[s]))
+            else:
+                shards[s] = np.asarray(bag.array(pre + f"shard.{s}"))
+        ids = {e: np.asarray(bag.array(pre + f"ent.{e}"))
+               for e in meta["entity_fields"]}
+        if stream is not None:
+            if (pre + "rmask") in names:
+                stream.last_response_mask = np.asarray(
+                    bag.array(pre + "rmask"))
+            stream.last_entity_presence = {
+                e: np.asarray(bag.array(pre + f"pres.{e}"))
+                for e in meta["entity_fields"]
+                if (pre + f"pres.{e}") in names}
+        yield GameData(np.asarray(bag.array(pre + "y")),
+                       np.asarray(bag.array(pre + "weights")),
+                       np.asarray(bag.array(pre + "offsets")),
+                       shards, ids)
+
+
+# ------------------------------------------------ kind: blocked-ELL ladder
+
+
+def _split_dataclass(obj) -> tuple[dict, dict]:
+    """(arrays, meta) of a layout dataclass: array fields and tuples of
+    arrays go to .npy files, plain ints stay in the manifest."""
+    arrays: dict = {}
+    meta: dict = {}
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if isinstance(v, tuple):
+            meta[f.name] = {"tuple": len(v)}
+            for j, x in enumerate(v):
+                arrays[f"{f.name}.{j}"] = np.asarray(x)
+        elif hasattr(v, "shape"):
+            meta[f.name] = {"array": True}
+            arrays[f.name] = np.asarray(v)
+        else:
+            meta[f.name] = {"value": v}
+    return arrays, meta
+
+
+def _join_dataclass(cls, bag: CachedBag, prefix: str, meta: dict):
+    kwargs: dict = {}
+    for name, spec in meta.items():
+        if "tuple" in spec:
+            kwargs[name] = tuple(
+                np.asarray(bag.array(f"{prefix}{name}.{j}"))
+                for j in range(spec["tuple"]))
+        elif spec.get("array"):
+            kwargs[name] = np.asarray(bag.array(f"{prefix}{name}"))
+        else:
+            kwargs[name] = spec["value"]
+    return cls(**kwargs)
+
+
+def save_ladder(root, key: str, cb) -> str:
+    """Commit a finished blocked-ELL ChunkedBatch (the
+    `data.dataset.chunk_blocked_ell` output) as a ``ladder`` entry —
+    layout construction happens once, every later run mmap-opens it."""
+    from photon_tpu.data.matrix import (BlockedEllRows,
+                                        ShardedBlockedEllRows)
+
+    X = cb.X
+    w = ChunkCacheWriter(root, key, "ladder", meta={
+        "n_real": int(X.n_real), "n_features": int(X.n_features),
+        "last_col_pos": (None if X.last_col_pos is None
+                         else int(X.last_col_pos)),
+        "n_chunks": X.n_chunks,
+    })
+    w.add_array("y", cb.y)
+    w.add_array("weights", cb.weights)
+    w.add_array("offsets", cb.offsets)
+    if X.perm_cols is not None:
+        w.add_array("perm_cols", X.perm_cols)
+        w.add_array("inv_perm", X.inv_perm)
+    chunk_meta = []
+    for i, c in enumerate(X.chunks):
+        if not isinstance(c, (BlockedEllRows, ShardedBlockedEllRows)):
+            raise TypeError(
+                "save_ladder expects blocked-ELL chunks (build them with "
+                "data.dataset.chunk_blocked_ell)")
+        arrays, meta = _split_dataclass(c)
+        for name, arr in arrays.items():
+            w.add_array(f"c{i:05d}.{name}", arr)
+        chunk_meta.append({"cls": type(c).__name__, "fields": meta})
+    w.meta["chunks"] = chunk_meta
+    return w.commit()
+
+
+def open_ladder(root, key: str, mmap: bool = True,
+                verify: bool = True):
+    """Reopen a committed ``ladder`` entry as a ChunkedBatch, or None on
+    a miss."""
+    from photon_tpu.data.dataset import ChunkedBatch, ChunkedMatrix
+    from photon_tpu.data.matrix import (BlockedEllRows,
+                                        ShardedBlockedEllRows)
+
+    bag = open_cache(root, key, "ladder", mmap=mmap, verify=verify)
+    if bag is None:
+        return None
+    classes = {"BlockedEllRows": BlockedEllRows,
+               "ShardedBlockedEllRows": ShardedBlockedEllRows}
+    chunks = tuple(
+        _join_dataclass(classes[cm["cls"]], bag, f"c{i:05d}.",
+                        cm["fields"])
+        for i, cm in enumerate(bag.meta["chunks"]))
+    names = set(bag.names())
+    has_perm = "perm_cols" in names
+    X = ChunkedMatrix(
+        chunks, int(bag.meta["n_real"]), int(bag.meta["n_features"]),
+        perm_cols=np.asarray(bag.array("perm_cols")) if has_perm else None,
+        inv_perm=np.asarray(bag.array("inv_perm")) if has_perm else None,
+        last_col_pos=bag.meta.get("last_col_pos"))
+    return ChunkedBatch(X, np.asarray(bag.array("y")),
+                        np.asarray(bag.array("weights")),
+                        np.asarray(bag.array("offsets")))

@@ -405,7 +405,7 @@ class ChunkedBatch(NamedTuple):
                 shard_rows(self.weights[sl], mesh, pad_rows=pad))
 
     def iter_device(self, device=None, mesh=None,
-                    prefetch: int = 2) -> Iterator:
+                    prefetch=2) -> Iterator:
         """Yield (i, device-resident GLMBatch) chunk by chunk, PREFETCHED:
         up to ``prefetch`` chunks (default 2 — the classic double buffer)
         are in flight at once, so chunk i+`k`'s host→device transfer
@@ -413,6 +413,14 @@ class ChunkedBatch(NamedTuple):
         asynchronous). Peak device footprint is ~``prefetch`` chunks, never
         the dataset. With ``mesh=``, every chunk is row-sharded across the
         whole mesh (`mesh_chunk`) instead of landing on one device.
+
+        ``prefetch`` may also be a stall-driven controller
+        (`data.ingest_plane.AdaptivePrefetch`): each pass then runs at the
+        controller's current depth, and the pass's measured stall/compute
+        totals feed `observe` at exhaustion — the window widens while
+        uploads stall, bounded by the controller's byte budget, and every
+        decision lands in telemetry (``prefetch_decision`` events). Depth
+        never changes results — it is purely an overlap knob.
 
         The iterator times how long it stalls waiting for each prefetched
         chunk's transfer; per-pass totals land in the telemetry counters
@@ -429,7 +437,8 @@ class ChunkedBatch(NamedTuple):
         n = self.n_chunks
         if n == 0:
             return
-        depth = max(int(prefetch), 1)
+        ctl = prefetch if hasattr(prefetch, "observe") else None
+        depth = max(int(ctl.depth if ctl is not None else prefetch), 1)
         if mesh is not None:
             # per-pass upload cache: stream-wide replicated structures
             # (the blocked-ELL ladder's column permutation) upload once
@@ -466,6 +475,12 @@ class ChunkedBatch(NamedTuple):
         telemetry.count("stream.stall_seconds", stall)
         telemetry.count("stream.compute_seconds", max(compute, 0.0))
         telemetry.gauge("stream.prefetch_depth", depth)
+        from photon_tpu import profiling
+
+        profiling.attribute("ingest.upload", "upload", max(stall, 0.0))
+        if ctl is not None:
+            ctl.observe(stall, max(compute, 0.0), n,
+                        self.X.nbytes() // max(self.X.n_chunks, 1))
         _log_stream_stall(stall, compute, n, depth)
 
 

@@ -55,15 +55,67 @@ def _open_reader(p) -> AvroContainerReader:
     return retry_io(lambda: AvroContainerReader(p), site="avro_open")
 
 
-def scan_row_counts(path) -> list:
+def scan_row_counts(path, block_index: Optional[dict] = None) -> list:
     """Per-file record counts from the container block HEADERS only — no
     payload decompression, no record decode. Cheap enough to run before
-    streaming so device buffers can be preallocated exactly."""
+    streaming so device buffers can be preallocated exactly.
+
+    ``block_index`` (path -> [(offset, count, size)], the shape
+    `scan_ingest` returns) answers from the already-scanned index without
+    touching the files again."""
+    if block_index is not None:
+        return [sum(c for _, c, _ in block_index[str(p)])
+                for p in avro_paths(path)]
     counts = []
     for p in avro_paths(path):
         rd = _open_reader(p)
         counts.append(sum(c for c, _ in rd.blocks(skip_payload=True)))
     return counts
+
+
+@dataclasses.dataclass
+class IngestScan:
+    """Everything one cold-start pass over the containers learns: the
+    frozen per-shard index maps AND the per-file block index (offsets /
+    record counts / compressed sizes). `scan_ingest` folds row counting
+    into the (retried) map-building scan, so preallocating device buffers
+    and planning the ingest plane's decode tasks costs no extra pass —
+    before round 14 the driver header-scanned every container twice."""
+
+    index_maps: dict
+    block_index: dict  # path -> [(offset, count, size)]
+
+    @property
+    def row_counts(self) -> list:
+        return [sum(c for _, c, _ in blocks)
+                for blocks in self.block_index.values()]
+
+    @property
+    def n_rows(self) -> int:
+        return sum(self.row_counts)
+
+
+def scan_ingest(path, config: GameDataConfig,
+                index_maps: Optional[dict] = None) -> IngestScan:
+    """ONE pass over the containers: build whatever frozen index maps are
+    missing (exactly `build_index_maps_streaming` semantics) while
+    recording the block index as a side effect of the same walk. When
+    every map is prebuilt the pass degrades to the header-only scan (no
+    payload decompress)."""
+    index_maps = dict(index_maps or {})
+    todo = {s: cfg for s, cfg in config.shards.items() if s not in index_maps}
+    index_out: dict = {}
+    if todo:
+        from photon_tpu import telemetry
+
+        with telemetry.span("ingest.build_index_maps", shards=sorted(todo)):
+            index_maps = _build_index_maps_streaming(path, config,
+                                                     index_maps, todo,
+                                                     index_out=index_out)
+    else:
+        for p in avro_paths(path):
+            index_out[str(p)] = _open_reader(p).block_index()
+    return IngestScan(index_maps, index_out)
 
 
 def _frozen_maps_or_raise(config: GameDataConfig, index_maps,
@@ -116,7 +168,8 @@ def build_index_maps_streaming(
 
 
 def _build_index_maps_streaming(path, config: GameDataConfig, index_maps,
-                                todo) -> dict:
+                                todo, index_out: Optional[dict] = None
+                                ) -> dict:
     # Native pass over EXACTLY the shards being built: a sub-config keeps
     # only their bags and consumes nothing else — every other field
     # (including the real response/entity columns and prebuilt shards'
@@ -126,20 +179,33 @@ def _build_index_maps_streaming(path, config: GameDataConfig, index_maps,
                               response_field="\x00unconsumed",
                               offset_field="\x00unconsumed",
                               weight_field="\x00unconsumed")
-    nat = _build_maps_native(path, sub)
+    # index_out passed only when collecting (keeps the 2-arg signature
+    # test spies replace)
+    nat = (_build_maps_native(path, sub) if index_out is None
+           else _build_maps_native(path, sub, index_out=index_out))
     if nat is not None:
         index_maps.update(nat)
         return index_maps
     building = {s: IndexMap() for s in todo}
     bag_names = sorted({b for cfg in todo.values() for b in cfg.bags})
     for p in avro_paths(path):
-        for rec in _open_reader(p):
-            norm = {b: normalize_bag(rec.get(b)) for b in bag_names}
-            for s, cfg in todo.items():
-                imap = building[s]
-                for bag in cfg.bags:
-                    for ntv in norm[bag]:
-                        imap.index_of(feature_key(ntv.name, ntv.term))
+        import io as _io
+
+        rd = _open_reader(p)
+        entries = []
+        for off, count, size, payload in rd.walk_blocks():
+            entries.append((off, count, size))
+            buf = _io.BytesIO(payload)
+            for _ in range(count):
+                rec = read_datum(buf, rd.schema)
+                norm = {b: normalize_bag(rec.get(b)) for b in bag_names}
+                for s, cfg in todo.items():
+                    imap = building[s]
+                    for bag in cfg.bags:
+                        for ntv in norm[bag]:
+                            imap.index_of(feature_key(ntv.name, ntv.term))
+        if index_out is not None:
+            index_out[str(p)] = entries
     for s, cfg in todo.items():
         if cfg.has_intercept:
             building[s].index_of(INTERCEPT_KEY)
@@ -147,10 +213,12 @@ def _build_index_maps_streaming(path, config: GameDataConfig, index_maps,
     return index_maps
 
 
-def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
+def _build_maps_native(path, config: GameDataConfig,
+                       index_out: Optional[dict] = None) -> Optional[dict]:
     """Native block-decode pass in BUILD mode, per-block arrays discarded —
     id assignment mirrors read_game_data_native exactly (same stores, same
-    first-seen order). None when the native path doesn't apply."""
+    first-seen order). None when the native path doesn't apply.
+    ``index_out`` collects the block index of the same walk."""
     from photon_tpu import native
     from photon_tpu.data.native_ingest import compile_plan
 
@@ -173,11 +241,15 @@ def _build_maps_native(path, config: GameDataConfig) -> Optional[dict]:
 
     plan = build_decode_plan(plan0, config, shard_names)
     for rd in readers:
-        for count, payload in rd.blocks():
+        entries = []
+        for off, count, size, payload in rd.walk_blocks():
+            entries.append((off, count, size))
             dec = native.decode_block(payload, count, 0, plan, stores, True)
             if not dec.ok:
                 raise ValueError(f"{rd.path}: malformed Avro block")
             dec.free()
+        if index_out is not None:
+            index_out[str(rd.path)] = entries
     out = {}
     for si, s in enumerate(shard_names):
         cfg = config.shards[s]
@@ -315,6 +387,16 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
     records→GameData assembly with the frozen maps. Chunks close at
     container-BLOCK boundaries, exactly like the native path, so chunking
     is identical whichever decoder runs."""
+    return _python_chunks_from_readers(
+        [_open_reader(p) for p in avro_paths(path)], stream)
+
+
+def _python_chunks_from_readers(readers, stream: ChunkStream
+                                ) -> Iterator[GameData]:
+    """The reader-level body of `_python_chunks`: any AvroContainerReader-
+    shaped sources (including the ingest plane's per-worker block slices)
+    stream through the SAME record buffering and assembly, so a worker's
+    chunk is bit-identical to the serial stream's by construction."""
     import io
 
     buf: list = []
@@ -345,8 +427,7 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
         buf.clear()
         return data
 
-    for p in avro_paths(path):
-        rd = _open_reader(p)
+    for rd in readers:
         for count, payload in rd.blocks():
             b = io.BytesIO(payload)
             buf.extend(read_datum(b, rd.schema) for _ in range(count))
@@ -359,14 +440,25 @@ def _python_chunks(path, stream: ChunkStream) -> Iterator[GameData]:
 def _native_chunks(path, stream: ChunkStream):
     """C++ block decoder path; None when unavailable/unplannable."""
     from photon_tpu import native
-    from photon_tpu.data.native_ingest import compile_plan
 
     if not native.available():
         return None
     paths = avro_paths(path)
     if not paths:
         return None
-    readers = [_open_reader(p) for p in paths]
+    return _native_chunks_from_readers(
+        [_open_reader(p) for p in paths], stream)
+
+
+def _native_chunks_from_readers(readers, stream: ChunkStream):
+    """The reader-level body of `_native_chunks` (shared with the ingest
+    plane's per-worker block slices); None when the schema is not
+    native-plannable."""
+    from photon_tpu import native
+    from photon_tpu.data.native_ingest import compile_plan
+
+    if not native.available() or not readers:
+        return None
     config = stream.config
     plan0 = compile_plan(readers[0].schema, config)
     if plan0 is None:
@@ -492,11 +584,22 @@ def stream_to_host(
     feature_dtype=None,
     chunk_hook=None,
     n_rows: Optional[int] = None,
+    workers: int = 0,
+    cache_dir=None,
+    block_index: Optional[dict] = None,
 ) -> tuple[GameData, int]:
     """Stream a dataset into HOST-RESIDENT form for the out-of-HBM
     streamed-objective solve (drivers.train auto-trips here when the
     device-resident estimate exceeds the POOLED HBM budget — per-chip
     budget × mesh size).
+
+    ``workers``/``cache_dir``/``block_index`` engage the round-14 ingest
+    plane (data.ingest_plane.open_chunk_source): ``workers > 0`` decodes
+    container blocks in a sharded worker pool (chunk order preserved
+    bit-for-bit; a dead worker degrades that chunk to in-process decode),
+    ``cache_dir`` opens/commits the decode-once columnar chunk cache, and
+    ``block_index`` reuses `scan_ingest`'s block offsets so the cold
+    start touches each container's headers once.
 
     Shards named in `chunked_shards` are assembled as
     data.dataset.ChunkedMatrix — uniform `objective_chunk_rows`-row host
@@ -525,7 +628,10 @@ def stream_to_host(
     unknown = chunked_shards - set(config.shards)
     if unknown:
         raise ValueError(f"chunked_shards not in config: {sorted(unknown)}")
-    n_real = sum(scan_row_counts(path)) if n_rows is None else int(n_rows)
+    if n_rows is not None:
+        n_real = int(n_rows)
+    else:
+        n_real = sum(scan_row_counts(path, block_index=block_index))
     c_rows = max(int(objective_chunk_rows), 1)
 
     dense_shards = {s: index_maps[s].n_features <= cfg.dense_threshold
@@ -560,11 +666,15 @@ def stream_to_host(
         filled = 0
 
     from photon_tpu import telemetry
+    from photon_tpu.data.ingest_plane import open_chunk_source
 
-    stream, chunks = iter_game_chunks(path, config, index_maps,
-                                      chunk_rows=chunk_rows,
-                                      sparse_k=sparse_k,
-                                      use_native=use_native)
+    stream, chunks = open_chunk_source(path, config, index_maps,
+                                       chunk_rows=chunk_rows,
+                                       sparse_k=sparse_k,
+                                       use_native=use_native,
+                                       workers=workers,
+                                       cache_dir=cache_dir,
+                                       block_index=block_index)
     row = 0
     for chunk in chunks:
         telemetry.count("ingest.chunks")
@@ -653,20 +763,29 @@ def stream_to_device(
     feature_dtype=None,
     chunk_hook=None,
     n_rows: Optional[int] = None,
-    prefetch: int = 2,
+    prefetch=2,
     _local_mask=None,
+    workers: int = 0,
+    cache_dir=None,
+    block_index: Optional[dict] = None,
 ) -> tuple[GameData, int]:
     """Stream a dataset STRAIGHT into its device placement.
 
     `n_rows`: the dataset's total row count, when the caller already ran
     `scan_row_counts` (the training driver's auto-streaming check does) —
-    skips a second pass over every container-block header.
+    skips a second pass over every container-block header. `block_index`
+    (from `scan_ingest`) serves the same purpose AND hands the ingest
+    plane its decode-task boundaries; `workers`/`cache_dir` as in
+    `stream_to_host`.
 
     `prefetch`: how many per-device shard uploads may be in flight at once
     (device_put is asynchronous; the default 2 keeps the classic double
     buffer — the next shard fills while the previous one transfers). Each
     completed shard's transfer is awaited once the window fills, bounding
-    how far the host can run ahead of the link.
+    how far the host can run ahead of the link. An
+    `data.ingest_plane.AdaptivePrefetch` controller may be passed instead
+    of an int: the window then WIDENS while uploads actually stall, up to
+    the controller's byte budget (stall-driven prefetch, round 14).
 
     With a mesh: rows are contiguously sharded over all mesh axes; per
     device a preallocated host buffer of exactly one shard fills from the
@@ -701,7 +820,10 @@ def stream_to_device(
     from photon_tpu.data.matrix import SparseRows
 
     index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
-    n_real = sum(scan_row_counts(path)) if n_rows is None else int(n_rows)
+    if n_rows is not None:
+        n_real = int(n_rows)
+    else:
+        n_real = sum(scan_row_counts(path, block_index=block_index))
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     from photon_tpu.parallel.mesh import pad_to_multiple
 
@@ -747,16 +869,30 @@ def stream_to_device(
 
     dev_i = 0  # global device-slot cursor (advances on every slot)
     in_flight: list = []  # shipped shards whose transfer isn't awaited yet
-    depth = max(int(prefetch), 1)
+    # prefetch: an int (fixed window) or a stall-driven controller
+    # (data.ingest_plane.AdaptivePrefetch) whose depth widens while the
+    # awaits below actually block, bounded by its byte budget.
+    ctl = prefetch if hasattr(prefetch, "observe_wait") else None
+    static_depth = 2 if ctl is not None else max(int(prefetch), 1)
+    shard_nbytes = 0
+
+    def _depth() -> int:
+        return max(int(ctl.depth), 1) if ctl is not None else static_depth
 
     def ship(buf):
         """device_put one completed shard onto its device (asynchronous; at
         most `prefetch` shard transfers run ahead before the oldest is
         awaited); a None buf is a slot another process owns — just advance
         past it."""
-        nonlocal dev_i
+        nonlocal dev_i, shard_nbytes
         if buf is not None:
+            import time as _time
+
             scal, mats = buf
+            if ctl is not None and not shard_nbytes:
+                shard_nbytes = sum(v.nbytes for v in scal.values()) + sum(
+                    (sum(a.nbytes for a in v) if isinstance(v, tuple)
+                     else v.nbytes) for v in mats.values())
             dev = devices[dev_i] if mesh is not None else None
             shipped = []
             for k in SCALARS:
@@ -771,8 +907,11 @@ def stream_to_device(
                 shipped.append(mat_parts[s][-1])
             in_flight.append(shipped)
             telemetry.count("ingest.device_shards")
-            if len(in_flight) > depth:
+            if len(in_flight) > _depth():
+                t0 = _time.perf_counter()
                 jax.block_until_ready(in_flight.pop(0))
+                if ctl is not None:
+                    ctl.observe_wait(_time.perf_counter() - t0, shard_nbytes)
         dev_i += 1
 
     def alloc_slot():
@@ -785,11 +924,15 @@ def stream_to_device(
     row = 0     # global row cursor
 
     from photon_tpu import telemetry
+    from photon_tpu.data.ingest_plane import open_chunk_source
 
-    stream, chunks = iter_game_chunks(path, config, index_maps,
-                                      chunk_rows=chunk_rows,
-                                      sparse_k=sparse_k,
-                                      use_native=use_native)
+    stream, chunks = open_chunk_source(path, config, index_maps,
+                                       chunk_rows=chunk_rows,
+                                       sparse_k=sparse_k,
+                                       use_native=use_native,
+                                       workers=workers,
+                                       cache_dir=cache_dir,
+                                       block_index=block_index)
     for chunk in chunks:
         telemetry.count("ingest.chunks")
         telemetry.count("ingest.rows", chunk.n)

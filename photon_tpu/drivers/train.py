@@ -156,6 +156,17 @@ class TrainingParams:
     # Storage dtype for streamed feature values (e.g. "bfloat16" halves the
     # HBM footprint of big shards; compute stays f32). None keeps float32.
     streaming_feature_dtype: Optional[str] = None
+    # Round-14 ingest plane (data/ingest_plane.py). ingest_workers > 0
+    # decodes Avro container blocks in that many worker processes —
+    # finished chunk structures flow back through a bounded ordered queue
+    # (chunk order bit-identical to the serial reader; a dead worker
+    # degrades that chunk to in-process decode). chunk_cache_dir enables
+    # the decode-once columnar chunk cache: the first run commits decoded
+    # chunks there (mmap-able .npy + manifest, keyed by source
+    # fingerprint + config + index maps + chunk layout) and every later
+    # run with the same key opens mmap'd chunks and never touches Avro.
+    ingest_workers: int = 0
+    chunk_cache_dir: Optional[str] = None
     # Directory of prebuilt frozen index maps (the indexing driver's
     # output; reference: consuming FeatureIndexingJob's PalDB maps).
     # Features absent from the maps — e.g. pruned by min_count — are
@@ -346,14 +357,19 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             prebuilt_maps = load_index_map_dir(params.index_map_dir,
                                                params.feature_shards)
         n_train_rows = None
+        train_block_index = None
         streaming = params.streaming
         if streaming is None:
             # resolved into a LOCAL, not written back: the caller's config
             # object stays a reusable tri-state (a stored False would stick
-            # to the next, bigger job it gets reused for)
-            from photon_tpu.data.streaming import scan_row_counts
+            # to the next, bigger job it gets reused for). The header-only
+            # scan also records the block index the ingest plane reuses —
+            # no later pass re-reads the container headers.
+            from photon_tpu.data.streaming import scan_ingest
 
-            n_train_rows = sum(scan_row_counts(params.train_path))
+            scan0 = scan_ingest(params.train_path, GameDataConfig(shards={}))
+            n_train_rows = scan0.n_rows
+            train_block_index = scan0.block_index
             streaming = n_train_rows > params.streaming_threshold_rows
         stream_stats: dict = {}
         streamed_obj = False
@@ -365,17 +381,17 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         if (streaming or params.streamed_objective
                 or (params.streamed_objective is None
                     and params.hbm_budget_bytes is not None)):
-            from photon_tpu.data.streaming import (
-                build_index_maps_streaming,
-                scan_row_counts,
-            )
+            from photon_tpu.data.streaming import scan_ingest
 
             # Frozen maps built ONCE, shared by the HBM estimate and
-            # whichever streaming reader runs (both accept them prebuilt).
-            frozen_maps = build_index_maps_streaming(
-                params.train_path, data_cfg, prebuilt_maps)
+            # whichever streaming reader runs (both accept them prebuilt);
+            # the SAME pass counts rows and records the block index
+            # (round 14: one cold-start walk, not three).
+            scan = scan_ingest(params.train_path, data_cfg, prebuilt_maps)
+            frozen_maps = scan.index_maps
+            train_block_index = scan.block_index
             if n_train_rows is None:
-                n_train_rows = sum(scan_row_counts(params.train_path))
+                n_train_rows = scan.n_rows
             streamed_obj = _resolve_streamed_objective(
                 params, frozen_maps, n_train_rows, mesh, log)
         if streamed_obj:
@@ -384,7 +400,8 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
             data, validation, stream_stats, n_real = \
                 _read_streamed_objective(
                     params, data_cfg, task, mode, index_maps,
-                    n_train_rows, chunked, mesh)
+                    n_train_rows, chunked, mesh,
+                    block_index=train_block_index)
             log.info(
                 "streamed objective engaged: %d rows; host-chunked "
                 "shards %s (%d-row chunks), resident shards %s%s",
@@ -396,7 +413,8 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
         elif streaming:
             data, validation, index_maps, stream_stats, n_real = \
                 _read_streaming(params, data_cfg, task, mode,
-                                frozen_maps, mesh, n_train_rows)
+                                frozen_maps, mesh, n_train_rows,
+                                block_index=train_block_index)
             log.info("streamed %d training rows (%d with padding), "
                      "%d shards", n_real, data.n, len(data.shards))
         else:
@@ -690,9 +708,19 @@ def run_training(params: TrainingParams, mesh=None) -> TrainingOutput:
                           n_resumed=n_resumed)
 
 
+def _ingest_cache_dir(params: TrainingParams):
+    """chunk_cache_dir resolved like checkpoint_dir: relative paths land
+    under the run's output dir."""
+    d = params.chunk_cache_dir
+    if d and not os.path.isabs(d):
+        d = os.path.join(params.output_dir, d)
+    return d
+
+
 def _read_streaming(params: TrainingParams, data_cfg: GameDataConfig,
                     task: TaskType, mode: DataValidationType,
-                    prebuilt_maps, mesh, n_train_rows=None):
+                    prebuilt_maps, mesh, n_train_rows=None,
+                    block_index=None):
     """Bounded-host-memory read (reference: AvroDataReader + the training
     driver never materialize the dataset on one host): frozen index maps
     from one block-stream pass, then chunks land straight into their device
@@ -703,6 +731,7 @@ def _read_streaming(params: TrainingParams, data_cfg: GameDataConfig,
     are exact over the real rows even when the mesh pads the row count."""
     import jax.numpy as jnp
 
+    from photon_tpu.data.ingest_plane import AdaptivePrefetch
     from photon_tpu.data.statistics import FeatureSummary
     from photon_tpu.data.streaming import (
         build_index_maps_streaming,
@@ -737,13 +766,17 @@ def _read_streaming(params: TrainingParams, data_cfg: GameDataConfig,
         params.train_path, data_cfg, index_maps, mesh=mesh,
         chunk_rows=params.streaming_chunk_rows, sparse_k=params.sparse_k,
         feature_dtype=f_dtype, chunk_hook=make_hook(bool(need_stats)),
-        n_rows=n_train_rows)
+        n_rows=n_train_rows, workers=params.ingest_workers,
+        cache_dir=_ingest_cache_dir(params), block_index=block_index,
+        prefetch=AdaptivePrefetch())
     validation = None
     if params.validation_path:
         validation, _ = stream_to_device(
             params.validation_path, data_cfg, index_maps, mesh=mesh,
             chunk_rows=params.streaming_chunk_rows, sparse_k=params.sparse_k,
-            feature_dtype=f_dtype, chunk_hook=make_hook(False))
+            feature_dtype=f_dtype, chunk_hook=make_hook(False),
+            workers=params.ingest_workers,
+            cache_dir=_ingest_cache_dir(params))
     return data, validation, index_maps, stats, n_real
 
 
@@ -867,7 +900,7 @@ def _read_streamed_objective(params: TrainingParams,
                              data_cfg: GameDataConfig, task: TaskType,
                              mode: DataValidationType, index_maps: dict,
                              n_train_rows: int, chunked_shards: set,
-                             mesh=None):
+                             mesh=None, block_index=None):
     """The out-of-HBM read: training data lands HOST-resident — the
     fixed-effect shards as uniform ChunkedMatrix chunks the streamed
     solvers re-upload pass by pass (row-sharded over the mesh when one is
@@ -907,14 +940,17 @@ def _read_streamed_objective(params: TrainingParams,
         chunk_rows=params.streaming_chunk_rows,
         objective_chunk_rows=params.objective_chunk_rows,
         sparse_k=params.sparse_k, feature_dtype=f_dtype,
-        chunk_hook=make_hook(bool(need_stats)), n_rows=n_train_rows)
+        chunk_hook=make_hook(bool(need_stats)), n_rows=n_train_rows,
+        workers=params.ingest_workers,
+        cache_dir=_ingest_cache_dir(params), block_index=block_index)
     validation = None
     if params.validation_path:
         validation, _ = stream_to_device(
             params.validation_path, data_cfg, index_maps, mesh=mesh,
             chunk_rows=params.streaming_chunk_rows,
             sparse_k=params.sparse_k, feature_dtype=f_dtype,
-            chunk_hook=make_hook(False))
+            chunk_hook=make_hook(False), workers=params.ingest_workers,
+            cache_dir=_ingest_cache_dir(params))
     return data, validation, stats, n_real
 
 
@@ -1158,6 +1194,16 @@ def main(argv=None) -> None:
                         "when one exists)")
     p.add_argument("--no-resume", dest="ckpt_resume", action="store_false",
                    help="ignore any existing snapshot and start fresh")
+    p.add_argument("--ingest-workers", type=int, default=None,
+                   help="decode Avro container blocks in this many worker "
+                        "processes (the round-14 ingest plane; overrides "
+                        "the config's ingest_workers; 0 = in-process)")
+    p.add_argument("--chunk-cache-dir", default=None,
+                   help="decode-once columnar chunk cache directory "
+                        "(overrides the config's chunk_cache_dir; "
+                        "relative paths land under output_dir). A rerun "
+                        "with an unchanged dataset/config/index-map key "
+                        "opens mmap'd chunks and never touches Avro")
     args = p.parse_args(argv)
     with open(args.config) as f:
         params = TrainingParams(**json.load(f))
@@ -1165,6 +1211,10 @@ def main(argv=None) -> None:
         params.checkpoint_dir = args.checkpoint_dir
     if args.ckpt_resume is not None:
         params.checkpoint_resume = args.ckpt_resume
+    if args.ingest_workers is not None:
+        params.ingest_workers = args.ingest_workers
+    if args.chunk_cache_dir is not None:
+        params.chunk_cache_dir = args.chunk_cache_dir
     out = run_training(params)
     print(json.dumps({
         "model_dir": out.model_dir,

@@ -1,0 +1,279 @@
+"""Ingest-plane selftest CLI: the whole data plane as one smoke.
+
+    python -m photon_tpu.ingest --selftest            # one line, exit != 0
+    python -m photon_tpu.ingest --selftest --json     # machine report
+
+Runs the round-14 ingest plane end to end on a canned Avro container
+(the umbrella ``python -m photon_tpu --selfcheck`` wires this in as the
+8th suite):
+
+- ``scan``          — `scan_ingest` builds maps + the block index in ONE
+  pass; `scan_row_counts` answers from the index without reopening.
+- ``decode_parity`` — worker-pool chunks (thread and process modes) are
+  bit-identical to the serial stream, chunk order preserved, including
+  under an injected ``ingest_worker`` kill (degrades to in-process
+  decode, never a hung iterator).
+- ``cache``         — cold decode commits the columnar cache; the cached
+  epoch re-reads bit-identically with Avro untouched; a kill at
+  ``cache_commit`` leaves a manifest-less (torn) entry that reads as a
+  MISS and falls back to Avro; a corrupted payload is detected by CRC;
+  a changed chunk layout misses under its new key.
+- ``ladder``        — the direct-to-blocked-ELL build round-trips the
+  ladder cache leaf-for-leaf.
+- ``prefetch``      — the stall-driven controller widens under stall,
+  narrows when stall-free, and honors its byte budget.
+- ``contract``      — the ``ingest_plane_chunk_invariance`` ContractSpec
+  traces clean (plane-produced chunks dispatch the same streamed chunk
+  program as in-process decode).
+
+Exit status: 0 iff every check passed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _default_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _chunks_equal(a, b) -> bool:
+    import numpy as np
+
+    from photon_tpu.data.matrix import SparseRows
+
+    if not (np.array_equal(a.y, b.y)
+            and np.array_equal(a.weights, b.weights)
+            and np.array_equal(a.offsets, b.offsets)):
+        return False
+    for s, X in a.shards.items():
+        Y = b.shards[s]
+        if isinstance(X, SparseRows):
+            if not (np.array_equal(np.asarray(X.indices),
+                                   np.asarray(Y.indices))
+                    and np.array_equal(np.asarray(X.values),
+                                       np.asarray(Y.values))):
+                return False
+        elif not np.array_equal(np.asarray(X), np.asarray(Y)):
+            return False
+    for e, col in a.entity_ids.items():
+        if not np.array_equal(col, b.entity_ids[e]):
+            return False
+    return True
+
+
+def run_selftest() -> dict:
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.checkpoint.faults import (FaultPlan, InjectedFault,
+                                              fault_plan)
+    from photon_tpu.data import chunk_cache as cc
+    from photon_tpu.data.avro_io import write_avro
+    from photon_tpu.data.feature_bags import FeatureShardConfig
+    from photon_tpu.data.ingest import (GameDataConfig,
+                                        training_example_schema)
+    from photon_tpu.data.ingest_plane import (AdaptivePrefetch,
+                                              chunk_blocked_ell_from_avro,
+                                              iter_game_chunks_parallel,
+                                              open_chunk_source)
+    from photon_tpu.data.streaming import (iter_game_chunks, scan_ingest,
+                                           scan_row_counts)
+
+    checks: dict = {}
+    rng = np.random.default_rng(14)
+    tmp = tempfile.mkdtemp(prefix="photon_ingest_selftest_")
+    root = os.path.join(tmp, "data")
+    os.makedirs(root)
+    schema = training_example_schema(feature_bags=("f", "g"),
+                                     entity_fields=("member",))
+    for fi in range(2):
+        records = []
+        for i in range(420):
+            fb = [{"name": "age", "term": "", "value": float(rng.normal())},
+                  {"name": "ctr", "term": "", "value": float(rng.normal())}]
+            gb = [{"name": f"id{int(v)}", "term": "t",
+                   "value": float(rng.normal())}
+                  for v in rng.integers(0, 300, size=3)]
+            records.append({"response": float(rng.integers(0, 2)),
+                            "offset": float(rng.normal()) if i % 3 == 0
+                            else None,
+                            "weight": 2.0 if i % 5 == 0 else None,
+                            "uid": f"r{fi}_{i}",
+                            "member": f"m{int(rng.integers(0, 23))}",
+                            "f": fb, "g": gb})
+        write_avro(os.path.join(root, f"part-{fi:03d}.avro"), records,
+                   schema, block_records=110)
+    config = GameDataConfig(
+        shards={"dense": FeatureShardConfig(bags=("f",), has_intercept=True),
+                "wide": FeatureShardConfig(bags=("g",), has_intercept=False,
+                                           dense_threshold=4)},
+        entity_fields=("member",))
+
+    # --- scan: one pass, counts answered from the index --------------------
+    scan = scan_ingest(root, config)
+    maps = scan.index_maps
+    counts = scan_row_counts(root, block_index=scan.block_index)
+    checks["scan"] = {"ok": scan.n_rows == 840 and counts == [420, 420]
+                      and len(scan.block_index) == 2,
+                      "n_rows": scan.n_rows, "counts": counts}
+
+    _, c0 = iter_game_chunks(root, config, maps, chunk_rows=250, sparse_k=4)
+    ref = list(c0)
+
+    # --- decode parity: thread + process pools, worker-kill degrade --------
+    def parity(mode, plan=None):
+        if plan is not None:
+            with fault_plan(plan):
+                _, c = iter_game_chunks_parallel(
+                    root, config, maps, chunk_rows=250, sparse_k=4,
+                    workers=2, mode=mode, block_index=scan.block_index)
+                got = list(c)
+        else:
+            _, c = iter_game_chunks_parallel(
+                root, config, maps, chunk_rows=250, sparse_k=4, workers=2,
+                mode=mode, block_index=scan.block_index)
+            got = list(c)
+        return (len(got) == len(ref)
+                and all(_chunks_equal(a, b) for a, b in zip(ref, got)))
+
+    ok_thread = parity("thread")
+    ok_proc = parity("process")
+    ok_killed = parity("thread", FaultPlan.kill_at("ingest_worker", 2))
+    checks["decode_parity"] = {"ok": ok_thread and ok_proc and ok_killed,
+                               "thread": ok_thread, "process": ok_proc,
+                               "worker_kill_degrade": ok_killed,
+                               "n_chunks": len(ref)}
+
+    # --- cache: cold -> cached parity, torn-commit fallback, CRC, key -----
+    cache = os.path.join(tmp, "cache")
+    killed = False
+    # dry run to count cache_commit occurrences, then kill at the LAST
+    # (the manifest commit itself)
+    from photon_tpu.checkpoint.faults import record_sites
+
+    with record_sites() as rec:
+        _, c = open_chunk_source(root, config, maps, chunk_rows=250,
+                                 sparse_k=4, cache_dir=cache)
+        cold = list(c)
+    n_hits = rec.hits.get("cache_commit", 0)
+    import shutil
+
+    shutil.rmtree(cache)
+    try:
+        with fault_plan(FaultPlan.kill_at("cache_commit", n_hits)):
+            _, c = open_chunk_source(root, config, maps, chunk_rows=250,
+                                     sparse_k=4, cache_dir=cache)
+            list(c)
+    except InjectedFault:
+        killed = True
+    key = cc.cache_key(root, config, maps, 250, 4)
+    torn_is_miss = cc.open_cache(cache, key, "game_chunks") is None
+    _, c = open_chunk_source(root, config, maps, chunk_rows=250,
+                             sparse_k=4, cache_dir=cache)
+    rebuilt = list(c)
+    _, c = open_chunk_source(root, config, maps, chunk_rows=250,
+                             sparse_k=4, cache_dir=cache)
+    cached = list(c)
+    cache_parity = (all(_chunks_equal(a, b) for a, b in zip(ref, cold))
+                    and all(_chunks_equal(a, b) for a, b in zip(ref, rebuilt))
+                    and all(_chunks_equal(a, b) for a, b in zip(ref, cached)))
+    # corruption: flip payload bytes, expect detection
+    bag = cc.open_cache(cache, key, "game_chunks")
+    f0 = os.path.join(bag.dir, bag.manifest["entries"][0]["file"])
+    raw = open(f0, "rb").read()
+    open(f0, "wb").write(raw[:-4] + b"\x00\x01\x02\x03")
+    corrupt_detected = False
+    try:
+        _, c = open_chunk_source(root, config, maps, chunk_rows=250,
+                                 sparse_k=4, cache_dir=cache)
+        list(c)
+    except cc.ChunkCacheCorrupt:
+        corrupt_detected = True
+    # a changed layout must key elsewhere (cold decode again, no corrupt
+    # read)
+    key2 = cc.cache_key(root, config, maps, 300, 4)
+    new_key_missed = (key2 != key
+                      and cc.open_cache(cache, key2, "game_chunks") is None)
+    checks["cache"] = {"ok": bool(killed and torn_is_miss and cache_parity
+                                  and corrupt_detected and new_key_missed),
+                       "kill_mid_commit": killed,
+                       "torn_is_miss": torn_is_miss,
+                       "parity": cache_parity,
+                       "corruption_detected": corrupt_detected,
+                       "layout_change_misses": new_key_missed,
+                       "commit_occurrences": n_hits}
+
+    # --- ladder: direct-to-blocked-ELL build round-trips its cache --------
+    import jax
+
+    lcache = os.path.join(tmp, "ladder")
+    cb1 = chunk_blocked_ell_from_avro(root, config, maps, "wide", 256,
+                                      d_dense=64, sparse_k=4,
+                                      cache_dir=lcache)
+    cb2 = chunk_blocked_ell_from_avro(root, config, maps, "wide", 256,
+                                      d_dense=64, sparse_k=4,
+                                      cache_dir=lcache)
+    l1 = jax.tree_util.tree_leaves(cb1.X.chunks)
+    l2 = jax.tree_util.tree_leaves(cb2.X.chunks)
+    ladder_ok = (len(l1) == len(l2)
+                 and all(np.array_equal(np.asarray(a), np.asarray(b))
+                         for a, b in zip(l1, l2))
+                 and np.array_equal(cb1.y, cb2.y)
+                 and np.array_equal(np.asarray(cb1.X.perm_cols),
+                                    np.asarray(cb2.X.perm_cols)))
+    checks["ladder"] = {"ok": bool(ladder_ok),
+                        "n_chunks": cb1.X.n_chunks}
+
+    # --- prefetch controller ----------------------------------------------
+    ap = AdaptivePrefetch(depth=2, max_depth=8, byte_budget=1000)
+    ap.observe(stall_s=1.0, compute_s=0.1, n_items=4, item_bytes=100)
+    widened = ap.depth == 4
+    ap.observe(stall_s=0.0, compute_s=1.0, n_items=4, item_bytes=100)
+    narrowed = ap.depth == 3
+    ap.observe(stall_s=5.0, compute_s=0.1, n_items=4, item_bytes=200)
+    capped = ap.depth == 5  # byte budget 1000 // 200
+    checks["prefetch"] = {"ok": widened and narrowed and capped,
+                          "decisions": [d["why"] for d in ap.decisions]}
+
+    # --- contract ----------------------------------------------------------
+    from photon_tpu.analysis import check_contract
+    from photon_tpu.analysis.registry import load_registry
+
+    registry = load_registry()
+    violations = check_contract(registry["ingest_plane_chunk_invariance"])
+    checks["contract"] = {"ok": not violations,
+                          **({"violations": [str(v) for v in violations]}
+                             if violations else {})}
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    return {"ok": all(c["ok"] for c in checks.values()), "checks": checks}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" not in argv:
+        print(__doc__)
+        return 2
+    _default_env()
+    import json
+
+    report = run_selftest()
+    if "--json" in argv:
+        print(json.dumps(report))
+    else:
+        parts = [f"{k}={'ok' if v['ok'] else 'FAIL'}"
+                 for k, v in report["checks"].items()]
+        print("ingest selftest: " + " ".join(parts))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

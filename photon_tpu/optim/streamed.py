@@ -760,13 +760,18 @@ def minimize_lbfgs_streamed(
     history: int = 10,
     max_ls_evals: int = 12,
     mesh=None,
-    prefetch: int = 2,
+    prefetch=2,
 ) -> OptResult:
     """L-BFGS whose value+gradient accumulate over streamed device chunks —
     the treeAggregate-per-iteration execution regime, same math and same
     convergence criteria as `optim.lbfgs.minimize_lbfgs_margin`. With
     ``mesh=``, chunks row-shard over every mesh device and each evaluation
     closes with one hierarchical psum (see the module docstring).
+
+    ``prefetch`` is an int window or a stall-driven controller
+    (`data.ingest_plane.AdaptivePrefetch`) — the window then widens
+    across passes while chunk uploads measurably stall, up to the
+    controller's byte budget; depth never changes results.
 
     The host driver loop emits telemetry for free: one `iteration` event
     per solver iteration (loss/grad_norm/step/trials — the live face of
@@ -984,9 +989,11 @@ def minimize_owlqn_streamed(
     reg_mask=None,
     ladder_lanes: int = 8,
     mesh=None,
-    prefetch: int = 2,
+    prefetch=2,
 ) -> OptResult:
-    """OWL-QN over streamed chunks. The projected backtracking ladder is
+    """OWL-QN over streamed chunks (``prefetch``: int window or an
+    `data.ingest_plane.AdaptivePrefetch` controller, as in the streamed
+    L-BFGS). The projected backtracking ladder is
     evaluated `ladder_lanes` candidates per chunk stream (selecting the
     first passing rung == the resident solver's sequential halving, rung by
     rung), so the common iteration costs two feature streams: the ladder

@@ -56,7 +56,16 @@ the parity-probed hot swap (the in-process cutover itself counts on
 refresh_solve/probe/swap spans — the
 grouped-evaluation `eval.*` family — scatter_elems_saved, the elements
 per metric call that would have entered combining scatters before the
-round-12 sorted-segment rework of `evaluation/grouped.py` — and HBM
+round-12 sorted-segment rework of `evaluation/grouped.py` — the
+round-14 ingest-plane additions to the `ingest.*` family —
+worker_chunks/worker_deaths counters and the workers gauge from the
+sharded decode pool (a death = one chunk degraded to in-process
+decode), cache_hits/cache_misses/cache_builds/cache_commits/
+cache_chunks/cache_bytes/cache_invalid from the decode-once chunk
+cache — with the stall-driven prefetch's
+stream.prefetch_widened/stream.prefetch_narrowed counters and one
+`prefetch_decision` event per depth verdict beside the existing
+stream.prefetch_depth gauge — and HBM
 watermarks), and the
 **iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted

@@ -24,17 +24,30 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 39 specs (the overload round added the serving robustness pins:
-    the admission layer's program invariance — policy changes batch
-    membership, never the device program — and the replica fleet's
-    per-request shard path staying collective-free) spanning every
+    """≥ 40 specs (round 14 added the ingest plane's chunk-program
+    invariance: worker-pool / cache-round-tripped chunks dispatch the
+    SAME streamed chunk program as in-process decode) spanning every
     workload family."""
-    assert len(_REGISTRY) >= 39
+    assert len(_REGISTRY) >= 40
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
-                   "evaluation", "continual"):
+                   "evaluation", "continual", "ingest"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_ingest_plane_spec_is_registered():
+    """The round-14 acceptance pin: enabling the ingest plane introduces
+    zero new trace signatures — the registered contract runs the cache's
+    .npy round-trip through TraceSignatureLog against the direct chunk
+    and refuses any signature divergence, and the traced streamed chunk
+    program stays collective-free with the strict transfer/f64 policy."""
+    spec = _REGISTRY["ingest_plane_chunk_invariance"]
+    assert dict(spec.collectives or {}) == {}
+    assert not spec.allow_transfers and not spec.allow_f64
+    assert "ingest" in spec.tags and "streamed" in spec.tags
+    violations = check_contract(spec)
+    assert violations == [], "\n".join(str(v) for v in violations)
 
 
 def test_blocked_ell_specs_are_registered():

@@ -130,6 +130,53 @@ class TestSentinel:
         verdicts = sentinel.gate({"streamed_mesh_n_chips": 4.0}, hist)
         assert "streamed_mesh_n_chips" not in verdicts
 
+    def test_ingest_leg_admission(self):
+        """The round-14 ingest_throughput legs as the sentinel sees them:
+        brand-new legs admit without tripping the gate that merges them;
+        the throughput legs + the cached/cold ratio gate higher-better,
+        the upload-stall share and the stalled-pass count LOWER-better
+        (more stalling at the same workload = the plane got slower);
+        once history exists a cached-rate collapse regresses."""
+        verdicts = sentinel.gate(
+            {"ingest_throughput_cold_rows_per_sec": 3.0e4,
+             "ingest_throughput_cached_rows_per_sec": 9.0e5,
+             "ingest_throughput_cached_over_cold": 30.0,
+             "ingest_throughput_upload_stall_pct": 0.8,
+             "ingest_stalled_passes": 0.0,
+             "dense_rate": 1e8},
+            _history())
+        for leg in ("ingest_throughput_cold_rows_per_sec",
+                    "ingest_throughput_cached_rows_per_sec",
+                    "ingest_throughput_cached_over_cold",
+                    "ingest_throughput_upload_stall_pct",
+                    "ingest_stalled_passes"):
+            assert verdicts[leg].status == "new", leg
+        assert verdicts["dense_rate"].status == "ok"
+        # directions
+        assert not sentinel.lower_is_better(
+            "ingest_throughput_cached_rows_per_sec")
+        assert not sentinel.lower_is_better(
+            "ingest_throughput_cached_over_cold")
+        assert sentinel.lower_is_better(
+            "ingest_throughput_upload_stall_pct")
+        assert sentinel.lower_is_better("ingest_stalled_passes")
+        # with history: a cached-rate collapse regresses, a stall-share
+        # rise regresses, improvements never trip
+        hist = _history(leg="ingest_throughput_cached_rows_per_sec",
+                        base=9.0e5)
+        worse = sentinel.gate(
+            {"ingest_throughput_cached_rows_per_sec": 1.0e5}, hist)
+        assert worse["ingest_throughput_cached_rows_per_sec"].status == \
+            "regressed"
+        shist = _history(leg="ingest_throughput_upload_stall_pct", base=1.0)
+        worse = sentinel.gate(
+            {"ingest_throughput_upload_stall_pct": 60.0}, shist)
+        assert worse["ingest_throughput_upload_stall_pct"].status == \
+            "regressed"
+        better = sentinel.gate(
+            {"ingest_throughput_upload_stall_pct": 0.01}, shist)
+        assert better["ingest_throughput_upload_stall_pct"].status == "ok"
+
     def test_game_e2e_leg_admission(self):
         """The round-13 game_e2e legs as the sentinel sees them: the new
         throughput legs admit as 'new' without tripping the gate that
