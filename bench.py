@@ -234,6 +234,48 @@ def run_sparse_grid(batch) -> float:
     return rows * int(iters) / best
 
 
+# --- kernel variant of the blocked-ELL sparse leg (round 15) --------------
+# The SAME single-lane solve with the Pallas kernels dispatched
+# (photon_tpu/kernels): on a TPU backend this is the full 2M-row
+# flagship problem through the fused tail-matvec / bucket-rmatvec
+# kernels; off-TPU the kernels run Pallas INTERPRET mode (the bit-parity
+# regime, orders of magnitude slower than compiled), so the leg drops to
+# a small problem that finishes in seconds — the number is then a
+# correctness-priced smoke, not a roofline claim, and the
+# `blocked_ell_kernel_backend` string says which regime produced it
+# (strings are invisible to the sentinel's leg_values).
+KE_ROWS_INTERPRET = 1 << 12
+KE_ITERS_INTERPRET = 4
+
+
+def run_sparse_kernel(batch) -> dict:
+    from photon_tpu import kernels as _kernels
+
+    interp = _kernels.interpret()
+    if interp:
+        kb, _ = sparse_problem(seed=7, rows=KE_ROWS_INTERPRET)
+        iters = KE_ITERS_INTERPRET
+    else:
+        kb, iters = batch, S_ITERS
+    rows = int(kb.y.shape[0])
+    cfg = OptimizerConfig(max_iters=iters, tolerance=0.0, reg=l2(),
+                          reg_weight=1e-3, history=5)
+
+    # ONE scope over warmup + reps: the mode flip clears jit caches on
+    # entry/exit only, so the timed reps replay compiled programs.
+    with _kernels.scope("on"):
+
+        def once():
+            import jax.numpy as jnp
+
+            _, res = train_glm(kb, TaskType.LOGISTIC_REGRESSION, cfg)
+            return jax.device_get((jnp.sum(res.w), res.iterations))
+
+        best, (_, it) = _best_of(once)
+    return {"rows_iters_per_sec": rows * int(it) / best,
+            "backend": "cpu-interpret" if interp else "tpu"}
+
+
 def _streamed_problem(chunk_rows: int):
     """The dense problem re-laid as HOST chunks + the streamed solve
     config (shared by the single-chip and mesh streamed legs)."""
@@ -669,6 +711,31 @@ def run_serving(ladder, pool) -> dict:
     }
 
 
+# --- quantized serving rung leg (round 15) --------------------------------
+# The SAME closed-loop drive as serving_qps through an int8-quantized
+# ProgramLadder (photon_tpu/serving: row-wise scales computed at store
+# load via data.matrix.quantize_blocks, dequant fused into the margin
+# matvec — coefficient HBM/gather traffic drops 4x). warmup() runs the
+# measured accuracy gate (probe margin max |Δ| vs the f32 rungs must sit
+# within SVQ_EPSILON or the ladder REFUSES to serve), and the leg
+# reports that measured delta as serving_quantized_margin_maxdiff —
+# sentinel-gated LOWER-better ("maxdiff" direction pattern): a quieter
+# quantization is a win, a louder one is a regression even if QPS holds.
+SVQ_EPSILON = 0.5
+
+
+def serving_quantized_ladder(ladder):
+    from photon_tpu import serving
+
+    q = serving.ProgramLadder(
+        ladder.store, floor=8, max_batch=SV_MAX_BATCH,
+        sparse_k={"member": SV_SPARSE_K}, output_mean=True,
+        model_tag="model-int8", quantize="int8",
+        quant_epsilon=SVQ_EPSILON)
+    q.warmup()  # the accuracy gate: QuantizationRefused on breach
+    return q
+
+
 # --- open-loop SLO leg (overload round) -----------------------------------
 # serving_qps is CLOSED-loop: clients wait for answers, so offered load
 # can never exceed capacity and overload is unobservable by construction.
@@ -1063,6 +1130,8 @@ def main() -> None:
         grid_value = run_sparse_grid(batch)
     with telemetry.span("leg.sparse_single"):
         single_value = run_sparse(batch)
+    with telemetry.span("leg.blocked_ell_kernel"):
+        kernel_stats = run_sparse_kernel(batch)
     with telemetry.span("leg.dense_data"):
         dense_batch = dense_problem()
     with telemetry.span("leg.dense_grid16"):
@@ -1097,6 +1166,9 @@ def main() -> None:
         sv_ladder, sv_pool = serving_problem()
     with telemetry.span("leg.serving_qps"):
         serving_stats = run_serving(sv_ladder, sv_pool)
+    with telemetry.span("leg.serving_quantized"):
+        svq_ladder = serving_quantized_ladder(sv_ladder)
+        svq_stats = run_serving(svq_ladder, sv_pool)
     with telemetry.span("leg.serving_slo"):
         slo_stats = run_serving_slo(sv_ladder, sv_pool,
                                     capacity_qps=serving_stats["qps"])
@@ -1124,6 +1196,14 @@ def main() -> None:
             # lower-better by the sentinel; the split/bucket legs are
             # config facts the sentinel excludes from gating.
             **sparse_stats,
+            # the Pallas-kernel variant (round 15): the same single-lane
+            # blocked-ELL solve with photon_tpu/kernels dispatched;
+            # off-TPU the backend string says "cpu-interpret" and the
+            # number is a small-problem parity smoke, not a roofline
+            # claim (strings are invisible to the sentinel)
+            "blocked_ell_kernel_rows_iters_per_sec_per_chip":
+                round(kernel_stats["rows_iters_per_sec"], 1),
+            "blocked_ell_kernel_backend": kernel_stats["backend"],
             "dense_grid16_rows_iters_per_sec_per_chip": round(dense_value, 1),
             "dense_grid16_vs_baseline": round(dense_value / base, 3),
             "dense_grid256_rows_iters_per_sec_per_chip":
@@ -1212,6 +1292,14 @@ def main() -> None:
             "serving_p50_ms": round(serving_stats["p50_ms"], 3),
             "serving_p95_ms": round(serving_stats["p95_ms"], 3),
             "serving_p99_ms": round(serving_stats["p99_ms"], 3),
+            # quantized rung (round 15): the same closed-loop mix through
+            # the int8 ladder (gated at warmup by the measured accuracy
+            # bound); margin_maxdiff gates LOWER-better — a louder
+            # quantization is a regression even at the same QPS
+            "serving_quantized_qps": round(svq_stats["qps"], 1),
+            "serving_quantized_p99_ms": round(svq_stats["p99_ms"], 3),
+            "serving_quantized_margin_maxdiff":
+                round(svq_ladder.quant_report["max_abs_diff"], 6),
             # open-loop SLO regime (overload round): fixed arrival rates
             # with the admission policy armed. sustained_qps/p99 gate as
             # usual; overload_shed_pct gates LOWER-better ("shed" in the

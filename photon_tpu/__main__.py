@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the eight per-package selftests as subprocesses (each CLI
+Runs the nine per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -31,6 +31,13 @@ and one crashed subsystem cannot take the others down):
                    parity-probed atomic hot-swap with kill-mid-swap
                    falling back to the old model, and both continual
                    contracts
+- ``kernels``    — `--selftest`: the roofline-closure round — Pallas
+                   interpret-mode kernel-vs-XLA bitwise parity (matvec/
+                   rmatvec/lanes/sq across storage dtypes), the streamed
+                   chunk path kernels-on == kernels-off bit for bit, the
+                   dispatch seam's fallback + signature invariance, the
+                   donated upload ring's rotation, and the four
+                   roofline-closure contracts
 - ``ingest``     — `--selftest`: the round-14 ingest data plane —
                    one-pass scan, worker-pool decode parity (incl.
                    worker-kill degrade), decode-once chunk cache
@@ -60,6 +67,7 @@ SUITES: tuple = (
     ("game", ("photon_tpu.game", "--selftest", "--json")),
     ("continual", ("photon_tpu.continual", "--selftest", "--json")),
     ("ingest", ("photon_tpu.ingest", "--selftest", "--json")),
+    ("kernels", ("photon_tpu.kernels", "--selftest", "--json")),
 )
 
 

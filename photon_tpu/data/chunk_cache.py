@@ -57,8 +57,25 @@ __all__ = [
     "CACHE_FORMAT", "CACHE_SCHEMA_VERSION", "ChunkCacheSchemaError",
     "ChunkCacheCorrupt", "cache_key", "index_map_digest", "ChunkCacheWriter",
     "CachedBag", "open_cache", "save_game_chunks_start", "save_ladder",
-    "open_ladder", "iter_cached_chunks",
+    "open_ladder", "iter_cached_chunks", "shard_chunk_range",
 ]
+
+
+def shard_chunk_range(n_chunks: int, process: int,
+                      n_processes: int) -> tuple[int, int]:
+    """The canonical per-process chunk split of the distributed cache
+    convention: contiguous ``[lo, hi)`` chunk-index ranges in process
+    order (the first ``n_chunks % n_processes`` processes take one
+    extra). Each process decodes + `add_array`s ONLY its range — chunk-
+    indexed array names stay globally unique, and concatenating the
+    per-process entries in process order recovers the serial chunk
+    order exactly (docs/INGEST.md, "Distributed cache directories")."""
+    if not 0 <= process < n_processes:
+        raise ValueError(f"process {process} out of range for "
+                         f"{n_processes}")
+    base, extra = divmod(int(n_chunks), int(n_processes))
+    lo = process * base + min(process, extra)
+    return lo, lo + base + (1 if process < extra else 0)
 
 CACHE_FORMAT = "photon_tpu-chunk-cache-v1"
 CACHE_SCHEMA_VERSION = 1
@@ -159,29 +176,68 @@ class ChunkCacheWriter:
     """Accumulate named arrays under ``<root>/<key16>/``, then commit the
     manifest LAST (the crash-consistency point). Payload files land
     durable before the manifest ever exists; `commit` sweeps leftovers of
-    a previous dead attempt out of the entries it publishes."""
+    a previous dead attempt out of the entries it publishes.
+
+    MULTI-HOST RUNS (the distributed cache directory convention,
+    docs/INGEST.md): pass ``process``/``n_processes`` and every process
+    writes its own payloads under a ``p<k>_`` filename prefix into the
+    SHARED entry directory (mirroring `checkpoint.store.SnapshotStore`'s
+    per-process ``p<k>_`` snapshot payloads) — array NAMES must be
+    globally unique across processes (each process caches its own
+    disjoint chunk range, so chunk-indexed names already are. See
+    `shard_chunk_range` for the canonical split). `commit` then differs
+    by role: processes k > 0 publish a ``p<k>.entries.json`` sidecar
+    (atomically, payloads already durable) and are done; process 0
+    barriers (best-effort — `checkpoint.store` semantics), waits for
+    every sidecar, merges all processes' entries and metas, and commits
+    the ONE shared MANIFEST.json last. A kill on any process before the
+    process-0 commit leaves a manifest-less directory — a MISS on every
+    host, never a torn cache. Readers (`open_cache`) are unchanged: the
+    manifest is the single publication point regardless of how many
+    processes wrote payloads."""
 
     def __init__(self, root, key: str, kind: str,
-                 meta: Optional[dict] = None):
+                 meta: Optional[dict] = None,
+                 process: Optional[int] = None,
+                 n_processes: Optional[int] = None):
         self.root = os.fspath(root)
         self.key = key
         self.kind = kind
         self.dir = entry_dir(root, key)
         self.meta = dict(meta or {})
+        self.process = None if process is None else int(process)
+        self.n_processes = (1 if n_processes is None else int(n_processes))
+        if self.process is not None and not (
+                0 <= self.process < self.n_processes):
+            raise ValueError(
+                f"process {self.process} out of range for "
+                f"{self.n_processes} processes")
+        self._prefix = ("" if self.process is None
+                        else f"p{self.process}_")
         self._entries: list = []
         self._committed = False
         os.makedirs(self.dir, exist_ok=True)
         # a manifest from a PREVIOUS commit at this key must not survive
         # alongside fresh half-written payloads: remove it first so a
         # kill mid-rebuild reads as a miss, not as the stale entry over
-        # torn files
-        stale = os.path.join(self.dir, _MANIFEST)
-        if os.path.exists(stale):
-            os.unlink(stale)
+        # torn files (multi-host: process 0 owns the manifest; every
+        # process clears its OWN stale sidecar)
+        if self.process is None or self.process == 0:
+            stale = os.path.join(self.dir, _MANIFEST)
+            if os.path.exists(stale):
+                os.unlink(stale)
+        if self.process is not None:
+            sidecar = os.path.join(self.dir, self._sidecar(self.process))
+            if os.path.exists(sidecar):
+                os.unlink(sidecar)
+
+    @staticmethod
+    def _sidecar(k: int) -> str:
+        return f"p{k}.entries.json"
 
     def add_array(self, name: str, arr) -> None:
         data = _npy_bytes(arr)
-        fname = f"{len(self._entries):05d}.npy"
+        fname = f"{self._prefix}{len(self._entries):05d}.npy"
         faults.retry_io(
             lambda: _write_fsync(os.path.join(self.dir, fname), data),
             site="cache_commit")
@@ -190,15 +246,87 @@ class ChunkCacheWriter:
                               "nbytes": len(data)})
         telemetry.count("ingest.cache_bytes", len(data))
 
-    def commit(self) -> str:
+    def _wait_sidecars(self, timeout_s: float) -> list:
+        """Process 0: every other process's committed sidecar, polled up
+        to ``timeout_s`` (their payloads are durable once the sidecar —
+        itself committed atomically — exists)."""
+        import time
+
+        docs = []
+        deadline = time.monotonic() + timeout_s
+        for k in range(1, self.n_processes):
+            path = os.path.join(self.dir, self._sidecar(k))
+            while not os.path.exists(path):
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"{path}: process {k}'s cache sidecar never "
+                        f"appeared within {timeout_s:.0f}s — the shared "
+                        "manifest cannot commit (the entry stays a MISS "
+                        "everywhere)")
+                time.sleep(0.05)
+            with open(path) as f:
+                docs.append(json.load(f))
+        return docs
+
+    @staticmethod
+    def _merge_meta(base: dict, others: list) -> dict:
+        """Deterministic meta merge for the shared manifest: ints/floats
+        sum (chunk/row counts), lists concatenate in process order,
+        dicts union; anything contradictory lands verbatim under
+        ``meta["processes"][k]`` instead of being guessed at."""
+        merged = dict(base)
+        for k, m in others:
+            for key, v in m.items():
+                if key not in merged:
+                    merged[key] = v
+                elif isinstance(v, bool) and isinstance(merged[key], bool):
+                    merged[key] = merged[key] or v
+                elif isinstance(v, (int, float)) \
+                        and isinstance(merged[key], (int, float)) \
+                        and not isinstance(v, bool):
+                    merged[key] = merged[key] + v
+                elif isinstance(v, list) and isinstance(merged[key], list):
+                    merged[key] = merged[key] + [x for x in v
+                                                if x not in merged[key]]
+                elif isinstance(v, dict) and isinstance(merged[key], dict):
+                    merged[key] = {**merged[key], **v}
+                elif merged[key] != v:
+                    merged.setdefault("processes", {}).setdefault(
+                        str(k), {})[key] = v
+        return merged
+
+    def commit(self, sidecar_timeout_s: float = 60.0) -> str:
         """Publish: MANIFEST.json last, via the repo-wide atomic commit
         primitive (``cache_commit`` retry/kill site wraps it — a kill here
-        leaves NO manifest and the next open falls back to Avro)."""
+        leaves NO manifest and the next open falls back to Avro).
+        Multi-host: see the class docstring — k > 0 publishes its
+        sidecar, process 0 merges and commits the shared manifest."""
         from photon_tpu.checkpoint.store import commit_bytes
 
+        if self.process is not None and self.process != 0:
+            doc = {"process": self.process, "meta": self.meta,
+                   "entries": self._entries}
+            faults.retry_io(
+                lambda: commit_bytes(
+                    os.path.join(self.dir, self._sidecar(self.process)),
+                    json.dumps(doc).encode()),
+                site="cache_commit")
+            self._committed = True
+            return self.dir
+        entries = list(self._entries)
+        meta = self.meta
+        if self.process == 0 and self.n_processes > 1:
+            from photon_tpu.checkpoint.store import _barrier
+
+            _barrier(f"photon_cache_commit_{self.key[:16]}")
+            docs = self._wait_sidecars(sidecar_timeout_s)
+            for doc in docs:
+                entries.extend(doc["entries"])
+            meta = self._merge_meta(
+                self.meta, [(doc["process"], doc["meta"]) for doc in docs])
         manifest = {"format": CACHE_FORMAT, "schema": CACHE_SCHEMA_VERSION,
-                    "key": self.key, "kind": self.kind, "meta": self.meta,
-                    "entries": self._entries}
+                    "key": self.key, "kind": self.kind, "meta": meta,
+                    "entries": entries}
         data = json.dumps(manifest).encode()
         faults.retry_io(
             lambda: commit_bytes(os.path.join(self.dir, _MANIFEST), data),

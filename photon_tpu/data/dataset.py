@@ -483,6 +483,124 @@ class ChunkedBatch(NamedTuple):
                         self.X.nbytes() // max(self.X.n_chunks, 1))
         _log_stream_stall(stall, compute, n, depth)
 
+    def device_ring(self, device=None, mesh=None,
+                    prefetch=2) -> "DeviceChunkRing":
+        """A persistent cross-pass upload ring over this dataset's chunks
+        (see `DeviceChunkRing`) — the streamed solvers' upload/compute
+        overlap regime. `iter_device` is the one-shot per-pass form."""
+        return DeviceChunkRing(self, device=device, mesh=mesh,
+                               prefetch=prefetch)
+
+
+class DeviceChunkRing:
+    """A PERSISTENT double-buffered upload ring over one ChunkedBatch:
+    the cross-pass form of `ChunkedBatch.iter_device`.
+
+    `iter_device` overlaps chunk i+1's host→device copy with chunk i's
+    compute WITHIN a pass, but the window drains at pass end — so the
+    next evaluation's first uploads serialize behind the current
+    evaluation's close: the mesh psum (`_MeshChunkOps.finish`), its host
+    readback, and the Wolfe host step all run with the link idle. The
+    ring keeps the window primed ACROSS passes instead: chunk indices
+    wrap (the streamed solvers re-stream the same chunks every
+    evaluation), so while the caller closes pass p — partials, psum,
+    readback — the first `depth` chunks of pass p+1 are already in
+    flight. Paired with the streamed backends' donated chunk programs
+    (optim/streamed.py: the compute program consumes its chunk's
+    buffers), peak HBM stays ~`depth` chunks — the two-deep ring never
+    holds a third copy.
+
+    Per-pass semantics are `iter_device`'s exactly: `stream_pass()`
+    yields ``(i, device_chunk)`` in order with the same telemetry
+    counters, the same `chunk_upload` fault-injection site per chunk,
+    ledger attribution (``ingest.upload`` stall + ``solve.compute``)
+    and `AdaptivePrefetch` support. A pass abandoned mid-way (an
+    injected kill, any exception) resets the ring to a clean state — the
+    next pass starts at chunk 0 with nothing stale in flight. Mesh mode
+    additionally persists the replication cache across passes, so a
+    blocked-ELL ladder's column permutation uploads once per SOLVE, not
+    once per pass.
+    """
+
+    def __init__(self, batch: "ChunkedBatch", device=None, mesh=None,
+                 prefetch=2):
+        from collections import deque
+
+        self.batch, self.mesh = batch, mesh
+        self._ctl = prefetch if hasattr(prefetch, "observe") else None
+        self._prefetch = prefetch
+        self._window: deque = deque()
+        self._next = 0  # chunk index the next upload issues (mod n_chunks)
+        if mesh is not None:
+            mesh_cache: dict = {}  # persists across passes: perm uploads once
+            self._put = lambda i: batch.mesh_chunk(i, mesh,
+                                                   _cache=mesh_cache)
+        else:
+            dput = (lambda b: jax.device_put(b, device)) \
+                if device is not None else jax.device_put
+            self._put = lambda i: dput(batch.chunk(i))
+
+    @property
+    def depth(self) -> int:
+        return max(int(self._ctl.depth if self._ctl is not None
+                       else self._prefetch), 1)
+
+    def _fill(self, n: int) -> None:
+        while len(self._window) < min(self.depth, n):
+            self._window.append(self._put(self._next))
+            self._next = (self._next + 1) % n
+
+    def stream_pass(self):
+        """One pass: yield (i, device chunk) for every chunk, keeping the
+        upload window full — including past the last chunk, into the
+        next pass (the psum/readback overlap)."""
+        import time as _time
+
+        from photon_tpu import profiling, telemetry
+        from photon_tpu.checkpoint.faults import kill_point
+
+        n = self.batch.n_chunks
+        if n == 0:
+            return
+        depth = self.depth
+        stall = 0.0
+        t_start = _time.perf_counter()
+        ok = False
+        try:
+            for i in range(n):
+                self._fill(n)
+                cur = self._window.popleft()
+                kill_point("chunk_upload")
+                t0 = _time.perf_counter()
+                jax.block_until_ready(cur)
+                stall += _time.perf_counter() - t0
+                yield i, cur
+            # prime the NEXT pass before the caller closes this one (the
+            # in-loop fill already wrapped past chunk n-1; this tops the
+            # window back up after the final popleft)
+            self._fill(n)
+            ok = True
+        finally:
+            if not ok:
+                # abandoned mid-pass (kill/exception): drop in-flight
+                # uploads so the next pass starts clean at chunk 0
+                self._window.clear()
+                self._next = 0
+            compute = (_time.perf_counter() - t_start) - stall
+            telemetry.count("stream.passes")
+            telemetry.count("stream.chunk_uploads", n)
+            telemetry.count("stream.stall_seconds", stall)
+            telemetry.count("stream.compute_seconds", max(compute, 0.0))
+            telemetry.gauge("stream.prefetch_depth", depth)
+            profiling.attribute("ingest.upload", "upload", max(stall, 0.0))
+            profiling.attribute("solve.compute", "compute",
+                                max(compute, 0.0))
+            if ok and self._ctl is not None:
+                self._ctl.observe(
+                    stall, max(compute, 0.0), n,
+                    self.batch.X.nbytes() // max(self.batch.X.n_chunks, 1))
+            _log_stream_stall(stall, compute, n, depth)
+
 
 def mesh_chunk_matrix(X, mesh, _cache: dict | None = None):
     """Upload one ShardedBlockedEllRows chunk onto the mesh: the dense

@@ -1393,11 +1393,32 @@ def _bell_tail(X, w):
     return parts
 
 
+def _use_kernel(X, vec) -> bool:
+    """The backend-dispatch seam (photon_tpu/kernels): True when the
+    Pallas kernels own this X pass — knob active (PHOTON_TPU_KERNELS /
+    OptimizerConfig.kernels), a plain BlockedEllRows with a tail (the
+    sharded global views keep XLA; inside shard_map `local()` is a plain
+    BlockedEllRows, so the mesh hot loop still routes here), and the
+    fused form fits the VMEM budget. The XLA path below stays the
+    always-available — and bitwise-identical — fallback."""
+    if not isinstance(X, BlockedEllRows):
+        return False
+    from photon_tpu import kernels
+
+    return kernels.active() and kernels.kernel_feasible(X, vec)
+
+
 def _bell_matvec(X: BlockedEllRows, w):
     """w: (d,) or (d, G) PERMUTED. Hot block against the contiguous prefix
-    slice, blocked-ELL tail — gathers and dense contractions only."""
+    slice, blocked-ELL tail — gathers and dense contractions only. The
+    tail term routes through the fused Pallas kernel when the kernels
+    seam is active (`photon_tpu.kernels.tail_matvec`; bitwise-equal)."""
     hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
                      preferred_element_type=jnp.float32)
+    if X.ell_vals and _use_kernel(X, w):
+        from photon_tpu import kernels
+
+        return hot + kernels.tail_matvec(X, w)
     lanes = w.ndim == 2
     zero = jnp.zeros((1, w.shape[1]) if lanes else (1,), jnp.float32)
     cat = jnp.concatenate(_bell_tail(X, w) + [zero], axis=0)
@@ -1407,12 +1428,23 @@ def _bell_matvec(X: BlockedEllRows, w):
 def _bell_rmatvec(X: BlockedEllRows, r, square: bool = False):
     """Xᵀr (or (X∘X)ᵀr): hot matmul + per-occurrence-bucket pre-sorted
     gather/reduce, assembled by concatenation — no scatter. r: (n,) or
-    (n, G)."""
+    (n, G). The bucket block routes through the fused Pallas kernel when
+    the kernels seam is active (`photon_tpu.kernels.bucket_rmatvec`;
+    bitwise-equal)."""
     f32 = jnp.float32
     lanes = r.ndim == 2
     dense = X.dense * X.dense if square else X.dense
     parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
                         preferred_element_type=f32)]
+    if X.bucket_vals and _use_kernel(X, r):
+        from photon_tpu import kernels
+
+        parts.append(kernels.bucket_rmatvec(X, r, square=square))
+        pad = X.n_features - X.n_prefix
+        if pad:
+            parts.append(jnp.zeros(
+                (pad, r.shape[1]) if lanes else (pad,), f32))
+        return jnp.concatenate(parts, axis=0)
     for br, bv in zip(X.bucket_rows, X.bucket_vals):
         if square:
             v = bv.astype(f32)
@@ -1758,6 +1790,38 @@ def quantize_rows(n: int, quantum: int) -> int:
     entity lane counts) bucket by `next_pow2` instead."""
     q = int(quantum)
     return max((max(int(n), 1) + q - 1) // q * q, q)
+
+
+def quantize_blocks(block, mode: str = "int8"):
+    """Row-wise symmetric quantization of a serving coefficient block —
+    the store-load half of the quantized serving rungs (serving/programs
+    fuses the matching dequant into the margin matvec).
+
+    ``block``: a (d,) fixed-effect vector (ONE scale) or an (E + 1, d)
+    random-effect block (one scale PER ROW — per-entity dynamic range;
+    a global scale would crush small-norm entities under one hot one).
+
+    ``mode="int8"`` → ``(q int8, scales f32)`` with ``scales =
+    max|row| / 127`` and ``q = round(row / scale)``; dequant is
+    ``q * scale``. All-zero rows (the cold-miss row E) take scale 1.0 so
+    they dequantize to EXACT zeros — the graceful-degradation row stays
+    bit-exact. ``mode="bf16"`` → ``(q bf16, None)``: a plain storage
+    cast (half the bytes, ~3 decimal digits), no scales needed.
+    """
+    arr = np.ascontiguousarray(np.asarray(block, np.float32))
+    if mode == "bf16":
+        return arr.astype(jnp.bfloat16), None
+    if mode != "int8":
+        raise ValueError(f"quantize mode must be 'int8' or 'bf16', "
+                         f"got {mode!r}")
+    vec = arr.ndim == 1
+    rows = arr[None] if vec else arr
+    scales = np.abs(rows).max(axis=1) / 127.0
+    scales = np.where(scales > 0.0, scales, 1.0).astype(np.float32)
+    q = np.clip(np.rint(rows / scales[:, None]), -127, 127).astype(np.int8)
+    if vec:
+        return q[0], np.float32(scales[0])
+    return q, scales
 
 
 def last_column_is_intercept(X: Matrix) -> bool:

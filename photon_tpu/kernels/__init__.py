@@ -1,0 +1,158 @@
+"""Pallas TPU kernels for the measured sparse soft spots — the machine-code
+half of ROADMAP open item 4 ("spend the ledger's gap").
+
+PR 8's attribution ledger and PERF.md rounds 11-12 measured exactly where
+the blocked-ELL hot path leaves hardware on the table: the tail matvec's
+concat + `row_pos` reassembly is an extra HBM round-trip of the (B,)
+bucket outputs per X pass, the per-slot w-gather pays an HBM access
+granule per ELL slot INCLUDING the 12.3% pow2 padding, and the
+occurrence-bucket rmatvec re-reads the cotangent per bucket. This package
+closes that loop with two fused Pallas kernels (`kernels/blocked_ell.py`):
+
+- **blocked-ELL tail matvec** — gather + bf16-multiply/f32-accumulate
+  einsum + row reassembly in ONE kernel: the tail-coefficient slice
+  ``w[d_sel:n_prefix]`` (~2 MB of distinct tail columns at 10M-feature
+  scale, the round-12 fact) lives VMEM-resident for the whole kernel, so
+  per-slot gathers — padded slots included — are VMEM-local instead of
+  HBM granules, and the bucket outputs never materialize in HBM (the XLA
+  path writes the (B,) concat out and gathers it back in).
+- **occurrence-bucket rmatvec** — every bucket's pre-sorted gather +
+  einsum in one kernel over a single VMEM-resident cotangent read,
+  emitting the concatenated tail-gradient block directly.
+
+DISPATCH SEAM (`data/matrix.py::BlockedEllRows.{matvec,rmatvec}` route
+through `tail_matvec` / `bucket_rmatvec` here):
+
+- ``PHOTON_TPU_KERNELS`` env knob: ``on`` forces the kernels (Pallas
+  ``interpret=True`` off-TPU — the bit-level parity test mode), ``off``
+  forces the XLA path, ``auto`` (default) enables them on a TPU backend
+  only.
+- `OptimizerConfig.kernels` threads the same three-state knob through
+  `models/training.py` and `optim/streamed.py` per solve (None =
+  inherit the env/auto default).
+- The XLA path stays the always-available fallback: kernels also step
+  aside per call when a layout has no tail or exceeds the VMEM budget
+  (``PHOTON_TPU_KERNELS_VMEM``) — never an error, never a different
+  answer (interpret-mode parity is BITWISE, pinned by
+  tests/test_kernels.py and the `blocked_ell_kernel_x_passes` contract).
+
+Flipping the effective mode mid-process clears jit caches (the
+`telemetry.taps` arming precedent): the dispatch branch is a trace-time
+fact, not part of jit's cache key, so a cached program would otherwise
+keep its old path. The seam itself never changes CALL signatures —
+`KERNEL_SIGNATURES` records every dispatch and the registered no-retrace
+contract refuses signature divergence between modes.
+
+``python -m photon_tpu.kernels --selftest`` is the 9th umbrella
+selfcheck suite (interpret parity matrix + dispatch invariance + the
+registered contracts).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from photon_tpu.analysis.rules import TraceSignatureLog
+
+from photon_tpu.kernels.blocked_ell import (  # noqa: F401
+    bucket_rmatvec,
+    kernel_feasible,
+    tail_matvec,
+)
+
+__all__ = [
+    "ENV_KNOB", "ENV_VMEM", "KERNEL_SIGNATURES", "mode", "active",
+    "interpret", "vmem_budget", "scope", "tail_matvec", "bucket_rmatvec",
+    "kernel_feasible",
+]
+
+ENV_KNOB = "PHOTON_TPU_KERNELS"
+ENV_VMEM = "PHOTON_TPU_KERNELS_VMEM"
+_MODES = ("on", "off", "auto")
+
+# Dispatch-signature registry: the seam records every kernel dispatch's
+# argument signature here; the `blocked_ell_kernel_no_retrace` contract
+# (kernels/blocked_ell.py) replays dispatches under both modes and
+# refuses any divergence — mode flips must never change call signatures.
+KERNEL_SIGNATURES = TraceSignatureLog()
+
+# Override stack (innermost wins) pushed by `scope` — the config-field
+# face of the knob, threaded per solve by models/training.py and
+# optim/streamed.py.
+_OVERRIDES: list[str] = []
+
+
+def _canon(m) -> str:
+    m = str(m).strip().lower()
+    aliases = {"1": "on", "true": "on", "0": "off", "false": "off",
+               "": "auto"}
+    m = aliases.get(m, m)
+    if m not in _MODES:
+        raise ValueError(
+            f"{ENV_KNOB}/OptimizerConfig.kernels must be one of {_MODES} "
+            f"(or 0/1), got {m!r}")
+    return m
+
+
+def mode() -> str:
+    """The requested mode: innermost `scope` override, else the
+    ``PHOTON_TPU_KERNELS`` env knob, else ``auto``."""
+    if _OVERRIDES:
+        return _OVERRIDES[-1]
+    return _canon(os.environ.get(ENV_KNOB, "auto"))
+
+
+def interpret() -> bool:
+    """True off-TPU: kernels run via Pallas ``interpret=True`` — the
+    CPU bit-parity mode the test matrix pins."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def active() -> bool:
+    """Whether the dispatch seam routes to the Pallas kernels right now
+    (``on`` → yes, ``off`` → no, ``auto`` → TPU backend only)."""
+    m = mode()
+    if m == "on":
+        return True
+    if m == "off":
+        return False
+    return not interpret()
+
+
+def vmem_budget() -> int | None:
+    """Per-call VMEM byte budget for the single-fused-kernel form; a
+    layout whose operands exceed it falls back to the XLA path. Off-TPU
+    (interpret mode) there is no VMEM, so the budget is unbounded unless
+    ``PHOTON_TPU_KERNELS_VMEM`` pins one."""
+    raw = os.environ.get(ENV_VMEM)
+    if raw is not None:
+        return int(raw)
+    return None if interpret() else 12 << 20
+
+
+@contextlib.contextmanager
+def scope(m=None):
+    """Push a mode override for the duration (None = no-op inherit).
+
+    A push/pop that CHANGES the effective `active()` verdict clears jit
+    caches: cached programs traced under the old mode would otherwise
+    keep dispatching the old path (the flag is not part of jit's cache
+    key — exactly the telemetry-tap arming semantics)."""
+    if m is None:
+        yield
+        return
+    import jax
+
+    before = active()
+    _OVERRIDES.append(_canon(m))
+    inside = active()
+    if inside != before:
+        jax.clear_caches()
+    try:
+        yield
+    finally:
+        _OVERRIDES.pop()
+        if active() != inside:
+            jax.clear_caches()

@@ -1,0 +1,269 @@
+"""The two Pallas TPU kernels behind the blocked-ELL dispatch seam.
+
+Both kernels mirror `data/matrix.py`'s XLA ops PRIMITIVE FOR PRIMITIVE —
+the same `_bell_compute` dtype recipe (bf16 storage multiplies in bf16),
+the same ``einsum(..., preferred_element_type=f32)`` accumulation, the
+same concat order — so Pallas interpret mode on CPU reproduces the XLA
+path BITWISE (tests/test_kernels.py pins the full bucket matrix). What
+changes is the memory traffic on a real TPU:
+
+- `tail_matvec` fuses the whole tail X pass into ONE kernel: the
+  tail-coefficient slice ``w[d_sel:n_prefix]`` loads HBM→VMEM once and
+  every per-slot gather — the 12.3% pow2-padded slots included — is a
+  VMEM access instead of an HBM granule (the round-12 `StaticCost.
+  gather_bytes` wall), and the per-bucket einsum outputs concatenate and
+  reassemble through ``row_pos`` inside VMEM, never materializing the
+  (B,) intermediate in HBM (the XLA path writes it out and gathers it
+  back in — two extra HBM passes over the tail rows per X pass).
+- `bucket_rmatvec` fuses the occurrence-bucket gradient block the same
+  way: one VMEM-resident read of the cotangent serves every bucket's
+  pre-sorted gather + einsum, and the concatenated tail-gradient block
+  is emitted directly.
+
+The hot dense block stays on the XLA/MXU path in both passes (it is
+already one `jnp.matmul` — nothing to fuse), as do the zero suffix and
+the final `hot + tail` add, so kernel-vs-XLA parity reduces to the
+bucket arithmetic these kernels own.
+
+Single-fused-kernel form: each call is one `pallas_call` with every
+operand VMEM-resident (grid-free). The dispatch seam enforces the VMEM
+budget (`kernels.vmem_budget`) and falls back to XLA above it — the
+grid-tiled production form (row-tiled reassembly over a persistent
+VMEM bucket scratch) is the measured-on-TPU follow-up recorded in
+docs/PERF.md round 15; interpret-mode parity and the contracts below
+hold for any future tiling because the per-bucket arithmetic is pinned
+primitive-for-primitive.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["tail_matvec", "bucket_rmatvec", "kernel_feasible"]
+
+
+def _nbytes(a) -> int:
+    return int(np.prod(a.shape, dtype=np.int64)) * np.dtype(a.dtype).itemsize
+
+
+def kernel_feasible(X, w_or_r) -> bool:
+    """Whether the single-fused-kernel form fits the VMEM budget for this
+    layout (+ the vector it multiplies). No-tail layouts are infeasible
+    by definition (there is nothing to fuse)."""
+    from photon_tpu import kernels as K
+
+    if not getattr(X, "ell_vals", ()) and not getattr(X, "bucket_vals", ()):
+        return False
+    budget = K.vmem_budget()
+    if budget is None:
+        return True
+    total = _nbytes(w_or_r)
+    for t in (X.ell_pcols, X.ell_vals, X.bucket_rows, X.bucket_vals):
+        total += sum(_nbytes(b) for b in t)
+    total += _nbytes(X.row_pos)
+    return total <= budget
+
+
+@functools.lru_cache(maxsize=256)
+def _tail_call(n_buckets: int, lanes: bool, interp: bool, n: int, G: int):
+    """One compiled-form `pallas_call` closure per (structure) key: the
+    kernel body is pure python over the STATIC bucket count, so the
+    closure caches on structure and jit caches on argument shapes."""
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+
+    def kernel(*refs):
+        # refs: row_pos, wt, (pc_i, pv_i)*, out
+        rp_ref, wt_ref = refs[0], refs[1]
+        out_ref = refs[-1]
+        wt = wt_ref[:]
+        parts = []
+        for i in range(n_buckets):
+            pc = refs[2 + 2 * i][:]
+            pv = refs[3 + 2 * i][:]
+            g = wt[pc]                      # ([S,] r_b, W_b[, G]) gather
+            if g.dtype != pv.dtype:
+                g = g.astype(pv.dtype)      # the _bell_compute recipe
+            eq = "rw,rwg->rg" if lanes else "rw,rw->r"
+            parts.append(jnp.einsum(eq, pv, g,
+                                    preferred_element_type=f32))
+        zero = jnp.zeros((1, G) if lanes else (1,), f32)
+        cat = jnp.concatenate(parts + [zero], axis=0)
+        out_ref[:] = cat[rp_ref[:]]
+
+    out_shape = jax.ShapeDtypeStruct((n, G) if lanes else (n,), f32)
+
+    def call(row_pos, wt, *buckets):
+        return pl.pallas_call(
+            kernel, out_shape=out_shape, interpret=interp,
+        )(row_pos, wt, *buckets)
+
+    return call
+
+
+def tail_matvec(X, w):
+    """The fused blocked-ELL tail matvec: (n,)/(n, G) f32 tail
+    contributions in ORIGINAL row order (the caller adds the hot block's
+    MXU matmul). ``w`` is the full permuted (d,)/(d, G) vector; the
+    kernel consumes only the contiguous ``w[d_sel:n_prefix]`` tail
+    slice. Bitwise-equal to `data.matrix._bell_matvec`'s tail term."""
+    from photon_tpu import kernels as K
+
+    lanes = w.ndim == 2
+    wt = w[X.d_sel:X.n_prefix]
+    row_pos = jnp.asarray(X.row_pos)
+    n = int(row_pos.shape[0])
+    G = int(w.shape[1]) if lanes else 0
+    args = (row_pos, wt) + tuple(
+        x for pc, pv in zip(X.ell_pcols, X.ell_vals)
+        for x in (jnp.asarray(pc), jnp.asarray(pv)))
+    K.KERNEL_SIGNATURES.record("kernels.tail_matvec", args)
+    call = _tail_call(len(X.ell_vals), lanes, K.interpret(), n, G)
+    return call(*args)
+
+
+@functools.lru_cache(maxsize=256)
+def _rmatvec_call(n_buckets: int, lanes: bool, square: bool, interp: bool,
+                  U: int, G: int):
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+
+    def kernel(*refs):
+        # refs: r, (br_i, bv_i)*, out
+        r_ref = refs[0]
+        out_ref = refs[-1]
+        r = r_ref[:]
+        parts = []
+        for i in range(n_buckets):
+            br = refs[1 + 2 * i][:]
+            bv = refs[2 + 2 * i][:]
+            g = r[br]                       # (c_b, k_b[, G]) gather
+            if square:
+                v = bv.astype(f32)
+                v, g = v * v, g.astype(f32)
+            else:
+                v = bv
+                if g.dtype != v.dtype:
+                    g = g.astype(v.dtype)   # the _bell_compute recipe
+            eq = "ck,ckg->cg" if lanes else "ck,ck->c"
+            parts.append(jnp.einsum(eq, v, g,
+                                    preferred_element_type=f32))
+        out_ref[:] = jnp.concatenate(parts, axis=0)
+
+    out_shape = jax.ShapeDtypeStruct((U, G) if lanes else (U,), f32)
+
+    def call(r, *buckets):
+        return pl.pallas_call(
+            kernel, out_shape=out_shape, interpret=interp,
+        )(r, *buckets)
+
+    return call
+
+
+def bucket_rmatvec(X, r, square: bool = False):
+    """The fused occurrence-bucket rmatvec: the (U,)/(U, G) f32
+    tail-gradient block in prefix (concat) order, U = n_prefix − d_sel
+    (the caller concatenates [hot, this, zero suffix]). Bitwise-equal to
+    the bucket terms of `data.matrix._bell_rmatvec`."""
+    from photon_tpu import kernels as K
+
+    lanes = r.ndim == 2
+    U = int(X.n_prefix - X.d_sel)
+    G = int(r.shape[1]) if lanes else 0
+    args = (jnp.asarray(r),) + tuple(
+        x for br, bv in zip(X.bucket_rows, X.bucket_vals)
+        for x in (jnp.asarray(br), jnp.asarray(bv)))
+    K.KERNEL_SIGNATURES.record("kernels.bucket_rmatvec", args)
+    call = _rmatvec_call(len(X.bucket_vals), lanes, bool(square),
+                         K.interpret(), U, G)
+    return call(*args)
+
+
+# ----------------------------------------------------------------- contracts
+# The roofline-closure pins (photon_tpu/analysis): the kernel-dispatched
+# X passes keep the blocked-ELL law — ZERO scatters of any kind, every
+# sparse dot/einsum accumulating f32 (the walker descends into the
+# pallas_call's own jaxpr, so the law holds INSIDE the kernel too) — and
+# the dispatch seam never retraces: kernel-on and kernel-off dispatches
+# of the same layout record identical call signatures.
+from photon_tpu.analysis.contracts import register_contract  # noqa: E402
+from photon_tpu.analysis.walker import SCATTER_PRIMITIVES  # noqa: E402
+
+
+def _contract_X(bf16: bool = True):
+    from photon_tpu.data.matrix import _contract_blocked_ell
+
+    return _contract_blocked_ell(bf16=bf16)
+
+
+@register_contract(
+    name="blocked_ell_kernel_x_passes",
+    description="BlockedEllRows matvec + rmatvec with the Pallas kernels "
+                "dispatched (interpret off-TPU): gather-fused tail and "
+                "occurrence buckets INSIDE one pallas_call each, ZERO "
+                "scatters of any kind, every sparse dot/einsum "
+                "accumulating f32 — the walker checks the kernel body's "
+                "jaxpr, not just the caller's",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("kernels", "sparse", "resident"))
+def _contract_kernel_x_passes():
+    from photon_tpu import kernels as K
+    from photon_tpu.data import matrix as M
+
+    X = _contract_X(bf16=True)
+    n, d = X.shape
+
+    def both(Xb, w, r):
+        with K.scope("on"):
+            z = M.matvec(Xb, w)
+            return z, M.rmatvec(Xb, r * z)
+
+    return both, (X, jnp.zeros((d,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32))
+
+
+@register_contract(
+    name="blocked_ell_kernel_no_retrace",
+    description="the kernel dispatch seam is signature-invariant: the "
+                "same blocked-ELL layout dispatched kernels-on and "
+                "kernels-off records IDENTICAL call signatures (the "
+                "builder replays both modes through TraceSignatureLog "
+                "and raises on divergence), so flipping the knob — or "
+                "falling back per call — never retraces a caller",
+    collectives={}, tags=("kernels", "sparse"))
+def _contract_kernel_no_retrace():
+    from photon_tpu import kernels as K
+    from photon_tpu.analysis.rules import TraceSignatureLog
+    from photon_tpu.data import matrix as M
+
+    X = _contract_X(bf16=False)
+    n, d = X.shape
+    w = jnp.zeros((d,), jnp.float32)
+    r = jnp.zeros((n,), jnp.float32)
+    log = TraceSignatureLog()
+    # The caller-visible dispatch signature is (X, w) — record it under
+    # both modes; the seam must not perturb shapes/dtypes/weak types.
+    for m in ("off", "on", "off"):
+        with K.scope(m):
+            log.record("dispatch.matvec", (X, w))
+            log.record("dispatch.rmatvec", (X, r))
+    for name in ("dispatch.matvec", "dispatch.rmatvec"):
+        sigs = log.signatures(name)
+        if len(sigs) != 1:
+            raise AssertionError(
+                f"kernel dispatch seam drifted: {len(sigs)} distinct "
+                f"{name} signatures across mode flips (expected 1)")
+    if log.hazards():
+        raise AssertionError(
+            f"kernel dispatch weak-type drift: {log.hazards()}")
+
+    def passes(Xb, wv, rv):
+        with K.scope("on"):
+            return M.matvec(Xb, wv), M.rmatvec(Xb, rv)
+
+    return passes, (X, w, r)

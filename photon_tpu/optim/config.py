@@ -39,6 +39,15 @@ class OptimizerConfig:
     # Wolfe search vets it as usual (quality pinned by
     # tests/test_lane_solver.py::test_lane_grid_bf16_history_quality).
     lane_history_dtype: str | None = None
+    # Pallas-kernel dispatch for the blocked-ELL X passes
+    # (photon_tpu/kernels): "on" forces the fused kernels (interpret mode
+    # off-TPU — the parity-test regime), "off" forces the XLA path,
+    # "auto" enables them on a TPU backend only. None (default) inherits
+    # the process-wide PHOTON_TPU_KERNELS env knob. A per-solve value
+    # that FLIPS the effective mode clears jit caches on entry/exit (the
+    # dispatch branch is a trace-time fact) — set the env knob for
+    # steady-state use and this field for explicit A/B.
+    kernels: str | None = None
 
     def effective_optimizer(self) -> OptimizerType:
         """The reference forces OWLQN whenever an L1 term is present."""

@@ -84,22 +84,58 @@ __all__ = ["minimize_lbfgs_streamed", "minimize_owlqn_streamed"]
 # Every numeric step is a module-level jitted program (cached by shape), so
 # the host loop costs dispatches, not retraces. Objective/GLMBatch are
 # registered pytrees; host numpy chunk leaves device-put on call.
+#
+# DONATION (the upload/compute-overlap round): each chunk-consuming
+# program has a `_don`-suffixed twin that DONATES its feature-chunk
+# argument — the chunk's buffers are consumed by the call (scalar leaves
+# alias outputs where shapes allow, the rest free at dispatch instead of
+# at the host loop's next refcount drop), which is what lets the
+# persistent `DeviceChunkRing` keep next-pass uploads in flight without a
+# third chunk copy ever going resident. The backends pick the donated
+# twin whenever the chunk has no cross-chunk shared leaves (`_donatable`
+# — the mesh blocked-ELL ladder shares ONE replicated column permutation
+# across chunks, so it keeps the plain programs). Donation never changes
+# the traced program or its signature — the
+# `mesh_stream_donated_no_retrace` contract pins that the ring's
+# rotating dispatches stay ONE signature.
 
 
-@jax.jit
-def _chunk_init(obj, w, batch):
+# Partial non-aliasability is the donation DESIGN here: a chunk's scalar
+# leaves (y/weights/offsets ↔ margins) alias outputs, its feature blocks
+# cannot (different shapes) and instead free at dispatch — jax would
+# otherwise warn "Some donated buffers were not usable" once per
+# compiled chunk shape for exactly the blocks we donate for early-free.
+import warnings as _warnings  # noqa: E402
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def _chunk_init_fn(obj, w, batch):
     return obj.chunk_value_grad_partials(w, batch)
 
 
-@jax.jit
-def _chunk_grad_at_margin(obj, z, batch):
+def _chunk_grad_fn(obj, z, batch):
     return obj.chunk_partials_at_margin(z, batch)
 
 
-@jax.jit
-def _chunk_dz_phi(obj, p, z, a, batch):
+def _chunk_dz_phi_fn(obj, p, z, a, batch):
     dz = obj.direction_margin(p, batch)
     return dz, obj.chunk_phi_partials(z, dz, a, batch.y, batch.weights)
+
+
+def _chunk_value_many_fn(obj, W, batch):
+    return obj.chunk_value_partials_many(W, batch)
+
+
+_chunk_init = jax.jit(_chunk_init_fn)
+_chunk_init_don = jax.jit(_chunk_init_fn, donate_argnums=(2,))
+_chunk_grad_at_margin = jax.jit(_chunk_grad_fn)
+_chunk_grad_at_margin_don = jax.jit(_chunk_grad_fn, donate_argnums=(2,))
+_chunk_dz_phi = jax.jit(_chunk_dz_phi_fn)
+_chunk_dz_phi_don = jax.jit(_chunk_dz_phi_fn, donate_argnums=(4,))
+_chunk_value_many = jax.jit(_chunk_value_many_fn)
+_chunk_value_many_don = jax.jit(_chunk_value_many_fn, donate_argnums=(2,))
 
 
 @jax.jit
@@ -108,18 +144,24 @@ def _chunk_phi(obj, z, dz, a, y, weights):
 
 
 @jax.jit
-def _chunk_value_many(obj, W, batch):
-    return obj.chunk_value_partials_many(W, batch)
-
-
-@jax.jit
 def _finish(obj, w, partials):
     return obj.finish_value_grad(w, partials)
 
 
-@jax.jit
-def _acc(a, b):
-    return jax.tree_util.tree_map(jnp.add, a, b)
+# The cross-chunk partial accumulator donates its running total: the
+# (value, (d,)-gradient[, gsum]) tree updates IN PLACE instead of
+# allocating a fresh tree per chunk — on a mesh that is the stacked
+# (n_slots, d) gradient block every chunk of every evaluation.
+_acc = jax.jit(lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
+               donate_argnums=(0,))
+
+
+def _donatable(c0) -> bool:
+    """Whether a chunk ladder's device chunks may be donated to their
+    compute program: True unless chunks share device buffers (the mesh
+    blocked-ELL ladder replicates ONE column permutation across all
+    chunks of a solve — donating chunk 0 would invalidate chunk 1)."""
+    return not isinstance(c0, ShardedBlockedEllRows)
 
 
 @jax.jit
@@ -247,7 +289,6 @@ class _MeshChunkOps:
         def stack(parts):
             return jax.tree_util.tree_map(lambda x: x[None], parts)
 
-        @jax.jit
         def chunk_init(obj, w, b):
             def body(obj, w, b):
                 z, parts = obj.chunk_value_grad_partials(w, lview(b))
@@ -257,7 +298,6 @@ class _MeshChunkOps:
                              in_specs=(ospec(obj), rep, bspec(b)),
                              out_specs=(row, pspec(obj)))(obj, w, b)
 
-        @jax.jit
         def chunk_grad(obj, z, b):
             def body(obj, z, b):
                 return stack(obj.chunk_partials_at_margin(z, lview(b)))
@@ -266,7 +306,6 @@ class _MeshChunkOps:
                              in_specs=(ospec(obj), row, bspec(b)),
                              out_specs=pspec(obj))(obj, z, b)
 
-        @jax.jit
         def chunk_dz_phi(obj, p, z, a, b):
             def body(obj, p, z, a, b):
                 bl = lview(b)
@@ -288,7 +327,6 @@ class _MeshChunkOps:
                              in_specs=(ospec(obj), row, row, rep, row, row),
                              out_specs=(row, row))(obj, z, dz, a, y, wt)
 
-        @jax.jit
         def chunk_value_many(obj, W, b):
             def body(obj, W, b):
                 return obj.chunk_value_partials_many(W, lview(b))[None]
@@ -296,6 +334,19 @@ class _MeshChunkOps:
             return shard_map(body, mesh=mesh,
                              in_specs=(ospec(obj), rep, bspec(b)),
                              out_specs=row)(obj, W, b)
+
+        # donated twins consume their feature-chunk argument (see the
+        # module-level donation note) — picked by _MeshStream when the
+        # ladder's chunks share no device buffers
+        self.chunk_init_don = jax.jit(chunk_init, donate_argnums=(2,))
+        self.chunk_grad_don = jax.jit(chunk_grad, donate_argnums=(2,))
+        self.chunk_dz_phi_don = jax.jit(chunk_dz_phi, donate_argnums=(4,))
+        self.chunk_value_many_don = jax.jit(chunk_value_many,
+                                            donate_argnums=(2,))
+        chunk_init = jax.jit(chunk_init)
+        chunk_grad = jax.jit(chunk_grad)
+        chunk_dz_phi = jax.jit(chunk_dz_phi)
+        chunk_value_many = jax.jit(chunk_value_many)
 
         @jax.jit
         def finish(obj, w, parts):
@@ -353,6 +404,17 @@ class _SingleDeviceStream:
                          "chunk_grad": _chunk_grad_at_margin,
                          "chunk_dz_phi": _chunk_dz_phi,
                          "chunk_value_many": _chunk_value_many}
+        # the persistent two-deep upload ring + donated chunk programs
+        # (the upload/compute-overlap round — see DeviceChunkRing and the
+        # module-level donation note)
+        self.ring = data.device_ring(prefetch=prefetch)
+        self.donate = _donatable(data.X.chunks[0])
+        self._init = _chunk_init_don if self.donate else _chunk_init
+        self._grad = (_chunk_grad_at_margin_don if self.donate
+                      else _chunk_grad_at_margin)
+        self._dz_phi = _chunk_dz_phi_don if self.donate else _chunk_dz_phi
+        self._value_many = (_chunk_value_many_don if self.donate
+                            else _chunk_value_many)
 
     def note(self, name, *args):
         """Static-cost registration (trace-only, once per attached
@@ -371,17 +433,17 @@ class _SingleDeviceStream:
                                (obj, z, dz, np.float32(a), b.y, b.weights))
 
     def iter_chunks(self):
-        return self.data.iter_device(prefetch=self.prefetch)
+        return self.ring.stream_pass()
 
     def chunk_init(self, obj, w, b):
-        z, parts = _chunk_init(obj, w, b)
+        z, parts = self._init(obj, w, b)
         return np.asarray(z), parts
 
     def chunk_grad(self, obj, z, b):
-        return _chunk_grad_at_margin(obj, z, b)
+        return self._grad(obj, z, b)
 
     def chunk_dz_phi(self, obj, p, z, a, b):
-        dz, wlwd = _chunk_dz_phi(obj, p, z, np.float32(a), b)
+        dz, wlwd = self._dz_phi(obj, p, z, np.float32(a), b)
         return np.asarray(dz), wlwd
 
     def chunk_phi(self, obj, i, z, dz, a):
@@ -389,7 +451,7 @@ class _SingleDeviceStream:
         return _chunk_phi(obj, z, dz, np.float32(a), b.y, b.weights)
 
     def chunk_value_many(self, obj, W, b):
-        return _chunk_value_many(obj, W, b)
+        return self._value_many(obj, W, b)
 
     def finish(self, obj, w, acc):
         return _finish(obj, w, acc)
@@ -420,6 +482,18 @@ class _MeshStream:
                          "chunk_grad": self.ops.chunk_grad,
                          "chunk_dz_phi": self.ops.chunk_dz_phi,
                          "chunk_value_many": self.ops.chunk_value_many}
+        # persistent ring (next-pass uploads overlap this pass's finish
+        # psum + readback; the replicated ladder permutation uploads once
+        # per solve) + donated chunk programs where chunks share nothing
+        self.ring = data.device_ring(mesh=mesh, prefetch=prefetch)
+        self.donate = _donatable(data.X.chunks[0])
+        ops = self.ops
+        self._init = ops.chunk_init_don if self.donate else ops.chunk_init
+        self._grad = ops.chunk_grad_don if self.donate else ops.chunk_grad
+        self._dz_phi = (ops.chunk_dz_phi_don if self.donate
+                        else ops.chunk_dz_phi)
+        self._value_many = (ops.chunk_value_many_don if self.donate
+                            else ops.chunk_value_many)
 
     def note(self, name, *args):
         """Mesh face of `_SingleDeviceStream.note`: margin caches live
@@ -444,7 +518,7 @@ class _MeshStream:
             (obj, self._put(z), self._put(dz), np.float32(a), y, wt))
 
     def iter_chunks(self):
-        return self.data.iter_device(mesh=self.mesh, prefetch=self.prefetch)
+        return self.ring.stream_pass()
 
     def _fetch(self, arr):
         from photon_tpu.parallel.mesh import fetch_local_rows
@@ -457,15 +531,14 @@ class _MeshStream:
         return shard_local_rows(local, self.mesh)
 
     def chunk_init(self, obj, w, b):
-        z, parts = self.ops.chunk_init(obj, w, b)
+        z, parts = self._init(obj, w, b)
         return self._fetch(z), parts
 
     def chunk_grad(self, obj, z, b):
-        return self.ops.chunk_grad(obj, self._put(z), b)
+        return self._grad(obj, self._put(z), b)
 
     def chunk_dz_phi(self, obj, p, z, a, b):
-        dz, wlwd = self.ops.chunk_dz_phi(obj, p, self._put(z),
-                                         np.float32(a), b)
+        dz, wlwd = self._dz_phi(obj, p, self._put(z), np.float32(a), b)
         return self._fetch(dz), wlwd
 
     def chunk_phi(self, obj, i, z, dz, a):
@@ -474,7 +547,7 @@ class _MeshStream:
                                   np.float32(a), y, wt)
 
     def chunk_value_many(self, obj, W, b):
-        return self.ops.chunk_value_many(obj, W, b)
+        return self._value_many(obj, W, b)
 
     def finish(self, obj, w, acc):
         return self.ops.finish(obj, w, acc)
@@ -761,6 +834,7 @@ def minimize_lbfgs_streamed(
     max_ls_evals: int = 12,
     mesh=None,
     prefetch=2,
+    kernels=None,
 ) -> OptResult:
     """L-BFGS whose value+gradient accumulate over streamed device chunks —
     the treeAggregate-per-iteration execution regime, same math and same
@@ -777,9 +851,17 @@ def minimize_lbfgs_streamed(
     per solver iteration (loss/grad_norm/step/trials — the live face of
     `OptResult.loss_history`), plus feature-stream / evaluation /
     line-search / margin-cache counters (photon_tpu.telemetry; no-ops
-    without an attached Run)."""
+    without an attached Run).
+
+    ``kernels``: the Pallas-kernel three-state knob ("on"/"off"/"auto";
+    None inherits the PHOTON_TPU_KERNELS env default) scoped over every
+    chunk program of this solve — blocked-ELL chunk ladders then run
+    their X passes through photon_tpu/kernels inside each jitted chunk
+    program."""
+    from photon_tpu import kernels as _kernels
+
     with telemetry.span("solve.lbfgs_streamed", mesh=mesh is not None,
-                        n_chunks=data.n_chunks):
+                        n_chunks=data.n_chunks), _kernels.scope(kernels):
         return _lbfgs_streamed(obj, data, w0, max_iters, tolerance,
                                history, max_ls_evals, mesh, prefetch)
 
@@ -990,6 +1072,7 @@ def minimize_owlqn_streamed(
     ladder_lanes: int = 8,
     mesh=None,
     prefetch=2,
+    kernels=None,
 ) -> OptResult:
     """OWL-QN over streamed chunks (``prefetch``: int window or an
     `data.ingest_plane.AdaptivePrefetch` controller, as in the streamed
@@ -1003,9 +1086,12 @@ def minimize_owlqn_streamed(
 
     Telemetry mirrors the streamed L-BFGS: live `iteration` events plus
     feature-stream / evaluation / ladder-trial counters from the host
-    driver loop (no-ops without an attached Run)."""
+    driver loop (no-ops without an attached Run). ``kernels`` scopes the
+    Pallas-kernel knob over the solve as in `minimize_lbfgs_streamed`."""
+    from photon_tpu import kernels as _kernels
+
     with telemetry.span("solve.owlqn_streamed", mesh=mesh is not None,
-                        n_chunks=data.n_chunks):
+                        n_chunks=data.n_chunks), _kernels.scope(kernels):
         return _owlqn_streamed(obj, data, w0, l1_weight, max_iters,
                                tolerance, history, max_ls_evals, reg_mask,
                                ladder_lanes, mesh, prefetch)
@@ -1267,6 +1353,42 @@ def _contract_streamed_mesh_blocked_ell_chunk_partials():
     obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.4))
     return (lambda o, wv, b: ops.chunk_init(o, wv, b)), \
         (obj, jnp.zeros((d,), jnp.float32), batch)
+
+
+@register_contract(
+    name="mesh_stream_donated_no_retrace",
+    description="the donated double-buffer upload ring is signature-"
+                "stable: rotating the DeviceChunkRing across passes "
+                "(wraparound included) dispatches the chunk-partial "
+                "program with ONE argument signature — the builder "
+                "drains two full passes through TraceSignatureLog and "
+                "raises on divergence or weak-type drift, so donation + "
+                "ring rotation never retrace — and the program itself "
+                "stays communication-free",
+    collectives={}, tags=("streamed",))
+def _contract_donated_ring_no_retrace():
+    from photon_tpu.analysis.rules import TraceSignatureLog
+    from photon_tpu.data.dataset import chunk_batch
+
+    obj, w, batch = _contract_problem(d=6)
+    cb = chunk_batch(batch, chunk_rows=8)  # 16 rows -> 2 chunks
+    ring = cb.device_ring(prefetch=2)
+    log = TraceSignatureLog()
+    first = None
+    for _ in range(2):  # two passes: the ring wraps across the boundary
+        for i, b in ring.stream_pass():
+            log.record("streamed.chunk_init", (obj, w, b))
+            if first is None:
+                first = b
+    sigs = log.signatures("streamed.chunk_init")
+    if len(sigs) != 1:
+        raise AssertionError(
+            f"donated ring dispatch drifted: {len(sigs)} distinct "
+            "chunk-program signatures across ring rotations (expected 1)")
+    if log.hazards():
+        raise AssertionError(
+            f"donated ring weak-type drift: {log.hazards()}")
+    return _chunk_init_fn, (obj, w, first)
 
 
 @register_contract(

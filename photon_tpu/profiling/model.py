@@ -32,6 +32,18 @@ Two deliberate conventions:
   floor: a 4-byte scalar gather still moves a granule), tallied into
   ``StaticCost.gather_bytes`` so the attribution report can show the
   irregular-access share of a sparse program's roofline.
+- **Dot operands are costed at their STORAGE width** (round 15). A
+  quantized program dequantizes in-program (``int8 → f32`` convert +
+  scale multiply, fused by XLA into the dot), so the dot's operand aval
+  says f32 while HBM really streamed 1 byte/element — the aval-width
+  proxy would claim the quantized rungs moved 4× their true bytes and
+  their roofline intensity would read 4× too low. `estimate_jaxpr`
+  therefore tracks each value's PROVENANCE through
+  ``convert_element_type`` / broadcast / scale-multiply chains and
+  charges every ``dot_general`` operand at the narrowest source dtype
+  it was widened from; the narrowing is tallied into
+  ``StaticCost.narrowed_bytes`` so the report can say how much of a
+  program's traffic the quantization actually removed.
 """
 from __future__ import annotations
 
@@ -173,6 +185,11 @@ class StaticCost:
     # random-access traffic of gather/scatter slices (granule-rounded;
     # included in `bytes`) — the sparse-program share of the roofline
     gather_bytes: float = 0.0
+    # bytes REMOVED from the charge by storage-width provenance: dot
+    # operands that were widened in-program (int8/bf16 dequant chains)
+    # cost their narrow storage width, and this tallies the difference —
+    # the quantized-rung share of the roofline story
+    narrowed_bytes: float = 0.0
     eqns: int = 0
     while_loops: int = 0
     while_trips_assumed: int = 1  # the hint applied to un-lengthed loops
@@ -195,7 +212,8 @@ class StaticCost:
             "collective_bytes": self.collective_bytes,
             "transcendentals": self.transcendentals,
             "dot_flops": self.dot_flops,
-            "gather_bytes": self.gather_bytes, "eqns": self.eqns,
+            "gather_bytes": self.gather_bytes,
+            "narrowed_bytes": self.narrowed_bytes, "eqns": self.eqns,
             "while_loops": self.while_loops,
             "while_trips_assumed": self.while_trips_assumed,
             "intensity": round(self.intensity, 4),
@@ -203,15 +221,56 @@ class StaticCost:
         }
 
 
+# Ops through which a value's STORAGE width propagates unchanged — the
+# dequant chain (convert + broadcast + scale-multiply) a quantized dot
+# rides. `mul`/`div` take the narrowest array operand (q·scale keeps q's
+# width: the scale was never the streamed operand).
+_STORAGE_TRANSPARENT = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "rev", "copy",
+})
+_STORAGE_COMBINING = frozenset({"mul", "div"})
+
+
+def _itemsize(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "dtype"):
+        return 0
+    return np.dtype(aval.dtype).itemsize
+
+
 def estimate_jaxpr(jaxpr, while_trips: int = 1) -> StaticCost:
     """Walk a (Closed)Jaxpr and accumulate the modeled cost. ``while_
     trips`` is the per-`while` trip-count hint (e.g. a solver's
     max_iters); `scan` lengths come from the IR itself."""
     cost = StaticCost(while_trips_assumed=int(while_trips))
+    # var -> storage itemsize where NARROWER than the aval width (the
+    # round-15 dtype-aware operand rule; see the module docstring)
+    storage_env: dict = {}
+
+    def _storage(v) -> int:
+        try:
+            return storage_env.get(v, _itemsize(v))
+        except TypeError:  # unhashable (literals): aval width
+            return _itemsize(v)
 
     def walk(j, mult: float) -> None:
         for eqn in as_jaxpr(j).eqns:
             name = eqn.primitive.name
+            if name == "convert_element_type" and eqn.invars:
+                src = _storage(eqn.invars[0])
+                if src and src < _itemsize(eqn.outvars[0]):
+                    storage_env[eqn.outvars[0]] = src
+            elif name in _STORAGE_TRANSPARENT and eqn.invars:
+                src = _storage(eqn.invars[0])
+                if src and src < _itemsize(eqn.outvars[0]):
+                    storage_env[eqn.outvars[0]] = src
+            elif name in _STORAGE_COMBINING and len(eqn.invars) == 2:
+                src = min(s for s in (_storage(eqn.invars[0]),
+                                      _storage(eqn.invars[1])) if s) \
+                    if any((_storage(v) for v in eqn.invars)) else 0
+                if src and src < _itemsize(eqn.outvars[0]):
+                    storage_env[eqn.outvars[0]] = src
             subs = list(sub_jaxprs(eqn))
             if subs:
                 # call eqns are containers: cost only their leaves
@@ -231,7 +290,13 @@ def estimate_jaxpr(jaxpr, while_trips: int = 1) -> StaticCost:
                 f = _dot_general_flops(eqn)
                 cost.dot_flops += mult * f
                 cost.flops += mult * f
-                cost.bytes += mult * io_bytes
+                # operands charge their STORAGE width (a fused dequant's
+                # int8 source, not the widened f32 aval) — round 15
+                op_bytes = (sum(_numel(v) * (_storage(v) or _itemsize(v))
+                                for v in eqn.invars)
+                            + sum(_aval_bytes(v) for v in eqn.outvars))
+                cost.narrowed_bytes += mult * max(io_bytes - op_bytes, 0)
+                cost.bytes += mult * op_bytes
             elif name in _ELEMENTWISE:
                 n = max((_numel(v) for v in eqn.outvars), default=0)
                 cost.flops += mult * n

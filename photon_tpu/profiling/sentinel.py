@@ -64,9 +64,11 @@ SCHEMA_VERSION = 2
 # else is a rate/score where higher is better. "shed": the serving_slo
 # overload legs — a rising shed percentage at the SAME offered rate means
 # the tier got slower, a real regression (the shed-vs-queue TRADE is
-# by design; its cost moving is not).
+# by design; its cost moving is not). "maxdiff": the quantized rungs'
+# measured probe-margin delta — a louder quantization is a quality
+# regression even when QPS holds.
 _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
-                         "stall", "shed")
+                         "stall", "shed", "maxdiff")
 
 # Config-ish / count legs that are not performance quantities: a changed
 # topology, cadence, or layout split must not read as a "regression".

@@ -32,12 +32,14 @@ parity tests/test_serving.py pins.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional
 
 import numpy as np
 
+from photon_tpu import telemetry
 from photon_tpu.analysis.rules import TraceSignatureLog
-from photon_tpu.data.matrix import SparseRows, next_pow2
+from photon_tpu.data.matrix import SparseRows, next_pow2, quantize_blocks
 from photon_tpu.game.model import score_rows
 from photon_tpu.ops.losses import mean_fn
 from photon_tpu.serving.store import CoefficientStore
@@ -58,16 +60,43 @@ class ShardSpec:
     sparse_k: Optional[int] = None
 
 
-def _build_score_fn(coords: tuple, task, output_mean: bool):
+class QuantizationRefused(RuntimeError):
+    """A quantized rung's warmup accuracy gate breached its epsilon: the
+    quantized ladder does NOT serve (mirroring `continual.SwapRefused` —
+    a rung whose margins moved past the configured bound never reaches
+    traffic). Carries the measured report for the operator."""
+
+    def __init__(self, report: dict):
+        super().__init__(
+            f"quantized serving rung refused: probe margin max |Δ| "
+            f"{report['max_abs_diff']:.6g} over {report['n_probes']} rows "
+            f"exceeds epsilon {report['epsilon']:.6g} "
+            f"(mode={report['mode']})")
+        self.report = report
+
+
+def _build_score_fn(coords: tuple, task, output_mean: bool,
+                    quantize: Optional[str] = None):
     """The per-bucket scoring program, closed over STRUCTURE only (names,
-    routing, task); every array — including the coefficient blocks — is
-    an argument, so a coefficient hot-swap reuses the same executable.
+    routing, task, quantization MODE); every array — including the
+    coefficient blocks — is an argument, so a coefficient hot-swap reuses
+    the same executable.
 
     coords: ((name, kind, feature_shard), ...) in the GameModel's
     coordinate order, kind ∈ {"fixed", "random"} — contributions sum in
     exactly this order, which is what keeps serving scores bit-identical
     to the offline driver's `score_game` sum.
+
+    With ``quantize`` the coefficient arguments are the quantized forms
+    (`data.matrix.quantize_blocks`): int8 blocks gather at 1 B/element
+    and the row-wise dequant (``q·scale``) FUSES into the margin matvec /
+    gather-dot inside this one jitted program — the f32 coefficients
+    never materialize in HBM; bf16 blocks upcast in registers the same
+    way. The cold-miss row dequantizes to exact zeros by construction
+    (all-zero rows quantize at scale 1.0).
     """
+    import jax.numpy as jnp
+
     from photon_tpu.data.matrix import matvec
 
     mean = mean_fn(task)
@@ -76,12 +105,26 @@ def _build_score_fn(coords: tuple, task, output_mean: bool):
         margin = offsets
         for name, kind, shard in coords:
             if kind == "fixed":
-                margin = margin + matvec(shards[shard], fixed_ws[name])
+                wq = fixed_ws[name]
+                if quantize == "int8":
+                    q, s = wq
+                    wq = q.astype(jnp.float32) * s
+                elif quantize == "bf16":
+                    wq = wq.astype(jnp.float32)
+                margin = margin + matvec(shards[shard], wq)
             else:
                 # (E+1, d) flat block: row E is the zero cold-miss row,
                 # so the gather itself IS the graceful degradation.
-                margin = margin + score_rows(shards[shard],
-                                             re_cs[name][ids[name]])
+                cq = re_cs[name]
+                if quantize == "int8":
+                    q, s = cq
+                    rows = (q[ids[name]].astype(jnp.float32)
+                            * s[ids[name]][:, None])
+                elif quantize == "bf16":
+                    rows = cq[ids[name]].astype(jnp.float32)
+                else:
+                    rows = cq[ids[name]]
+                margin = margin + score_rows(shards[shard], rows)
         return mean(margin) if output_mean else margin
 
     return score
@@ -109,9 +152,19 @@ class ProgramLadder:
                  output_mean: bool = True,
                  aot_dir: Optional[str] = None,
                  model_tag: str = "model",
-                 ladder: Optional[tuple] = None):
+                 ladder: Optional[tuple] = None,
+                 quantize: Optional[str] = None,
+                 quant_epsilon: float = 0.05):
         import jax
 
+        if quantize not in (None, "int8", "bf16"):
+            raise ValueError(
+                f"quantize must be None, 'int8' or 'bf16', got {quantize!r}")
+        self.quantize = quantize
+        self.quant_epsilon = float(quant_epsilon)
+        self.quant_report: Optional[dict] = None
+        self._qdev = None  # (f32-generation token, quantized device blocks)
+        self._qlock = threading.Lock()
         self.store = store
         self.output_mean = bool(output_mean)
         self.model_tag = model_tag
@@ -138,8 +191,17 @@ class ProgramLadder:
             if name in store.fixed
             else (name, "random", store.random[name].feature_shard)
             for name in store.order)
-        self._fn = _build_score_fn(coords, store.task, self.output_mean)
+        self._fn = _build_score_fn(coords, store.task, self.output_mean,
+                                   quantize=self.quantize)
         self._jit = jax.jit(self._fn)
+        if self.quantize is not None:
+            # the warmup accuracy gate scores MARGINS both ways (the link
+            # function would compress honest deltas near saturation)
+            self._gate_f32 = jax.jit(_build_score_fn(coords, store.task,
+                                                     False))
+            self._gate_quant = jax.jit(_build_score_fn(coords, store.task,
+                                                       False,
+                                                       quantize=self.quantize))
         self._aot = None
         if aot_dir is not None:
             from photon_tpu.utils.aot import AotStore
@@ -165,7 +227,77 @@ class ProgramLadder:
 
     # ------------------------------------------------------------- programs
     def _key(self, bucket: int) -> str:
-        return f"serving/{self.model_tag}@B{bucket}"
+        tag = (self.model_tag if self.quantize is None
+               else f"{self.model_tag}:{self.quantize}")
+        return f"serving/{tag}@B{bucket}"
+
+    def _quant_blocks(self) -> tuple:
+        """(fixed_ws, re_cs) in this ladder's quantized form, computed
+        row-wise at store load (`data.matrix.quantize_blocks`) and cached
+        per coefficient GENERATION: a `reload_coefficients` hot-swap
+        swings `device_blocks()` to a new tuple, which invalidates this
+        cache — the next dispatch re-quantizes the new model (same
+        shapes, so the rung executables replay untouched)."""
+        import jax
+
+        token = self.store.device_blocks()  # ONE generation, atomically
+        with self._qlock:
+            if self._qdev is not None and self._qdev[0] is token:
+                return self._qdev[1]
+            fixed_q: dict = {}
+            for n, blk in self.store.fixed.items():
+                q, s = quantize_blocks(np.asarray(blk.weights, np.float32),
+                                       self.quantize)
+                fixed_q[n] = (jax.device_put(q) if s is None
+                              else (jax.device_put(q), np.float32(s)))
+            re_q: dict = {}
+            for n, blk in self.store.random.items():
+                q, s = quantize_blocks(
+                    np.asarray(blk.coefficients, np.float32), self.quantize)
+                re_q[n] = (jax.device_put(q) if s is None
+                           else (jax.device_put(q), jax.device_put(s)))
+            blocks = (fixed_q, re_q)
+            self._qdev = (token, blocks)
+            return blocks
+
+    def _coefficient_args(self) -> tuple:
+        return (self.store.device_blocks() if self.quantize is None
+                else self._quant_blocks())
+
+    def _quant_gate(self) -> dict:
+        """The measured accuracy gate (warmup refuses on breach): margins
+        of a deterministic probe batch — every entity cycled through,
+        cold-miss row included, N(0,1) rows per shard — through the f32
+        and quantized programs; the worst |Δ| must sit within
+        ``quant_epsilon`` (the `continual.swap.parity_probe` discipline,
+        applied to the quantization instead of a refresh)."""
+        B = self.ladder[0]
+        rng = np.random.default_rng(0)
+        shards = {}
+        for s, spec in self.shard_specs.items():
+            if spec.sparse_k is None:
+                shards[s] = rng.normal(size=(B, spec.d)).astype(np.float32)
+            else:
+                shards[s] = SparseRows(
+                    rng.integers(0, spec.d, size=(B, spec.sparse_k)).astype(
+                        np.int32),
+                    rng.normal(size=(B, spec.sparse_k)).astype(np.float32),
+                    spec.d)
+        ids = {name: (np.arange(B, dtype=np.int64)
+                      % (self.store.n_entities(name) + 1)).astype(np.int32)
+               for name in self.store.random}
+        offsets = np.zeros(B, np.float32)
+        fixed_ws, re_cs = self.store.device_blocks()
+        m32 = np.asarray(self._gate_f32(offsets, shards, ids, fixed_ws,
+                                        re_cs), np.float64)
+        qf, qr = self._quant_blocks()
+        mq = np.asarray(self._gate_quant(offsets, shards, ids, qf, qr),
+                        np.float64)
+        report = {"mode": self.quantize, "n_probes": int(B),
+                  "max_abs_diff": float(np.max(np.abs(m32 - mq))),
+                  "epsilon": self.quant_epsilon}
+        self.quant_report = report
+        return report
 
     def example_args(self, bucket: int) -> tuple:
         """Zero-filled arguments at one rung's exact signature (warmup +
@@ -181,7 +313,7 @@ class ProgramLadder:
                     np.zeros((B, spec.sparse_k), np.float32), spec.d)
         ids = {name: np.full(B, self.store.n_entities(name), np.int32)
                for name in self.store.random}
-        fixed_ws, re_cs = self.store.device_blocks()
+        fixed_ws, re_cs = self._coefficient_args()
         return (np.zeros(B, np.float32), shards, ids, fixed_ws, re_cs)
 
     def score_padded(self, offsets, shards: dict, ids: dict):
@@ -192,7 +324,7 @@ class ProgramLadder:
         if B not in self.ladder:
             raise ValueError(f"padded batch of {B} is not a ladder rung "
                              f"{self.ladder}")
-        fixed_ws, re_cs = self.store.device_blocks()
+        fixed_ws, re_cs = self._coefficient_args()
         args = (offsets, shards, ids, fixed_ws, re_cs)
         self.signature_log.record("serving.score", args)
         if self._aot is not None:
@@ -202,7 +334,17 @@ class ProgramLadder:
     def warmup(self) -> int:
         """Pre-load/compile every rung's program (serving startup): with
         an AotStore, `AotStore.warmup` replays or exports each entry; a
-        jit-only ladder runs each rung once. Returns rungs warmed."""
+        jit-only ladder runs each rung once. Returns rungs warmed.
+
+        A QUANTIZED ladder gates first: the measured probe margin delta
+        vs the f32 program must sit within ``quant_epsilon``, else
+        `QuantizationRefused` (counted on ``serving.quant_refusals``) —
+        an unacceptably lossy quantization never warms, never serves."""
+        if self.quantize is not None:
+            report = self._quant_gate()
+            if report["max_abs_diff"] > report["epsilon"]:
+                telemetry.count("serving.quant_refusals")
+                raise QuantizationRefused(report)
         entries = [(self._key(B), self._fn, self.example_args(B))
                    for B in self.ladder]
         if self._aot is not None:
@@ -268,6 +410,39 @@ def _contract_serving_request():
     ladder = ProgramLadder(_tiny_store(), ladder=(8,), sparse_k={"member": 3},
                            output_mean=True)
     args = ladder.example_args(8)
+    return ladder._fn, args
+
+
+@register_contract(
+    name="serving_quantized_rung_invariance",
+    description="one QUANTIZED serving rung (int8 blocks + row-wise "
+                "scales as arguments, dequant fused into the margin "
+                "matvec): the same zero-collective / zero-host-exit / "
+                "no-f64 law as the f32 rungs, and program INVARIANCE — "
+                "the builder swaps coefficient values (a hot-swap's "
+                "re-quantization) and raises if the rung's dispatch "
+                "signature moves, so a model push never retraces a "
+                "quantized ladder",
+    collectives={}, tags=("serving", "kernels"))
+def _contract_serving_quantized_rung():
+    ladder = ProgramLadder(_tiny_store(), ladder=(8,),
+                           sparse_k={"member": 3}, output_mean=True,
+                           quantize="int8")
+    args = ladder.example_args(8)
+    log = TraceSignatureLog()
+    log.record("serving.quant_rung", args)
+    # a hot-swap re-quantizes NEW values into the SAME shapes: the rung
+    # signature must not move (same-structure store, fresh arrays)
+    ladder.store.reload_coefficients(_tiny_store())
+    log.record("serving.quant_rung", ladder.example_args(8))
+    sigs = log.signatures("serving.quant_rung")
+    if len(sigs) != 1:
+        raise AssertionError(
+            f"quantized rung dispatch drifted across a coefficient "
+            f"reload: {len(sigs)} signatures (expected 1)")
+    if log.hazards():
+        raise AssertionError(
+            f"quantized rung weak-type drift: {log.hazards()}")
     return ladder._fn, args
 
 

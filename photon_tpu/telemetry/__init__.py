@@ -32,7 +32,9 @@ scorer, chunked_fit_points from the estimator, and pod_scale_runs from
 the training driver; the online serving tier's
 `serving.*` family — requests/batches/batch_rows/pad_waste/cold_misses/
 hot_swaps counters (pad_waste is shared with the offline chunked scorer;
-hot_swaps counts `CoefficientStore.reload_coefficients` cutovers), the
+hot_swaps counts `CoefficientStore.reload_coefficients` cutovers),
+quant_refusals (a quantized ProgramLadder's warmup accuracy gate
+breached its epsilon — the ladder refused to serve), the
 overload-round admission counters admitted/shed/deadline_expired
 (admitted = entered the queue; shed = watermark or bounded-submit
 drops; deadline_expired = admitted but dropped before a batch slot —

@@ -24,16 +24,42 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 40 specs (round 14 added the ingest plane's chunk-program
-    invariance: worker-pool / cache-round-tripped chunks dispatch the
-    SAME streamed chunk program as in-process decode) spanning every
-    workload family."""
-    assert len(_REGISTRY) >= 40
+    """≥ 43 specs (round 15 added the roofline-closure pins: the Pallas
+    kernel X passes, the kernel-dispatch and donated-ring no-retrace
+    invariances, and the quantized serving rung) spanning every workload
+    family."""
+    assert len(_REGISTRY) >= 43
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
-                   "evaluation", "continual", "ingest"):
+                   "evaluation", "continual", "ingest", "kernels"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_roofline_closure_specs_are_registered():
+    """The round-15 acceptance pins, strict: the kernel-dispatched X
+    passes forbid the FULL scatter family and require f32 accumulation
+    (the walker descends into the pallas_call body, so the law holds
+    INSIDE the kernel); the two no-retrace invariances (kernel seam,
+    donated ring) and the quantized rung budget ZERO collectives with no
+    transfer/f64 escape hatch."""
+    from photon_tpu.analysis.walker import (SCATTER_ADD_PRIMITIVES,
+                                            SCATTER_PRIMITIVES)
+
+    spec = _REGISTRY["blocked_ell_kernel_x_passes"]
+    assert SCATTER_PRIMITIVES <= spec.forbid
+    assert SCATTER_ADD_PRIMITIVES <= spec.forbid
+    assert spec.require_f32_accum
+    assert not spec.allow_transfers and not spec.allow_f64
+    assert "kernels" in spec.tags
+    for name in ("blocked_ell_kernel_no_retrace",
+                 "mesh_stream_donated_no_retrace",
+                 "serving_quantized_rung_invariance"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}, name
+        assert not spec.allow_transfers and not spec.allow_f64, name
+    assert "serving" in _REGISTRY["serving_quantized_rung_invariance"].tags
+    assert "streamed" in _REGISTRY["mesh_stream_donated_no_retrace"].tags
 
 
 def test_ingest_plane_spec_is_registered():

@@ -366,6 +366,49 @@ class TestChunkCache:
             np.testing.assert_array_equal(ma, mb)
             np.testing.assert_array_equal(pa, pb)
 
+    def test_distributed_writer_convention(self, tmp_path):
+        """The multi-host cache directory convention (docs/INGEST.md):
+        p<k>_ payload prefixes, k>0 sidecars instead of manifests,
+        process 0 merging entries + metas and committing the ONE shared
+        manifest LAST; a missing sidecar fails loudly instead of
+        publishing a partial entry."""
+        from photon_tpu.data.chunk_cache import (ChunkCacheWriter,
+                                                 open_cache,
+                                                 shard_chunk_range)
+
+        key = "d" * 64
+        w1 = ChunkCacheWriter(tmp_path, key, "game_chunks",
+                              meta={"n_chunks": 1, "n_rows": 7},
+                              process=1, n_processes=2)
+        w1.add_array("c00001.y", np.arange(3.0))
+        w1.commit()
+        # no manifest yet: the entry is a MISS everywhere until process 0
+        assert open_cache(tmp_path, key, "game_chunks") is None
+        w0 = ChunkCacheWriter(tmp_path, key, "game_chunks",
+                              meta={"n_chunks": 1, "n_rows": 5},
+                              process=0, n_processes=2)
+        w0.add_array("c00000.y", np.arange(2.0))
+        w0.commit(sidecar_timeout_s=5)
+        bag = open_cache(tmp_path, key, "game_chunks")
+        assert sorted(bag.names()) == ["c00000.y", "c00001.y"]
+        assert bag.meta["n_chunks"] == 2 and bag.meta["n_rows"] == 12
+        np.testing.assert_array_equal(
+            np.asarray(bag.array("c00001.y")), np.arange(3.0))
+        files = sorted(os.listdir(w0.dir))
+        assert any(f.startswith("p0_") for f in files)
+        assert any(f.startswith("p1_") for f in files)
+        # process 0 with a never-arriving sidecar refuses to publish
+        key2 = "e" * 64
+        lone = ChunkCacheWriter(tmp_path, key2, "game_chunks",
+                                meta={}, process=0, n_processes=2)
+        lone.add_array("c00000.y", np.arange(2.0))
+        with pytest.raises(TimeoutError, match="sidecar"):
+            lone.commit(sidecar_timeout_s=0.2)
+        assert open_cache(tmp_path, key2, "game_chunks") is None
+        # the canonical split covers [0, n) contiguously in order
+        spans = [shard_chunk_range(10, k, 3) for k in range(3)]
+        assert spans == [(0, 4), (4, 7), (7, 10)]
+
 
 class TestLadderCache:
     @pytest.mark.parametrize("n_shards", [1, 2])

@@ -1,0 +1,391 @@
+"""Roofline-closure round (15): the Pallas kernel dispatch seam, the
+donated upload ring, and the quantized serving rungs.
+
+The load-bearing facts, each pinned bitwise where the design claims
+bitwise:
+
+- Pallas INTERPRET mode on this CPU backend reproduces the XLA
+  blocked-ELL X passes bit for bit — across every nnz width bucket the
+  pow2 ladder produces, empty buckets, non-dividing row counts, f32 and
+  bf16 storage, single-vector and lane-minor forms, and the squared
+  (Hessian-diagonal) rmatvec.
+- The dispatch seam (PHOTON_TPU_KERNELS / OptimizerConfig.kernels) is
+  pure routing: kernels-on solves equal kernels-off solves bitwise on
+  the resident AND streamed-chunk paths, fallbacks (no tail, VMEM
+  budget) never error, and mode flips never change call signatures.
+- The DeviceChunkRing rotates across passes in order, pre-arms the next
+  pass at exhaustion, and resets cleanly when a pass is abandoned — the
+  crash/kill path of the donated double-buffer round.
+- Quantized rungs: the warmup accuracy gate REFUSES a breach
+  (`QuantizationRefused`, counted), the cold-miss row dequantizes to
+  exact zeros (fixed-effect-only degradation is bit-identical to the
+  f32 ladder), and mixed-size quantized dispatch never retraces.
+"""
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu import kernels as K
+from photon_tpu.data import matrix as M
+from photon_tpu.data.dataset import (chunk_batch, chunk_blocked_ell,
+                                     make_batch)
+from photon_tpu.data.matrix import SparseRows, to_blocked_ell
+from photon_tpu.models.training import train_glm
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+
+pytestmark = pytest.mark.release_programs
+
+
+def _wide_bucket_problem(n=51, d=160, d_dense=8, seed=0, bf16=False):
+    """A blocked-ELL layout exercising MANY width buckets: row i carries
+    (i % 18) + 1 tail nnz on top of 2 hot columns, so the pow2 width
+    ladder spans 1/2/4/8/16/32 and n=51 divides nothing."""
+    rng = np.random.default_rng(seed)
+    rows_ind, rows_val = [], []
+    kmax = 21
+    for i in range(n):
+        tail = (i % 18) + 1
+        cols = rng.permutation(np.arange(2, d - 1))[:tail]  # distinct
+        ind = np.concatenate([[0, 1], cols, np.zeros(kmax - 2 - tail,
+                                                     np.int64)])
+        val = np.concatenate([rng.normal(size=2 + tail),
+                              np.zeros(kmax - 2 - tail)])
+        rows_ind.append(ind)
+        rows_val.append(val)
+    sp = SparseRows(np.asarray(rows_ind, np.int32),
+                    np.asarray(rows_val, np.float32), d)
+    X = to_blocked_ell(sp, d_dense)
+    if bf16:
+        bf = jnp.bfloat16
+        X = dataclasses.replace(
+            X, dense=jnp.asarray(X.dense).astype(bf),
+            ell_vals=tuple(jnp.asarray(v).astype(bf) for v in X.ell_vals),
+            bucket_vals=tuple(jnp.asarray(v).astype(bf)
+                              for v in X.bucket_vals))
+    return X
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("bf16", [False, True])
+    def test_full_bucket_matrix_bitwise(self, bf16):
+        """Every op, every width bucket, non-dividing rows: kernel == XLA
+        bit for bit."""
+        X = _wide_bucket_problem(bf16=bf16)
+        assert len(X.ell_vals) >= 4  # widths 1/2/4/8/16…: real coverage
+        n, d = X.shape
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        W = jnp.asarray(rng.normal(size=(d, 3)).astype(np.float32))
+        R = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+        cases = ((M.matvec, w), (M.rmatvec, r), (M.matvec_lanes, W),
+                 (M.rmatvec_lanes, R), (M.sq_rmatvec, r))
+        with K.scope("off"):
+            ref = [np.asarray(f(X, v)) for f, v in cases]
+        with K.scope("on"):
+            assert K.active()
+            got = [np.asarray(f(X, v)) for f, v in cases]
+        for (f, _), a, b in zip(cases, ref, got):
+            np.testing.assert_array_equal(a, b, err_msg=f.__name__)
+
+    def test_empty_bucket_fallback(self):
+        """A layout with no tail routes to the XLA path (nothing to
+        fuse) — same answer, no error."""
+        sp = SparseRows(np.zeros((8, 2), np.int32),
+                        np.ones((8, 2), np.float32), 16)
+        X = to_blocked_ell(sp, 16)
+        assert X.ell_vals == ()
+        w = jnp.ones((16,), jnp.float32)
+        with K.scope("on"):
+            assert not M._use_kernel(X, w)
+            out = np.asarray(M.matvec(X, w))
+        with K.scope("off"):
+            np.testing.assert_array_equal(out, np.asarray(M.matvec(X, w)))
+
+    def test_vmem_budget_fallback(self):
+        """Past the VMEM budget the seam steps aside per call — never an
+        error, same bits."""
+        X = _wide_bucket_problem()
+        w = jnp.ones((X.shape[1],), jnp.float32)
+        with K.scope("on"):
+            ref = np.asarray(M.matvec(X, w))
+            os.environ[K.ENV_VMEM] = "1"
+            try:
+                assert not M._use_kernel(X, w)
+                np.testing.assert_array_equal(ref, np.asarray(M.matvec(X, w)))
+            finally:
+                del os.environ[K.ENV_VMEM]
+
+    def test_jit_solve_parity_resident(self):
+        """A resident blocked-ELL train_glm with kernels on equals the
+        XLA solve bitwise (the seam dispatches inside jit)."""
+        rng = np.random.default_rng(3)
+        ind = rng.integers(0, 96, size=(128, 5)).astype(np.int32)
+        val = rng.normal(size=(128, 5)).astype(np.float32)
+        y = (rng.uniform(size=128) < 0.5).astype(np.float32)
+        batch = jax.device_put(make_batch(SparseRows(ind, val, 96), y))
+        batch = batch._replace(X=jax.device_put(
+            to_blocked_ell(SparseRows(ind, val, 96), 16)))
+        cfg = OptimizerConfig(max_iters=6, tolerance=0.0, reg=l2(),
+                              reg_weight=1e-3, history=4)
+        w_off = np.asarray(train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, kernels="off"))[1].w)
+        w_on = np.asarray(train_glm(
+            batch, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, kernels="on"))[1].w)
+        np.testing.assert_array_equal(w_off, w_on)
+
+    def test_streamed_chunk_path_parity(self):
+        """The streamed blocked-ELL chunk ladder with kernels on equals
+        kernels off bit for bit (the chunk programs carry the seam)."""
+        rng = np.random.default_rng(4)
+        ind = rng.integers(0, 64, size=(96, 4)).astype(np.int32)
+        val = rng.normal(size=(96, 4)).astype(np.float32)
+        y = (rng.uniform(size=96) < 0.5).astype(np.float32)
+        cb = chunk_blocked_ell(make_batch(SparseRows(ind, val, 64), y),
+                               32, d_dense=16)
+        cfg = OptimizerConfig(max_iters=5, tolerance=0.0, reg=l2(),
+                              reg_weight=1e-3, history=4)
+        w_off = np.asarray(train_glm(
+            cb, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, kernels="off"))[1].w)
+        w_on = np.asarray(train_glm(
+            cb, TaskType.LOGISTIC_REGRESSION,
+            dataclasses.replace(cfg, kernels="on"))[1].w)
+        np.testing.assert_array_equal(w_off, w_on)
+
+
+class TestDispatchSeam:
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv(K.ENV_KNOB, "on")
+        assert K.mode() == "on" and K.active()
+        monkeypatch.setenv(K.ENV_KNOB, "off")
+        assert not K.active()
+        monkeypatch.setenv(K.ENV_KNOB, "auto")
+        assert K.active() == (jax.default_backend() == "tpu")
+        monkeypatch.setenv(K.ENV_KNOB, "bogus")
+        with pytest.raises(ValueError, match="PHOTON_TPU_KERNELS"):
+            K.mode()
+
+    def test_scope_nesting_and_restore(self):
+        base = K.active()
+        with K.scope("on"):
+            assert K.active()
+            with K.scope("off"):
+                assert not K.active()
+            assert K.active()
+        assert K.active() == base
+
+    def test_signature_invariance_across_modes(self):
+        from photon_tpu.analysis.rules import TraceSignatureLog
+
+        X = _wide_bucket_problem()
+        w = jnp.zeros((X.shape[1],), jnp.float32)
+        log = TraceSignatureLog()
+        for m in ("off", "on", "off", "on"):
+            with K.scope(m):
+                log.record("seam", (X, w))
+        assert len(log.signatures("seam")) == 1
+        assert log.hazards() == []
+
+
+class TestDeviceChunkRing:
+    def test_rotation_order_and_prearm(self):
+        rng = np.random.default_rng(5)
+        Xd = rng.normal(size=(64, 8)).astype(np.float32)
+        cb = chunk_batch(make_batch(
+            Xd, (rng.uniform(size=64) < 0.5).astype(np.float32)), 16)
+        ring = cb.device_ring(prefetch=2)
+        for p in range(3):
+            seen = [(i, np.asarray(b.y)) for i, b in ring.stream_pass()]
+            assert [i for i, _ in seen] == [0, 1, 2, 3]
+            for i, yb in seen:
+                np.testing.assert_array_equal(yb, cb.y[i * 16:(i + 1) * 16])
+            # pre-arm: the next pass's first uploads are already issued
+            assert len(ring._window) == 2
+
+    def test_abandoned_pass_resets(self):
+        rng = np.random.default_rng(6)
+        Xd = rng.normal(size=(48, 4)).astype(np.float32)
+        cb = chunk_batch(make_batch(
+            Xd, np.zeros(48, np.float32)), 16)
+        ring = cb.device_ring(prefetch=2)
+        it = ring.stream_pass()
+        next(it)  # consume chunk 0, abandon mid-pass
+        it.close()
+        assert len(ring._window) == 0 and ring._next == 0
+        order = [i for i, _ in ring.stream_pass()]
+        assert order == [0, 1, 2]  # restarts at chunk 0, nothing stale
+
+    def test_streamed_solve_unchanged_by_ring(self):
+        """The ring + donated programs are pure overlap: streamed ==
+        resident at the documented tolerance, twice in a row (ring state
+        carries across solves of the same backend instance only)."""
+        rng = np.random.default_rng(7)
+        Xd = rng.normal(size=(256, 12)).astype(np.float32)
+        y = (rng.uniform(size=256) < 0.5).astype(np.float32)
+        cfg = OptimizerConfig(max_iters=8, tolerance=0.0, reg=l2(),
+                              reg_weight=1e-3, history=4)
+        res = train_glm(make_batch(Xd, y), TaskType.LOGISTIC_REGRESSION,
+                        cfg)[1]
+        cb = chunk_batch(make_batch(Xd, y), 64)
+        s1 = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)[1]
+        s2 = train_glm(cb, TaskType.LOGISTIC_REGRESSION, cfg)[1]
+        np.testing.assert_array_equal(np.asarray(s1.w), np.asarray(s2.w))
+        np.testing.assert_allclose(np.asarray(res.w), np.asarray(s1.w),
+                                   atol=2e-4, rtol=2e-4)
+
+
+class TestQuantizedRungs:
+    def _ladder(self, quantize=None, eps=0.5, E=32, df=12, dr=6, k=3):
+        from photon_tpu import serving
+        from photon_tpu.game.model import (FixedEffectModel, GameModel,
+                                           RandomEffectModel)
+        from photon_tpu.models.glm import (Coefficients,
+                                           GeneralizedLinearModel)
+
+        rng = np.random.default_rng(8)
+        task = TaskType.LOGISTIC_REGRESSION
+        keys = np.asarray(sorted(str(i) for i in range(E)))
+        model = GameModel({
+            "fixed": FixedEffectModel(GeneralizedLinearModel(
+                Coefficients(jnp.asarray(
+                    rng.normal(size=df).astype(np.float32))), task),
+                "global"),
+            "perMember": RandomEffectModel(
+                entity_name="memberId", feature_shard="member", task=task,
+                coefficients=jnp.asarray(
+                    rng.normal(size=(E, dr)).astype(np.float32)),
+                entity_keys=keys,
+                key_to_index={kk: i for i, kk in enumerate(keys.tolist())}),
+        }, task)
+        store = serving.CoefficientStore.from_game_model(model)
+        return serving.ProgramLadder(
+            store, floor=8, max_batch=16, sparse_k={"member": k},
+            quantize=quantize, quant_epsilon=eps), (df, dr, k, E)
+
+    def test_epsilon_refusal_and_counter(self):
+        from photon_tpu import telemetry
+        from photon_tpu.serving.programs import QuantizationRefused
+
+        ladder, _ = self._ladder(quantize="int8", eps=1e-9)
+        run = telemetry.start_run("quant_refusal_test")
+        try:
+            with pytest.raises(QuantizationRefused, match="exceeds"):
+                ladder.warmup()
+            assert run.counters.get("serving.quant_refusals", 0) == 1
+        finally:
+            telemetry.finish_run()
+        assert ladder.quant_report["max_abs_diff"] > 0.0
+
+    def test_gate_passes_and_reports(self):
+        ladder, _ = self._ladder(quantize="int8", eps=0.5)
+        assert ladder.warmup() >= 1
+        rep = ladder.quant_report
+        assert rep["mode"] == "int8"
+        assert 0.0 < rep["max_abs_diff"] <= 0.5
+
+    def test_cold_miss_row_bitwise(self):
+        """An unseen entity's quantized score equals the f32 ladder's bit
+        for bit: the all-zero cold-miss row quantizes at scale 1.0 and
+        dequantizes to exact zeros."""
+        ladder, (df, dr, k, E) = self._ladder(quantize="int8")
+        f32, _ = self._ladder(quantize=None)
+        ladder.warmup()
+        f32.warmup()
+        rng = np.random.default_rng(9)
+        off = np.zeros(8, np.float32)
+        shards = {"global": np.zeros((8, df), np.float32),
+                  "member": SparseRows(
+                      rng.integers(0, dr, size=(8, k)).astype(np.int32),
+                      rng.normal(size=(8, k)).astype(np.float32), dr)}
+        ids = {"perMember": np.full(8, E, np.int32)}  # the cold row
+        np.testing.assert_array_equal(
+            np.asarray(f32.score_padded(off, shards, ids)),
+            np.asarray(ladder.score_padded(off, shards, ids)))
+
+    @pytest.mark.parametrize("mode", ["int8", "bf16"])
+    def test_mixed_sizes_never_retrace(self, mode):
+        ladder, (df, dr, k, _E) = self._ladder(quantize=mode)
+        ladder.warmup()
+        rng = np.random.default_rng(10)
+        for B in (8, 16, 8, 16, 8):
+            shards = {"global": rng.normal(size=(B, df)).astype(np.float32),
+                      "member": SparseRows(
+                          rng.integers(0, dr, size=(B, k)).astype(np.int32),
+                          rng.normal(size=(B, k)).astype(np.float32), dr)}
+            ids = {"perMember": np.zeros(B, np.int32)}
+            ladder.score_padded(np.zeros(B, np.float32), shards, ids)
+        assert ladder.assert_no_retrace() <= len(ladder.ladder)
+
+    def test_hot_swap_requantizes(self):
+        """A reload_coefficients swap invalidates the quantized-block
+        cache: the next dispatch scores the NEW model (tracked via a
+        margin that flips sign when every coefficient is negated)."""
+        ladder, (df, dr, k, _E) = self._ladder(quantize="int8")
+        ladder.warmup()
+        rng = np.random.default_rng(11)
+        shards = {"global": rng.normal(size=(8, df)).astype(np.float32),
+                  "member": SparseRows(
+                      np.zeros((8, k), np.int32),
+                      np.zeros((8, k), np.float32), dr)}
+        ids = {"perMember": np.zeros(8, np.int32)}
+        before = np.asarray(ladder.score_padded(
+            np.zeros(8, np.float32), shards, ids))
+        import copy
+
+        other = copy.copy(ladder.store)
+        neg_fixed = {n: dataclasses.replace(
+            b, weights=-np.asarray(b.weights)) for n, b in
+            ladder.store.fixed.items()}
+        neg_rand = {n: dataclasses.replace(
+            b, coefficients=-np.asarray(b.coefficients)) for n, b in
+            ladder.store.random.items()}
+        other.fixed, other.random = neg_fixed, neg_rand
+        other._device = None
+        ladder.store.reload_coefficients(other)
+        after = np.asarray(ladder.score_padded(
+            np.zeros(8, np.float32), shards, ids))
+        # logistic mean head: negated margins mirror around 0.5
+        np.testing.assert_allclose(np.asarray(before) + np.asarray(after),
+                                   1.0, atol=1e-6)
+
+
+class TestStaticCostNarrowing:
+    def test_quantized_dot_charges_storage_width(self):
+        from photon_tpu.profiling.model import estimate_fn
+
+        q = np.zeros((256,), np.int8)
+        s = np.float32(0.5)
+        x = np.zeros((64, 256), np.float32)
+
+        def quant_dot(q, s, x):
+            return x @ (q.astype(jnp.float32) * s)
+
+        c = estimate_fn(quant_dot, (q, s, x))
+        assert c.narrowed_bytes == 256 * 3  # int8 charged 1 B, not 4
+
+        def f32_dot(w, x):
+            return x @ w
+
+        c2 = estimate_fn(f32_dot, (np.zeros(256, np.float32), x))
+        assert c2.narrowed_bytes == 0
+        # the row-wise serving-rung pattern narrows through the gather +
+        # per-row scale multiply too
+        def rung(qm, sc, ids, xr):
+            rows = qm[ids].astype(jnp.float32) * sc[ids][:, None]
+            return jnp.einsum("nd,nd->n", xr, rows)
+
+        c3 = estimate_fn(rung, (np.zeros((100, 8), np.int8),
+                                np.zeros(100, np.float32),
+                                np.zeros(16, np.int32),
+                                np.zeros((16, 8), np.float32)))
+        assert c3.narrowed_bytes == 16 * 8 * 3

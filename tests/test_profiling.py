@@ -177,6 +177,61 @@ class TestSentinel:
             {"ingest_throughput_upload_stall_pct": 0.01}, shist)
         assert better["ingest_throughput_upload_stall_pct"].status == "ok"
 
+    def test_kernel_leg_admission(self):
+        """The round-15 kernel-variant leg as the sentinel sees it: a
+        brand-new leg admits without tripping the gate that merges it,
+        the backend string never becomes a leg, and with history the
+        rate gates higher-better like any throughput leg."""
+        verdicts = sentinel.gate(
+            {"blocked_ell_kernel_rows_iters_per_sec_per_chip": 1.0e7,
+             "dense_rate": 1e8},
+            _history())
+        assert verdicts[
+            "blocked_ell_kernel_rows_iters_per_sec_per_chip"].status == \
+            "new"
+        assert verdicts["dense_rate"].status == "ok"
+        legs = sentinel.leg_values(
+            {"legs": {"blocked_ell_kernel_backend": "cpu-interpret",
+                      "blocked_ell_kernel_rows_iters_per_sec_per_chip":
+                          1.0e7}})
+        assert "blocked_ell_kernel_backend" not in legs
+        assert "blocked_ell_kernel_rows_iters_per_sec_per_chip" in legs
+        hist = _history(
+            leg="blocked_ell_kernel_rows_iters_per_sec_per_chip",
+            base=1.0e7)
+        worse = sentinel.gate(
+            {"blocked_ell_kernel_rows_iters_per_sec_per_chip": 1.0e6},
+            hist)
+        assert worse[
+            "blocked_ell_kernel_rows_iters_per_sec_per_chip"].status == \
+            "regressed"
+
+    def test_serving_quantized_leg_admission(self):
+        """The round-15 quantized-rung legs as the sentinel sees them:
+        new legs admit, QPS gates higher-better, p99 and the measured
+        probe margin maxdiff LOWER-better — a louder quantization at
+        the same throughput is a regression."""
+        verdicts = sentinel.gate(
+            {"serving_quantized_qps": 2.1e4,
+             "serving_quantized_p99_ms": 4.5,
+             "serving_quantized_margin_maxdiff": 0.02,
+             "dense_rate": 1e8},
+            _history())
+        for leg in ("serving_quantized_qps", "serving_quantized_p99_ms",
+                    "serving_quantized_margin_maxdiff"):
+            assert verdicts[leg].status == "new", leg
+        assert not sentinel.lower_is_better("serving_quantized_qps")
+        assert sentinel.lower_is_better("serving_quantized_p99_ms")
+        assert sentinel.lower_is_better("serving_quantized_margin_maxdiff")
+        hist = _history(leg="serving_quantized_margin_maxdiff", base=0.02)
+        worse = sentinel.gate(
+            {"serving_quantized_margin_maxdiff": 0.5}, hist)
+        assert worse["serving_quantized_margin_maxdiff"].status == \
+            "regressed"
+        better = sentinel.gate(
+            {"serving_quantized_margin_maxdiff": 0.001}, hist)
+        assert better["serving_quantized_margin_maxdiff"].status == "ok"
+
     def test_game_e2e_leg_admission(self):
         """The round-13 game_e2e legs as the sentinel sees them: the new
         throughput legs admit as 'new' without tripping the gate that
