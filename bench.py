@@ -59,6 +59,18 @@ if "--check-contracts" in sys.argv:
                                    " --xla_force_host_platform_device_count"
                                    "=8").strip()
 
+# --check-lint: the source-level convention auditor (photon_tpu/lint) —
+# durable-write discipline, fault-site/telemetry/env-knob registries,
+# lock/spawn/exception hygiene, contract + sentinel coverage. Jax-free
+# AST rules over the repo source: milliseconds, runs before the
+# heavyweight imports below, exit 1 on any finding (CI pins
+# `python bench.py --check-lint` beside --check-contracts).
+if "--check-lint" in sys.argv:
+    from photon_tpu.lint.__main__ import main as _lint_main
+
+    raise SystemExit(_lint_main([a for a in sys.argv[1:]
+                                 if a != "--check-lint"]))
+
 # --gate: the noise-aware bench regression sentinel
 # (photon_tpu/profiling/sentinel.py) — judge the latest BENCH_r0*.json
 # round (or --gate-candidate FILE) against the earlier trajectory with

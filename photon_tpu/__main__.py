@@ -4,12 +4,17 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the nine per-package selftests as subprocesses (each CLI
+Runs the ten per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
 - ``analysis``   — `python -m photon_tpu.analysis --json` (the full
                    contract registry traces clean; exit 1 on drift)
+- ``lint``       — `python -m photon_tpu.lint --json` (the source-level
+                   convention auditor: durable writes, fault-site/
+                   telemetry/env-knob registries, lock + spawn +
+                   exception hygiene, contract/sentinel coverage —
+                   jax-free, milliseconds)
 - ``telemetry``  — `--selftest`: sinks, spans, iteration stream, the
                    telemetry-off-is-free contract
 - ``serving``    — `--selftest`: store + dispatcher offline parity,
@@ -60,6 +65,7 @@ import time
 
 SUITES: tuple = (
     ("analysis", ("photon_tpu.analysis", "--json")),
+    ("lint", ("photon_tpu.lint", "--json")),
     ("telemetry", ("photon_tpu.telemetry", "--selftest", "--json")),
     ("serving", ("photon_tpu.serving", "--selftest", "--json")),
     ("checkpoint", ("photon_tpu.checkpoint", "--selftest", "--json")),

@@ -23,6 +23,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.optim.streamed",      # streamed + mesh-streamed chunk regime
     "photon_tpu.game.random_effect",  # vmapped per-entity lane solves
     "photon_tpu.game.coordinate_descent",  # fused GAME coordinate update
+    "photon_tpu.game.scoring",        # streamed inter-coordinate scorer
     "photon_tpu.drivers.score",       # chunked scoring driver program
     "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
     "photon_tpu.serving.programs",    # online per-request scoring ladder

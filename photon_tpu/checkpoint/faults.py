@@ -6,12 +6,11 @@ tests trust that machinery. photon-tpu's host loops (streamed solves, the
 GAME block pipeline, snapshot writers) have no lineage to replay, so this
 module supplies the two halves explicitly:
 
-- **kill points** — named sites on the hot paths (``chunk_upload``,
-  ``evaluation``, ``bucket_retire``, ``snapshot_write``, ``commit``, the
-  serving tier's ``rung_execute``/``replica_dispatch``/``store_open``
-  — docs/SERVING.md "Overload semantics" — and the ingest plane's
-  ``ingest_worker``/``cache_open``/``cache_commit`` — docs/INGEST.md
-  "Crash semantics") where
+- **kill points** — named sites on the hot paths (the canonical
+  site list is :data:`FAULT_SITES` below — every ``kill_point`` /
+  ``retry_io(site=...)`` literal in the package must appear there and
+  vice versa, enforced by ``python -m photon_tpu.lint``'s
+  ``fault_site_registry`` rule) where
   an armed :class:`FaultPlan` raises :class:`InjectedFault` at a chosen
   occurrence, simulating a preemption at exactly that moment. Sites are
   DETERMINISTIC: the n-th hit of a site is the same program point on every
@@ -47,10 +46,67 @@ from typing import Callable, Optional
 from photon_tpu import telemetry
 
 __all__ = [
-    "InjectedFault", "TransientIOError", "FaultPlan", "arm_faults",
-    "disarm_faults", "fault_plan", "current_plan", "kill_point",
-    "record_sites", "retry_io",
+    "FAULT_SITES", "InjectedFault", "TransientIOError", "FaultPlan",
+    "arm_faults", "disarm_faults", "fault_plan", "current_plan",
+    "kill_point", "record_sites", "retry_io",
 ]
+
+# The canonical fault-site registry: every `kill_point(site)` and
+# `retry_io(site=...)` literal in the package maps to exactly one entry
+# here (and every entry to >=1 program point) — the `fault_site_registry`
+# lint rule holds both directions, so a new site lands in the same diff
+# as its documentation and an orphaned doc line cannot linger. A pure
+# literal: photon_tpu.lint reads it by AST, without importing jax.
+FAULT_SITES = {
+    # kill points (one `kill_point` hit per occurrence)
+    "chunk_upload": (
+        "data/dataset.py — per streamed feature-chunk upload (iter_device"
+        " and the persistent DeviceChunkRing)"),
+    "evaluation": (
+        "optim/streamed.py — per streamed objective evaluation (the "
+        "checkpoint cadence tick)"),
+    "bucket_retire": (
+        "game/random_effect.py — per retired random-effect block in the "
+        "pipelined train loop"),
+    "snapshot_write": (
+        "checkpoint/store.py — inside SnapshotStore payload writes, "
+        "before the manifest swing"),
+    "commit": (
+        "checkpoint/store.py commit_bytes/replace_committed — the widest "
+        "window of every two-phase commit, after the temp write"),
+    "swap_publish": (
+        "continual/swap.py — between the versioned store publish and the "
+        "CURRENT-pointer commit of a serving hot-swap"),
+    "rung_execute": (
+        "serving/dispatcher.py RungExecutor — per dispatched micro-batch "
+        "device program (a replica death mid-request)"),
+    "ingest_worker": (
+        "data/ingest_plane.py — once per retired decode task (a worker "
+        "death; the stream degrades that chunk to in-process decode)"),
+    # retry_io sites (errors[site] injects retried TransientIOErrors;
+    # kills[site] still injects an InjectedFault at that occurrence)
+    "avro_open": (
+        "data/streaming.py — Avro container opens for the ingest scan "
+        "and chunkers"),
+    "snapshot_io": (
+        "checkpoint/store.py — snapshot payload/manifest reads on the "
+        "restore path"),
+    "store_open": (
+        "serving/store.py CoefficientStore.open — serving store manifest"
+        " + block opens (missing manifest fails fast)"),
+    "replica_dispatch": (
+        "serving/fleet.py — per-replica request dispatch; retry_on "
+        "includes InjectedFault, so a kill here IS a failover"),
+    "cache_open": (
+        "data/chunk_cache.py — chunk-cache manifest/payload opens "
+        "(a torn entry reads as a miss)"),
+    "cache_commit": (
+        "data/chunk_cache.py — payload writes + the manifest-last commit "
+        "of a cache entry"),
+    "selftest_io": (
+        "checkpoint/__main__.py — the selftest's retry/backoff proof "
+        "site (never hit in production code)"),
+}
 
 
 class InjectedFault(RuntimeError):

@@ -477,6 +477,9 @@ class AvroBlockWriter:
         self.codec = codec
         self.sync = sync or os.urandom(SYNC_SIZE)
         schema_json = schema if isinstance(schema, str) else json.dumps(schema)
+        # a GB-scale streaming append cannot buffer for commit_bytes;
+        # readers detect torn containers by sync marker + CRC
+        # lint: rawwrite(streaming Avro container writer)
         self._f = open(path, "wb")
         self._f.write(MAGIC)
         meta = {"avro.schema": schema_json.encode("utf-8"),

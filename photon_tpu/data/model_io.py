@@ -186,8 +186,13 @@ def save_game_model(out_dir, model: GameModel, index_maps: dict,
             })
         else:
             raise TypeError(f"unknown coordinate model: {type(cm)}")
-    with open(os.path.join(out_dir, "metadata.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+    # metadata.json is the model-publish manifest load_game_model keys
+    # off — committed LAST and atomically, so a kill mid-save leaves a
+    # directory that reads as "no model" rather than a torn one
+    from photon_tpu.checkpoint.store import commit_bytes
+
+    commit_bytes(os.path.join(out_dir, "metadata.json"),
+                 json.dumps(meta, indent=2).encode())
     if manifest is not None:
         save_training_manifest(out_dir, manifest)
 

@@ -49,14 +49,17 @@ class FeatureSummary:
     # ------------------------------------------------------------- persistence
     def save(self, path: str) -> None:
         """One JSON document (small: 8 vectors of d floats) — the analog of
-        the reference driver's summarization output Avro."""
+        the reference driver's summarization output Avro. Committed
+        atomically: a normalization context derived from a torn summary
+        would silently skew every downstream solve."""
+        from photon_tpu.checkpoint.store import commit_bytes
+
         doc = {"count": self.count}
         for f in dataclasses.fields(self):
             if f.name != "count":
                 doc[f.name] = np.asarray(getattr(self, f.name),
                                          np.float64).tolist()
-        with open(path, "w") as fh:
-            json.dump(doc, fh)
+        commit_bytes(path, json.dumps(doc).encode())
 
     @staticmethod
     def load(path: str) -> "FeatureSummary":

@@ -1047,11 +1047,12 @@ def _manifest_row(point_dir: str, r, best: bool, sig: str) -> dict:
 
 def _write_manifest(path: str, rows: list) -> None:
     """Atomic replace: a preemption mid-write must never leave truncated
-    JSON (the resume feature's own failure scenario)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(rows, fh, indent=2)
-    os.replace(tmp, path)
+    JSON (the resume feature's own failure scenario). Rides the repo-wide
+    commit primitive — the hand-rolled tmp+replace this used to carry
+    skipped the fsync, so a power loss could still publish a torn file."""
+    from photon_tpu.checkpoint.store import commit_bytes
+
+    commit_bytes(path, json.dumps(rows, indent=2).encode())
 
 
 def _fit_grid_resumable(estimator: GameEstimator, params: TrainingParams,

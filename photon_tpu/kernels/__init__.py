@@ -50,9 +50,9 @@ registered contracts).
 from __future__ import annotations
 
 import contextlib
-import os
 
 from photon_tpu.analysis.rules import TraceSignatureLog
+from photon_tpu.utils import env as env_knobs
 
 from photon_tpu.kernels.blocked_ell import (  # noqa: F401
     bucket_rmatvec,
@@ -99,7 +99,7 @@ def mode() -> str:
     ``PHOTON_TPU_KERNELS`` env knob, else ``auto``."""
     if _OVERRIDES:
         return _OVERRIDES[-1]
-    return _canon(os.environ.get(ENV_KNOB, "auto"))
+    return _canon(env_knobs.get_raw(ENV_KNOB, "auto"))
 
 
 def interpret() -> bool:
@@ -126,7 +126,7 @@ def vmem_budget() -> int | None:
     layout whose operands exceed it falls back to the XLA path. Off-TPU
     (interpret mode) there is no VMEM, so the budget is unbounded unless
     ``PHOTON_TPU_KERNELS_VMEM`` pins one."""
-    raw = os.environ.get(ENV_VMEM)
+    raw = env_knobs.get_raw(ENV_VMEM)
     if raw is not None:
         return int(raw)
     return None if interpret() else 12 << 20

@@ -1,0 +1,64 @@
+"""CLI: audit the repo's source-level conventions.
+
+    python -m photon_tpu.lint             # human report, exit 1 on findings
+    python -m photon_tpu.lint --json      # machine report (one object)
+    python -m photon_tpu.lint --list      # rule names + suppression tags
+    python -m photon_tpu.lint --only durable_write --only telemetry_sync
+    python -m photon_tpu.lint --changed   # findings in changed files only
+
+Jax-free and import-side-effect-free: the rules read every registry they
+pin as an AST literal, so the whole audit costs milliseconds (bench.py's
+``--check-lint`` guard and the 10th umbrella ``--selfcheck`` suite run
+exactly this).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from photon_tpu.lint import run_lint
+    from photon_tpu.lint.rules import RULES
+
+    if "--list" in argv:
+        for name, (_fn, tag, doc) in RULES.items():
+            print(f"{name:24s} tag={tag:10s} {doc}")
+        return 0
+    only: list = []
+    it = iter(argv)
+    root = None
+    for a in it:
+        if a == "--only":
+            only.append(next(it))
+        elif a == "--root":
+            root = next(it)
+    unknown = sorted(set(only) - set(RULES) - {"suppression"})
+    if unknown:
+        print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+        return 2
+    report = run_lint(root=root, only=only or None,
+                      changed="--changed" in argv)
+    findings = report["findings"]
+    if "--json" in argv:
+        print(json.dumps({
+            "ok": report["ok"],
+            "n_files": report["n_files"],
+            "n_rules": report["n_rules"],
+            "n_findings": len(findings),
+            "n_suppressed": len(report["suppressed"]),
+            "findings": [f.to_json() for f in findings],
+        }))
+        return 0 if report["ok"] else 1
+    for f in findings:
+        print(f.text)
+    print(f"{report['n_rules']} rule(s) over {report['n_files']} file(s): "
+          f"{len(findings)} finding(s), "
+          f"{len(report['suppressed'])} suppressed"
+          + ("" if findings else " — all conventions hold"))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

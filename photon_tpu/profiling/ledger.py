@@ -42,12 +42,12 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
 import threading
 import time
 from typing import Optional
 
 from photon_tpu.profiling.model import StaticCost, estimate_fn, xla_cost
+from photon_tpu.utils import env as env_knobs
 
 __all__ = [
     "Ledger", "ProgramRecord", "start_ledger", "finish_ledger", "ledger",
@@ -70,8 +70,8 @@ _DEFAULT_PEAKS = (1.0e11, 5.0e10)
 def resolve_peaks() -> tuple[float, float]:
     """(peak_flops_per_s, peak_bytes_per_s): env override first, else
     the current backend's modeled ceiling."""
-    env_f = os.environ.get("PHOTON_TPU_PEAK_FLOPS")
-    env_b = os.environ.get("PHOTON_TPU_PEAK_BYTES_PER_S")
+    env_f = env_knobs.get_raw("PHOTON_TPU_PEAK_FLOPS")
+    env_b = env_knobs.get_raw("PHOTON_TPU_PEAK_BYTES_PER_S")
     backend_f, backend_b = _DEFAULT_PEAKS
     try:
         import jax

@@ -17,9 +17,17 @@ one process-wide `Run` recorder the instrumented hot paths report into.
 Three primitives (see `run.Run`): nestable host-side **spans** (also fed
 to `jax.profiler.TraceAnnotation`, so they appear on XProf timelines;
 `utils.timing.PhaseTimers` forwards the drivers' phase blocks here
-automatically), **counters/gauges** (chunk uploads, upload-stall seconds,
-prefetch depth, evaluations, line-search trials, margin-cache hits/
-refreshes, retraces via `analysis.TraceSignatureLog`, GAME sweep stats,
+automatically), **counters/gauges** (the streamed chunk pipeline's
+`stream.*` family — passes/chunk_uploads/stall_seconds/compute_seconds/
+stalled_passes counters beside the prefetch_depth gauge; the streamed
+solver loops' `solver.*` family — iterations/evaluations/
+feature_streams/linesearch_trials plus the margin_cache.hits/
+margin_cache.refreshes cache pair; retrace.new_signatures riding
+`analysis.TraceSignatureLog`; the GAME descent's `game.*` —
+sweeps/coordinate_updates/grid_points; the training driver's
+train.dataset_estimate_bytes/train.hbm_budget_bytes gauges; the chunked
+scoring driver's score.chunks/score.rows; the ingest scan's
+ingest.chunks/ingest.rows/ingest.device_shards;
 the random-effect block pipeline's `game_re.*` family —
 blocks/blocks_in_flight/readback_wait_ns plus the straggler compaction's
 straggler_entities/tail_resolves/iters_saved and the fused-update gate's
@@ -68,7 +76,8 @@ cache — with the stall-driven prefetch's
 stream.prefetch_widened/stream.prefetch_narrowed counters and one
 `prefetch_decision` event per depth verdict beside the existing
 stream.prefetch_depth gauge — and HBM
-watermarks), and the
+watermarks — the hbm.bytes_in_use.max / hbm.peak_bytes_in_use.max
+gauge pair, with per-tag suffixes), and the
 **iteration stream** — one event per solver
 iteration, free in the streamed/mesh host loops and opt-in for the jitted
 resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`
@@ -87,6 +96,13 @@ programs contain no callback at all (docs/OBSERVABILITY.md).
 CLI: ``python -m photon_tpu.telemetry --selftest`` smoke-checks the
 spine (sink round-trip + the off-is-free contract) and exits non-zero on
 failure.
+
+This docstring is the HUMAN registry of telemetry names; the
+machine-readable twin is :data:`TELEMETRY_REGISTRY` at the bottom of
+this module. ``python -m photon_tpu.lint``'s ``telemetry_sync`` rule
+holds all three sides: every counter/gauge literal the package emits is
+in the registry, every registry name is emitted somewhere, and every
+registry name appears in this docstring.
 """
 from __future__ import annotations
 
@@ -232,3 +248,68 @@ def sample_device_memory(tag: str = "") -> None:
     r = _CURRENT
     if r is not None:
         r.sample_device_memory(tag)
+
+
+# The machine-readable twin of the docstring's name registry (a pure
+# literal: photon_tpu.lint reads it by AST, without importing jax).
+# Entries ending in ".*" / "_*" are prefix globs for dynamically
+# suffixed names (per-site retry counters, per-percentile latency
+# gauges, per-tag HBM watermarks). `span_families` lists the allowed
+# prefix (before the first dot) of every `telemetry.span(...)` name the
+# package opens — `utils.timing.PhaseTimers(span_prefix=...)` routes the
+# drivers' phase blocks into the "train" and "score" families.
+TELEMETRY_REGISTRY = {
+    "counters": (
+        "faults.injected_kills", "faults.injected_errors",
+        "faults.io_retries", "faults.io_retries.*",
+        "faults.backoff_seconds",
+        "checkpoint.snapshots", "checkpoint.bytes", "checkpoint.restores",
+        "checkpoint.scope_restores", "checkpoint.solver_restores",
+        "checkpoint.re_restores", "checkpoint.descent_restores",
+        "checkpoint.gc_snapshots",
+        "continual.plans", "continual.touched_entities",
+        "continual.deferred_new_keys", "continual.refreshes",
+        "continual.touched_buckets", "continual.skipped_buckets",
+        "continual.refresh_solves", "continual.refresh_iterations",
+        "continual.probe_entities", "continual.swap_refusals",
+        "ingest.chunks", "ingest.rows", "ingest.device_shards",
+        "ingest.worker_chunks", "ingest.worker_deaths",
+        "ingest.cache_hits", "ingest.cache_misses", "ingest.cache_builds",
+        "ingest.cache_commits", "ingest.cache_chunks",
+        "ingest.cache_bytes", "ingest.cache_invalid",
+        "stream.passes", "stream.chunk_uploads", "stream.stall_seconds",
+        "stream.compute_seconds", "stream.stalled_passes",
+        "stream.prefetch_widened", "stream.prefetch_narrowed",
+        "solver.iterations", "solver.evaluations",
+        "solver.feature_streams", "solver.linesearch_trials",
+        "solver.margin_cache.hits", "solver.margin_cache.refreshes",
+        "retrace.new_signatures",
+        "score.chunks", "score.rows",
+        "serving.requests", "serving.batches", "serving.batch_rows",
+        "serving.pad_waste", "serving.cold_misses", "serving.hot_swaps",
+        "serving.quant_refusals", "serving.admitted", "serving.shed",
+        "serving.deadline_expired", "serving.fleet_dispatches",
+        "serving.fleet_failovers", "serving.fleet_degraded",
+        "game.sweeps", "game.coordinate_updates", "game.grid_points",
+        "game_re.blocks", "game_re.readback_wait_ns",
+        "game_re.straggler_entities", "game_re.tail_resolves",
+        "game_re.iters_saved", "game_re.fused_gate_offs",
+        "game_e2e.pod_scale_runs", "game_e2e.streamed_fixed_updates",
+        "game_e2e.objective_chunks",
+        "game_e2e.host_offset_sums", "game_e2e.score_stream_chunks",
+        "game_e2e.score_stream_rows", "game_e2e.chunked_fit_points",
+        "eval.scatter_elems_saved",
+    ),
+    "gauges": (
+        "stream.prefetch_depth", "ingest.workers",
+        "train.dataset_estimate_bytes", "train.hbm_budget_bytes",
+        "game_re.blocks_in_flight",
+        "serving.queue_depth", "serving.batch_fill",
+        "serving.latency_*", "serving.fleet_replicas",
+        "hbm.bytes_in_use.max*", "hbm.peak_bytes_in_use.max*",
+    ),
+    "span_families": (
+        "train", "score", "ingest", "solve",
+        "game", "game_re", "serving", "checkpoint", "continual",
+    ),
+}

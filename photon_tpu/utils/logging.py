@@ -20,13 +20,15 @@ import os
 import sys
 from typing import Optional
 
+from photon_tpu.utils import env as env_knobs
+
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
 
 def _env_level() -> Optional[int]:
     """PHOTON_TPU_LOG_LEVEL, parsed: a standard level name ("DEBUG",
     "warning") or a numeric level; unset/unparseable -> None."""
-    raw = os.environ.get("PHOTON_TPU_LOG_LEVEL", "").strip()
+    raw = (env_knobs.get_raw("PHOTON_TPU_LOG_LEVEL", "") or "").strip()
     if not raw:
         return None
     if raw.isdigit():

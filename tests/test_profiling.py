@@ -618,8 +618,13 @@ def test_umbrella_selfcheck_cli():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout.strip().splitlines()[-1])
     assert doc["ok"]
-    assert set(doc["suites"]) == {"analysis", "telemetry", "serving",
-                                  "checkpoint", "profiling", "game",
-                                  "continual"}
+    from photon_tpu.__main__ import SUITES
+
+    assert set(doc["suites"]) == {name for name, _ in SUITES}
+    assert set(doc["suites"]) >= {"analysis", "lint", "telemetry",
+                                  "serving", "checkpoint", "profiling",
+                                  "game", "continual", "ingest",
+                                  "kernels"}
     assert doc["suites"]["game"]["ok"]
     assert doc["suites"]["continual"]["ok"]
+    assert doc["suites"]["lint"]["ok"]
