@@ -112,6 +112,15 @@ def main() -> None:
                         "decode-once mmap'd chunk cache, plus the "
                         "stall-driven prefetch's upload-stall share of a "
                         "streamed pass) and print its JSON line")
+    p.add_argument("--tuning-e2e-leg", action="store_true",
+                   help="also run bench.py's tuning_e2e leg (the "
+                        "lane-batched cost-aware tuner: 256 configs "
+                        "through GP-proposed fixed-chunk lane rounds "
+                        "with successive halving and warm survivor "
+                        "re-solves, vs the point-at-a-time tuner "
+                        "architecture — with the two-signature "
+                        "no-retrace bound asserted live) and print its "
+                        "JSON line")
     p.add_argument("--serving-slo-leg", action="store_true",
                    help="also run bench.py's open-loop serving_slo leg "
                         "(fixed arrival-rate sweep with the admission "
@@ -297,6 +306,23 @@ def main() -> None:
             "cached_over_cold": round(ing["cached_over_cold"], 2),
             "upload_stall_pct": round(ing["upload_stall_pct"], 2),
             "stalled_passes": ing["stalled_passes"]}), flush=True)
+
+    if args.tuning_e2e_leg:
+        # bench.py's tuning_e2e leg verbatim: the lane-batched tuner's
+        # configs-per-wall-clock measured against the point-at-a-time
+        # architecture, beside the flagship runs it would tune.
+        import bench
+
+        tu = bench.run_tuning_e2e(bench.tuning_problem())
+        print(json.dumps({
+            "leg": "tuning_e2e",
+            "configs_per_sec": round(tu["configs_per_sec"], 2),
+            "sequential_configs_per_sec":
+                round(tu["sequential_configs_per_sec"], 2),
+            "speedup_vs_sequential":
+                round(tu["speedup_vs_sequential"], 2),
+            "n_configs": tu["n_configs"],
+            "n_rounds": tu["n_rounds"]}), flush=True)
 
     if args.serving_leg or args.serving_slo_leg:
         # bench.py's serving legs verbatim: the online-scoring regime

@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the ten per-package selftests as subprocesses (each CLI
+Runs the eleven per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -51,6 +51,12 @@ and one crashed subsystem cannot take the others down):
                    blocked-ELL ladder cache round-trip, the
                    stall-driven prefetch controller, and the
                    chunk-program-invariance contract
+- ``tuning``     — `--selftest`: the lane-batched cost-aware tuner —
+                   fixed-chunk GP proposal rounds with successive
+                   halving (two dispatch signatures for a whole tune),
+                   the pow2 GP observation ladder, cost-aware q-EI
+                   edges, the pre-dispatch round budget raising on a
+                   starved cap, and both tuning contracts
 
 Exit status: 0 iff every suite passed; the summary line names each
 suite's verdict so a red CI run says WHICH plane drifted.
@@ -74,6 +80,7 @@ SUITES: tuple = (
     ("continual", ("photon_tpu.continual", "--selftest", "--json")),
     ("ingest", ("photon_tpu.ingest", "--selftest", "--json")),
     ("kernels", ("photon_tpu.kernels", "--selftest", "--json")),
+    ("tuning", ("photon_tpu.tuning", "--selftest", "--json")),
 )
 
 

@@ -33,6 +33,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.profiling.ledger",    # ledger-off-is-free guarantee
     "photon_tpu.evaluation.grouped",  # scatter-free per-entity metrics
     "photon_tpu.continual.refresh",   # delta-refresh compacted solve + no-retrace
+    "photon_tpu.tuning.lane_tuner",   # lane-batched tuner dispatch + round budget
 )
 
 

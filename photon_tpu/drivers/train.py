@@ -186,6 +186,12 @@ class TrainingParams:
     # Bayesian reg-weight search (0 → grid over reg_weights lists instead)
     tuning_iters: int = 0
     tuning_range: tuple = (1e-4, 1e4)
+    # GP proposals per tuner round, trained as ONE vectorized grid fit
+    # (estimator.would_vectorize gates; non-vectorizable setups — warm
+    # starts, locked/incremental coordinates, unsupported layouts — fall
+    # back to point-at-a-time and say so). 1 = the reference's
+    # one-candidate-per-round HyperparameterTuner loop.
+    tuning_batch: int = 1
     seed: int = 0
     # Incremental training (reference: --initial-model + PriorDistribution):
     # warm-start every coordinate from the saved model; coordinates listed in
@@ -1159,20 +1165,35 @@ def _tune(estimator: GameEstimator, params: TrainingParams, data,
     space = SearchSpace([SearchRange(lo, hi, log_scale=True)] * len(names))
     results: list = []
 
-    def evaluate(x) -> float:
-        overrides = {
-            n: params.coordinates[n].coordinate_config(w)
-            for n, w in zip(names, x)
-        }
-        r = estimator.fit(data, validation=validation, config_grid=[overrides],
-                          initial_models=initial_models)[0]
-        results.append(r)
-        score = r.validation_score
-        # tuner minimizes; flip metrics where higher is better (AUC, P@K)
-        return -score if evaluator.higher_is_better else score
+    def evaluate_batch(X) -> list:
+        grid = [{n: params.coordinates[n].coordinate_config(w)
+                 for n, w in zip(names, x)} for x in np.atleast_2d(X)]
+        out = []
+        for r in estimator.fit(data, validation=validation, config_grid=grid,
+                               initial_models=initial_models):
+            results.append(r)
+            score = r.validation_score
+            # tuner minimizes; flip metrics where higher is better (AUC, P@K)
+            out.append(-score if evaluator.higher_is_better else score)
+        return out
 
-    outcome = tune(evaluate, space, n_iters=params.tuning_iters,
-                   seed=params.seed)
+    batch = max(1, int(params.tuning_batch))
+    if batch > 1:
+        # same gate fit() itself applies — probed HERE so a silently
+        # sequential "batched" tune cannot masquerade as the fast path
+        probe = [{n: params.coordinates[n].coordinate_config(w)
+                  for n in names} for w in (lo, hi)]
+        if not estimator.would_vectorize(probe, initial_models=initial_models,
+                                         data=data):
+            log.info(
+                "tuning_batch=%d requested but the reg grid would not "
+                "vectorize (warm starts, locked/incremental coordinates, "
+                "or an unsupported matrix layout); tuning point-at-a-time",
+                batch)
+            batch = 1
+    outcome = tune(None, space, n_iters=params.tuning_iters,
+                   seed=params.seed, batch_size=batch,
+                   evaluate_batch=evaluate_batch)
     log.info("tuner best reg weights: %s -> %.6f",
              dict(zip(names, outcome.best_x)), outcome.best_y)
     return results

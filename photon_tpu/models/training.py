@@ -305,9 +305,22 @@ def _active_norm(normalization):
     return None
 
 
-def _init_w0(d, w0, norm):
+def _init_w0(d, w0, norm, allow_lanes=False):
     if w0 is None:
         return jnp.zeros((d,), jnp.float32)
+    if np.ndim(w0) == 2:
+        # Lane-MAJOR (G, d) per-lane warm starts: the grid paths' survivor
+        # re-solve (tuning/lane_tuner.py compacts a capped screen's winning
+        # lanes and re-solves them full-depth from where they stopped).
+        if not allow_lanes:
+            raise ValueError(
+                "per-lane (G, d) w0 is a grid-path feature; single solves "
+                "take a (d,) start")
+        if norm is not None:
+            raise ValueError(
+                "per-lane w0 with normalization is not supported; pass "
+                "normalized-space starts and normalization=None")
+        return jnp.asarray(w0)
     if norm is not None:
         return jnp.asarray(norm.to_normalized_space(np.asarray(w0)))
     return jnp.asarray(w0)
@@ -362,8 +375,15 @@ def _lane_solve(obj, batch, w0, l2s, l1s, config):
     (optim/lane_owlqn.py — the orthant projection breaks margin linearity,
     so its trials pay one SHARED X pass instead of riding cached margins).
     ``l1s is None`` + the static optimizer are the route switch; jit
-    traces each case separately."""
-    W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
+    traces each case separately.
+
+    ``w0`` is either a shared (d,) start broadcast to every lane, or a
+    lane-MAJOR (G, d) per-lane warm start (the tuner's compacted survivor
+    re-solve) transposed into the solvers' lane-minor (d, G) layout."""
+    if w0.ndim == 2:
+        W0 = w0.T
+    else:
+        W0 = jnp.broadcast_to(w0[:, None], (w0.shape[0], l2s.shape[0]))
     if l1s is not None:
         return minimize_owlqn_lanes(
             obj, l2s, l1s, batch, W0, max_iters=config.max_iters,
@@ -427,15 +447,19 @@ def _train_run_grid(batch, w0, obj, l2s, l1s, config, variance):
     each weight as a separate Spark job."""
     import dataclasses as _dc
 
-    def one(l2v, l1v):
+    def one(l2v, l1v, w0v):
         o = _dc.replace(obj, l2=l2v)
-        res = solve(o, batch, w0, config, l1_weight=l1v)
+        res = solve(o, batch, w0v, config, l1_weight=l1v)
         var = compute_variances(o, res.w, batch, variance)
         return res, var
 
+    if w0.ndim == 2:  # per-lane (G, d) warm starts ride the lane axis
+        if l1s is None:
+            return jax.vmap(lambda l2v, w0v: one(l2v, None, w0v))(l2s, w0)
+        return jax.vmap(one)(l2s, l1s, w0)
     if l1s is None:
-        return jax.vmap(lambda l2v: one(l2v, None))(l2s)
-    return jax.vmap(one)(l2s, l1s)
+        return jax.vmap(lambda l2v: one(l2v, None, w0))(l2s)
+    return jax.vmap(lambda l2v, l1v: one(l2v, l1v, w0))(l2s, l1s)
 
 
 def lane_weight_arrays(config: OptimizerConfig, reg_weights):
@@ -488,7 +512,13 @@ def train_glm_grid(
 
     Unlike the sequential path, lanes cannot warm-start from each other
     (they run concurrently); every lane starts from ``w0``. Convergence is
-    tracked per lane.
+    tracked per lane. ``w0`` may also be a lane-MAJOR (G, d) block — a
+    PER-LANE warm start (one row per reg weight), the handoff the batched
+    tuner's successive-halving re-solve uses to resume its compacted
+    survivor lanes from where the capped screen left them. Per-lane
+    starts are supported on the single-device lane and vmapped runners
+    and the sharded lane runner; not with normalization or permuted
+    layouts.
 
     ``device_results=True`` returns the raw lane-stacked ``(OptResult,
     variances)`` pytree still resident on device — no host transfer, no
@@ -537,7 +567,18 @@ def train_glm_grid(
             "form (data.dataset.shard_permuted_batch / "
             "shard_blocked_ell_batch) or ShardedHybridRows under a mesh")
     norm = _active_norm(normalization)
-    w0 = _init_w0(d, w0, norm)
+    reg_weights = list(reg_weights)
+    if np.ndim(w0) == 2:
+        if permuted:
+            raise ValueError(
+                "per-lane (G, d) w0 is not supported with permuted "
+                "layouts (the column-space translation is per-vector); "
+                "pass a shared (d,) start or a non-permuted batch")
+        if np.shape(w0) != (len(reg_weights), d):
+            raise ValueError(
+                f"per-lane w0 must be (G={len(reg_weights)}, d={d}), "
+                f"got {np.shape(w0)}")
+    w0 = _init_w0(d, w0, norm, allow_lanes=True)
     if prior is not None:
         if prior_mean is not None or prior_precision is not None:
             raise ValueError("pass prior OR prior_mean/prior_precision")
@@ -598,6 +639,11 @@ def train_glm_grid(
                  # all three optimizers have a lane-minor solver
                  and (l1s is not None) == (static_cfg.optimizer
                                            is OptimizerType.OWLQN))
+    if w0.ndim == 2 and sharded_hybrid and not use_lanes:
+        raise ValueError(
+            "per-lane w0 on the sharded grid requires the lane-minor "
+            "path (no variances/priors); this sweep routes to the "
+            "sharded vmapped runner")
     with profiling.dispatch("training._train_run_grid",
                             (batch, w0, obj, l2s, l1s)):
         if sharded_hybrid:

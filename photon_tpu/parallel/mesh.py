@@ -250,7 +250,7 @@ def fetch_local_rows(arr, mesh: Mesh) -> np.ndarray:
 
 
 def compact_rows(tree, idx, pad_rows: int | None = None,
-                 mesh: Mesh | None = None):
+                 mesh: Mesh | None = None, pad_mode: str = "zero"):
     """Gather leading-axis rows ``idx`` from every leaf of a device tree
     into a dense zero-padded ``(pad_rows, ...)`` block — the straggler
     repack of the random-effect pipeline (game/random_effect.py): the
@@ -265,17 +265,29 @@ def compact_rows(tree, idx, pad_rows: int | None = None,
     first pass; callers routing through ``dispatch_chunked`` pass
     ``mesh=None`` and let the dispatcher place the block. Zero-padded rows
     carry weight 0 in every GLMBatch, so no reduction sees them.
+
+    ``pad_mode="edge"`` repeats the LAST gathered row into the pad instead
+    of zeros — for lock-step LANE consumers (the tuner's survivor
+    re-solve), where a zero-regularization zero-weight pad lane would be
+    the slowest-converging lane in the chunk and drag the whole lock-step
+    program to its straggler budget; a duplicate of a real survivor
+    converges exactly as fast as its original.
     """
+    if pad_mode not in ("zero", "edge"):
+        raise ValueError(f"pad_mode must be 'zero' or 'edge', got {pad_mode!r}")
     idx = idx if isinstance(idx, jax.Array) else jnp.asarray(
         np.asarray(idx), jnp.int32)
     n = int(idx.shape[0])
     target = n if pad_rows is None else int(pad_rows)
+    if n == 0 and target > 0 and pad_mode == "edge":
+        raise ValueError("pad_mode='edge' needs at least one gathered row")
 
     def take(x):
         g = jnp.take(x, idx, axis=0)
         if target != n:
             widths = [(0, target - n)] + [(0, 0)] * (g.ndim - 1)
-            g = jnp.pad(g, widths)
+            g = jnp.pad(g, widths, mode=("edge" if pad_mode == "edge"
+                                         else "constant"))
         return g
 
     out = jax.tree_util.tree_map(take, tree)

@@ -89,8 +89,9 @@ def run_report(rows: int = 1 << 14, chunk_rows: int = 1 << 12,
     bench_dir = bench_dir or _repo_bench_dir()
     history = profiling.sentinel.load_history(bench_dir)
     if history:
-        _, candidate = history[-1]
-        verdicts = profiling.sentinel.gate(candidate, history[:-1])
+        _, candidate, env = history[-1]
+        verdicts = profiling.sentinel.gate(
+            candidate, profiling.sentinel.same_env(history[:-1], env))
         out["gate"] = {leg: v.to_json() for leg, v in verdicts.items()}
     return out
 

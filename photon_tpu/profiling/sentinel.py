@@ -22,6 +22,15 @@ Noise-awareness, concretely:
   to warn-only (``"no-history"``).
 - improvements never trip anything; they report ``"ok"`` with their
   (negative-bad-direction) z so the JSON line still records the movement.
+- a leg's history series is SINGLE-ENVIRONMENT: each round may carry a
+  measured host fingerprint (``parsed["env"]``, ``host_env()``), and a
+  candidate gates only against rounds with a MATCHING fingerprint
+  (``same_env``). Rounds measured on different machines are different
+  experiments — the r06 TPU→CPU break already excluded the TPU legs by
+  hand; r10 (a container-host swap: ~2× single-core speed, ~5× disk)
+  made the policy automatic. At a break, gating strength rebuilds over
+  ``MIN_HISTORY`` rounds exactly as it did at r06. Legacy rounds with
+  no fingerprint form their own series (env ``None``).
 
 Deliberately jax-free and numpy-light: ``bench.py --gate`` runs this
 BEFORE the heavyweight bench imports, so gating a PR costs milliseconds,
@@ -40,8 +49,9 @@ from typing import Iterable, Optional
 
 __all__ = [
     "DEFAULT_Z", "MIN_HISTORY", "REL_FLOOR", "SCHEMA_VERSION",
-    "LegVerdict", "leg_values", "lower_is_better", "load_history",
-    "fit_legs", "gate", "verdict_lines", "gate_main",
+    "LegVerdict", "leg_values", "lower_is_better", "host_env",
+    "env_key", "load_history", "same_env", "fit_legs", "gate",
+    "verdict_lines", "gate_main",
 ]
 
 # Robust z beyond which a bad-direction move is a regression. 3.5 is the
@@ -78,7 +88,7 @@ _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
 # the serving SLO bar is a chosen config, not a measurement.)
 _EXCLUDE_PATTERNS = ("_n_chips", "n_requests", "snapshots", "cadence",
                      "_vs_baseline", "_frac", "_width_buckets",
-                     "slo_target")
+                     "slo_target", "_n_configs")
 
 
 def lower_is_better(leg: str) -> bool:
@@ -107,16 +117,54 @@ def leg_values(parsed: Optional[dict]) -> dict[str, float]:
     return out
 
 
+def host_env() -> str:
+    """This machine's bench-comparability fingerprint: CPU model + the
+    visible core count. Two rounds are comparable iff their fingerprints
+    are EQUAL — rates move with the core, and the gate must not read a
+    container-host swap as a code regression (nor absorb one into the
+    MAD and then miss a real one). Disk class is deliberately absent:
+    it has no discrete label to key on; I/O-bound legs on a swapped
+    disk still need the fingerprint break above to reset their series."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return f"{model or 'unknown-cpu'}/nproc={os.cpu_count()}"
+
+
+def env_key(parsed: Optional[dict]) -> Optional[str]:
+    """One round's recorded host fingerprint (``None`` for the legacy
+    rounds that predate fingerprinting — their own series)."""
+    if not parsed:
+        return None
+    env = parsed.get("env")
+    return env if isinstance(env, str) else None
+
+
+def same_env(history: Iterable[tuple], env: Optional[str]) -> list[tuple]:
+    """The single-environment slice of the history: rounds whose
+    fingerprint matches ``env``. Bare ``(name, legs)`` pairs (tests,
+    pre-fingerprint callers) count as env ``None``."""
+    return [h for h in history
+            if (h[2] if len(h) > 2 else None) == env]
+
+
 def _round_key(path: str) -> tuple:
     m = re.search(r"_r(\d+)", os.path.basename(path))
     return (int(m.group(1)) if m else -1, os.path.basename(path))
 
 
 def load_history(bench_dir: str, pattern: str = "BENCH_r*.json"
-                 ) -> list[tuple[str, dict]]:
-    """[(round_name, {leg: value})] in round order. Rounds whose file is
-    unreadable or whose ``parsed`` is null contribute nothing (the r01
-    seed round predates the JSON-line protocol)."""
+                 ) -> list[tuple[str, dict, Optional[str]]]:
+    """[(round_name, {leg: value}, env_fingerprint)] in round order.
+    Rounds whose file is unreadable or whose ``parsed`` is null
+    contribute nothing (the r01 seed round predates the JSON-line
+    protocol); rounds that predate fingerprinting carry env ``None``."""
     out = []
     for path in sorted(glob.glob(os.path.join(bench_dir, pattern)),
                        key=_round_key):
@@ -125,18 +173,21 @@ def load_history(bench_dir: str, pattern: str = "BENCH_r*.json"
                 doc = json.load(fh)
         except (OSError, json.JSONDecodeError):
             continue
-        legs = leg_values(doc.get("parsed"))
+        parsed = doc.get("parsed")
+        legs = leg_values(parsed)
         if legs:
-            out.append((os.path.basename(path), legs))
+            out.append((os.path.basename(path), legs, env_key(parsed)))
     return out
 
 
-def fit_legs(history: Iterable[tuple[str, dict]]) -> dict[str, dict]:
-    """Per-leg robust location/scale over the history:
+def fit_legs(history: Iterable[tuple]) -> dict[str, dict]:
+    """Per-leg robust location/scale over the history (``(name, legs)``
+    pairs or ``(name, legs, env)`` triples — filter with ``same_env``
+    FIRST; the fit itself is fingerprint-blind):
     {leg: {median, mad, scale, n}}."""
     series: dict[str, list[float]] = {}
-    for _, legs in history:
-        for leg, v in legs.items():
+    for item in history:
+        for leg, v in item[1].items():
             series.setdefault(leg, []).append(v)
     fits = {}
     for leg, vals in series.items():
@@ -189,12 +240,14 @@ class LegVerdict:
 
 
 def gate(candidate: dict[str, float],
-         history: Iterable[tuple[str, dict]],
+         history: Iterable[tuple],
          z_threshold: float = DEFAULT_Z,
          min_history: int = MIN_HISTORY) -> dict[str, LegVerdict]:
     """Judge one round's legs against the history. Regression == the
     signed-bad-direction z exceeds ``z_threshold``; short-history legs
-    admit as "new"; an empty history marks everything "no-history"."""
+    admit as "new"; an empty history marks everything "no-history".
+    The statistics are fingerprint-blind — pass the candidate's
+    ``same_env`` slice, not the raw trajectory."""
     history = list(history)
     fits = fit_legs(history)
     verdicts: dict[str, LegVerdict] = {}
@@ -225,19 +278,20 @@ def verdict_lines(verdicts: dict[str, LegVerdict]) -> list[str]:
     return [f"{leg}: {v.line}" for leg, v in sorted(verdicts.items())]
 
 
-def _load_candidate(path: str) -> dict[str, float]:
-    """A candidate round from a file holding either a BENCH_r0*.json
-    wrapper or a bare bench JSON line."""
+def _load_candidate(path: str) -> tuple[dict[str, float], Optional[str]]:
+    """(legs, env_fingerprint) for a candidate round from a file holding
+    either a BENCH_r0*.json wrapper or a bare bench JSON line."""
     with open(path) as fh:
         doc = json.load(fh)
-    return leg_values(doc.get("parsed") if "parsed" in doc else doc)
+    parsed = doc.get("parsed") if "parsed" in doc else doc
+    return leg_values(parsed), env_key(parsed)
 
 
 def gate_main(argv: list[str], bench_dir: Optional[str] = None) -> int:
     """The ``bench.py --gate`` entry: candidate = --gate-candidate FILE,
-    or the LATEST history round (gated against the earlier ones). Prints
-    one verdict line per leg plus a summary JSON line; exit 1 iff any
-    leg regressed."""
+    or the LATEST history round (gated against the earlier ones, sliced
+    to the candidate's host fingerprint). Prints one verdict line per
+    leg plus a summary JSON line; exit 1 iff any leg regressed."""
     def _flag(name: str, default=None):
         return (argv[argv.index(name) + 1] if name in argv else default)
 
@@ -246,12 +300,13 @@ def gate_main(argv: list[str], bench_dir: Optional[str] = None) -> int:
     cand_path = _flag("--gate-candidate")
     history = load_history(bench_dir)
     if cand_path is not None:
-        candidate = _load_candidate(cand_path)
+        candidate, cand_env = _load_candidate(cand_path)
     elif history:
-        _, candidate = history[-1]
+        _, candidate, cand_env = history[-1]
         history = history[:-1]
     else:
-        candidate = {}
+        candidate, cand_env = {}, None
+    history = same_env(history, cand_env)
     verdicts = gate(candidate, history, z_threshold=z)
     for line in verdict_lines(verdicts):
         print(line)
@@ -259,7 +314,7 @@ def gate_main(argv: list[str], bench_dir: Optional[str] = None) -> int:
                        if v.status == "regressed")
     print(json.dumps({
         "metric": "bench_gate", "schema": SCHEMA_VERSION,
-        "ok": not regressed, "z_threshold": z,
+        "ok": not regressed, "z_threshold": z, "env": cand_env,
         "n_history_rounds": len(history), "n_legs": len(verdicts),
         "regressed": regressed,
         "verdicts": {leg: v.to_json() for leg, v in verdicts.items()},

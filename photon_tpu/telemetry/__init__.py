@@ -72,7 +72,12 @@ worker_chunks/worker_deaths counters and the workers gauge from the
 sharded decode pool (a death = one chunk degraded to in-process
 decode), cache_hits/cache_misses/cache_builds/cache_commits/
 cache_chunks/cache_bytes/cache_invalid from the decode-once chunk
-cache — with the stall-driven prefetch's
+cache — the lane-batched tuner's `tuning.*` family —
+rounds/configs/survivor_resolves counters and the
+round_model_flops gauge (the modeled FLOPs `profiling.model.estimate_fn`
+priced the round's lane dispatch at, published BEFORE dispatch so a
+budget breach is attributable), with one `tuning.round` span per
+GP-propose/screen/halve/re-solve round — with the stall-driven prefetch's
 stream.prefetch_widened/stream.prefetch_narrowed counters and one
 `prefetch_decision` event per depth verdict beside the existing
 stream.prefetch_depth gauge — and HBM
@@ -299,6 +304,7 @@ TELEMETRY_REGISTRY = {
         "game_e2e.host_offset_sums", "game_e2e.score_stream_chunks",
         "game_e2e.score_stream_rows", "game_e2e.chunked_fit_points",
         "eval.scatter_elems_saved",
+        "tuning.rounds", "tuning.configs", "tuning.survivor_resolves",
     ),
     "gauges": (
         "stream.prefetch_depth", "ingest.workers",
@@ -307,9 +313,11 @@ TELEMETRY_REGISTRY = {
         "serving.queue_depth", "serving.batch_fill",
         "serving.latency_*", "serving.fleet_replicas",
         "hbm.bytes_in_use.max*", "hbm.peak_bytes_in_use.max*",
+        "tuning.round_model_flops",
     ),
     "span_families": (
         "train", "score", "ingest", "solve",
         "game", "game_re", "serving", "checkpoint", "continual",
+        "tuning",
     ),
 }

@@ -50,22 +50,43 @@ def qei(gp: GaussianProcess, X_batch, best_y: float,
 
 
 def qei_greedy(gp: GaussianProcess, pool, best_y: float, q: int,
-               n_samples: int = 256, seed: int = 0) -> list:
+               n_samples: int = 256, seed: int = 0, costs=None) -> list:
     """Greedy true-q-EI batch selection over a candidate pool.
 
     One set of S joint fantasies over the WHOLE pool; pick j+1 maximizes
     the exact MC increment of the joint q-EI given picks 1..j (classic
     submodular greedy — within (1−1/e) of the optimal batch under the
     shared fantasies). Returns pool indices in pick order.
+
+    ``costs`` (optional, (P,) positive) makes the greedy COST-AWARE: each
+    pick maximizes the marginal improvement PER UNIT modeled cost
+    (gain/cost — the cost-normalized knapsack-greedy rule), the hook the
+    lane tuner uses to price proposals in modeled FLOPs before dispatch.
+    Uniform costs reduce exactly to the plain greedy.
     """
     Z = gp.sample_joint(pool, n_samples, seed)  # (S, P)
     S, P = Z.shape
+    if costs is not None:
+        costs = np.asarray(costs, np.float64)
+        if costs.shape != (P,):
+            raise ValueError(
+                f"costs must be shaped like the pool ({P},), got "
+                f"{costs.shape}")
+        if not (costs > 0).all():
+            raise ValueError("costs must be positive")
     m = np.full(S, np.inf, np.float64)  # per-fantasy running batch minimum
     picked: list = []
     avail = np.ones(P, bool)
     for _ in range(min(q, P)):
         gains = np.mean(np.maximum(0.0, best_y - np.minimum(m[:, None], Z)),
                         axis=0)
+        if costs is not None:
+            # normalize the MARGINAL increment over the batch-so-far (the
+            # running value is a constant across candidates, so without
+            # costs the argmax is unchanged — uniform costs reduce to the
+            # plain greedy bitwise)
+            cur = float(np.mean(np.maximum(0.0, best_y - m)))
+            gains = (gains - cur) / costs
         gains[~avail] = -np.inf
         j = int(np.argmax(gains))
         picked.append(j)

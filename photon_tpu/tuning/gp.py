@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import dataclasses
 from contextlib import nullcontext
-from typing import Callable
+from functools import partial
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_tpu.analysis.rules import TraceSignatureLog
+from photon_tpu.data.matrix import next_pow2
 from photon_tpu.optim.lbfgs import minimize_lbfgs
 
 
@@ -43,6 +46,20 @@ JITTER = 1e-6
 # fitted noise at NOISE_FLOOR × amplitude (y is standardized, so this is a
 # ~1% noise floor — still effectively interpolating).
 NOISE_FLOOR = 1e-4
+
+# Pow2 observation-history ladder: a tuning run's observation count grows
+# by one batch per round, so an unpadded fit would compile a fresh
+# (n, n)-shaped NLL while_loop at EVERY round (the tier-1 conftest's
+# "~100 growing training-set shapes"). (X, y) pad to the next pow2 rung
+# (floor HISTORY_FLOOR) with a 0/1 mask that makes the padded Gram exactly
+# block-diagonal — [K_real + σ²I, 0; 0, I] — so the masked NLL, posterior
+# solve, and every query are BITWISE the unpadded math on the real block,
+# while one compiled program per rung serves the whole run. _FIT_SIG_LOG
+# records each fit's padded trace signature; the signature-count test pins
+# the ladder.
+HISTORY_FLOOR = 8
+_FIT_SIG_LOG = TraceSignatureLog()
+FIT_SIG_NAME = "tuning.fit_gp"
 
 
 def _sqdist(X1, X2, inv_lengthscales):
@@ -75,22 +92,28 @@ KERNELS: dict[str, Callable] = {"rbf": rbf_kernel, "matern52": matern52_kernel}
 class GaussianProcess:
     """Fitted GP posterior (reference: GaussianProcessModel)."""
 
-    X: jnp.ndarray  # (n, d) observed points
+    X: jnp.ndarray  # (N, d) observed points, padded to the pow2 ladder
     y_mean: float
     y_std: float
-    alpha: jnp.ndarray  # K⁻¹ y_centered
-    L: jnp.ndarray  # chol(K + σ²I)
+    alpha: jnp.ndarray  # K⁻¹ y_centered (padded entries exactly 0)
+    L: jnp.ndarray  # chol(K + σ²I); identity on the padded block
     amplitude: float
     inv_lengthscales: jnp.ndarray
     noise: float
     kernel_name: str = "matern52"
+    mask: Optional[jnp.ndarray] = None  # (N,) 1=real observation, 0=pad
 
     def _query(self, Xq) -> tuple[jnp.ndarray, jnp.ndarray]:
         """(standardized-space posterior mean, whitened cross-solve v) at
-        query points — the shared core of predict and sample_joint."""
+        query points — the shared core of predict and sample_joint. Padded
+        observations are invisible: the cross-covariance columns into the
+        pad are zeroed, their alpha entries are already 0, and L's padded
+        block is the identity, so the whitened solve rows vanish too."""
         kern = KERNELS[self.kernel_name]
         Kq = kern(jnp.asarray(Xq, jnp.float32), self.X,
                   self.amplitude, self.inv_lengthscales)
+        if self.mask is not None:
+            Kq = Kq * self.mask[None, :]
         v = jax.scipy.linalg.solve_triangular(self.L, Kq.T, lower=True)
         return Kq @ self.alpha, v
 
@@ -139,7 +162,18 @@ class GaussianProcess:
             return Z * self.y_std + self.y_mean
 
 
-def _nll_builder(X, y, kernel_name):
+def _masked_gram(kern, X, mask, amp, inv_ls, noise):
+    """K over padded points, exactly block-diagonal: the real block gets
+    kern + σ²I, padded rows/cols are zeroed and their diagonal set to 1 —
+    so Cholesky, logdet, and every solve reduce bitwise to the unpadded
+    math (padded logdet contribution: log 1 = 0; padded solves: y = 0)."""
+    n = X.shape[0]
+    M = mask[:, None] * mask[None, :]
+    return (kern(X, X, amp, inv_ls) * M
+            + jnp.eye(n) * (noise * mask + (1.0 - mask)))
+
+
+def _nll_builder(X, y, mask, kernel_name):
     kern = KERNELS[kernel_name]
     n, d = X.shape
 
@@ -148,9 +182,14 @@ def _nll_builder(X, y, kernel_name):
             amp = jnp.exp(theta[0])
             inv_ls = jnp.exp(-theta[1:1 + d])
             noise = jnp.exp(theta[-1]) + NOISE_FLOOR * amp
-            K = kern(X, X, amp, inv_ls) + noise * jnp.eye(n)
+            K = _masked_gram(kern, X, mask, amp, inv_ls, noise)
             L = jnp.linalg.cholesky(K)
             a = jax.scipy.linalg.cho_solve((L, True), y)
+            # The 2π term uses the PADDED count: a shape constant, so one
+            # program serves every real count on the rung (the real count
+            # would bake a fresh literal per fit). It offsets the NLL by
+            # 0.5·(n_pad − n_real)·log 2π — constant in theta, so the
+            # argmin (all the fit consumes) is untouched.
             return (0.5 * y @ a
                     + jnp.sum(jnp.log(jnp.diagonal(L)))
                     + 0.5 * n * jnp.log(2.0 * jnp.pi))
@@ -158,6 +197,20 @@ def _nll_builder(X, y, kernel_name):
         return jax.value_and_grad(nll)(theta)
 
     return nll_vg
+
+
+@partial(jax.jit, static_argnames=("kernel", "max_iters"))
+def _fit_theta(X, y, mask, theta0, *, kernel, max_iters):
+    """The whole hyperparameter fit as ONE jitted program with (X, y,
+    mask) as ARGUMENTS. fit_gp used to hand minimize_lbfgs a fresh
+    nll closure per call, so jax's jit cache — keyed on function
+    identity, not just shapes — recompiled the ~1.3 s NLL while_loop on
+    EVERY fit even when the pow2 ladder made the shapes identical. A
+    module-level function keeps the identity stable: one compile per
+    (rung shape, d, kernel, max_iters) serves the process."""
+    nll_vg = _nll_builder(X, y, mask, kernel)
+    return minimize_lbfgs(nll_vg, theta0, max_iters=max_iters,
+                          tolerance=1e-9).w
 
 
 def fit_gp(
@@ -176,18 +229,31 @@ def fit_gp(
 
 
 def _fit_gp_body(X, y, kernel, max_iters) -> GaussianProcess:
-    X = jnp.asarray(np.asarray(X, np.float32))
+    X_real = np.asarray(X, np.float32)
     y_raw = np.asarray(y, np.float32)
     y_mean = float(y_raw.mean())
     y_std = float(y_raw.std()) or 1.0
-    y = jnp.asarray((y_raw - y_mean) / y_std)
-    n, d = X.shape
+    n_real, d = X_real.shape
+
+    # Pad to the pow2 history rung (weight-0 masking; see HISTORY_FLOOR
+    # note above): one compiled NLL/posterior program per rung serves the
+    # whole tuning run instead of one per observation count.
+    n = next_pow2(n_real, floor=HISTORY_FLOOR)
+    X_pad = np.zeros((n, d), np.float32)
+    X_pad[:n_real] = X_real
+    y_pad = np.zeros((n,), np.float32)
+    y_pad[:n_real] = (y_raw - y_mean) / y_std
+    mask_np = np.zeros((n,), np.float32)
+    mask_np[:n_real] = 1.0
+    X = jnp.asarray(X_pad)
+    y = jnp.asarray(y_pad)
+    mask = jnp.asarray(mask_np)
 
     theta0 = jnp.zeros((d + 2,), jnp.float32)  # log amp, log ls_i, log noise
     theta0 = theta0.at[-1].set(-4.0)
-    res = minimize_lbfgs(_nll_builder(X, y, kernel), theta0,
-                         max_iters=max_iters, tolerance=1e-9)
-    theta = res.w
+    _FIT_SIG_LOG.record(FIT_SIG_NAME, (X, y, mask, theta0))
+    theta = _fit_theta(X, y, mask, theta0, kernel=kernel,
+                       max_iters=max_iters)
     if not bool(jnp.isfinite(theta).all()):
         theta = theta0  # hyperparameter fit diverged; prior defaults
 
@@ -197,7 +263,7 @@ def _fit_gp_body(X, y, kernel, max_iters) -> GaussianProcess:
         amp = float(jnp.exp(theta[0]))
         inv_ls = jnp.exp(-theta[1:1 + d])
         noise = float(jnp.exp(theta[-1])) + NOISE_FLOOR * amp
-        K = kern(X, X, amp, inv_ls) + noise * jnp.eye(n)
+        K = _masked_gram(kern, X, mask, amp, inv_ls, noise)
         L = jnp.linalg.cholesky(K)
         alpha = jax.scipy.linalg.cho_solve((L, True), y)
         return amp, inv_ls, noise, L, alpha
@@ -208,5 +274,5 @@ def _fit_gp_body(X, y, kernel, max_iters) -> GaussianProcess:
     return GaussianProcess(
         X=X, y_mean=y_mean, y_std=y_std, alpha=alpha, L=L,
         amplitude=amp, inv_lengthscales=inv_ls, noise=noise,
-        kernel_name=kernel,
+        kernel_name=kernel, mask=mask,
     )

@@ -160,12 +160,20 @@ def tune_glm_reg(
     evaluator=None,
     mesh=None,
     seed: int = 0,
+    lanes: Optional[int] = None,
 ):
     """Bayesian search over a GLM's regularization weight with BATCHED
     evaluations: each GP round's `batch_size` candidates train as ONE
     `train_glm_grid` program (lanes share every X pass) and score in one
     batched pass — the TPU-native form of the reference's
     one-Spark-job-per-candidate HyperparameterTuner loop.
+
+    ``lanes`` switches to the lane-batched successive-halving tuner
+    (`lane_tuner.tune_glm_reg_lanes`): proposal rounds dispatch as
+    fixed pow2 lane chunks of that width with capped-budget screening
+    and warm-started survivor re-solves — ``n_iters`` then counts total
+    CONFIGS (≥ ``lanes``) and ``batch_size`` is ignored (the chunk IS
+    the batch). The point-at-a-time GP loop stays the default.
 
     Returns ``(best_model, best_reg_weight, TuningResult)``; the tuning
     result's ``ys`` are the minimized metric values (AUC-like metrics are
@@ -174,6 +182,14 @@ def tune_glm_reg(
     from photon_tpu.evaluation.evaluator import default_evaluator
     from photon_tpu.models.training import evaluate_glm_grid, train_glm_grid
     from photon_tpu.tuning.search import SearchRange
+
+    if lanes is not None:
+        from photon_tpu.tuning.lane_tuner import tune_glm_reg_lanes
+
+        return tune_glm_reg_lanes(
+            train_batch, task, config, val_batch, n_configs=n_iters,
+            lane_chunk=lanes, reg_range=reg_range, evaluator=evaluator,
+            mesh=mesh, seed=seed)
 
     evaluator = evaluator if evaluator is not None else default_evaluator(task)
     space = SearchSpace([SearchRange(*reg_range, log_scale=True)])

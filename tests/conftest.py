@@ -65,6 +65,14 @@ def pytest_configure(config):
         "long-running end-to-end checks like the umbrella selfcheck.")
     config.addinivalue_line(
         "markers",
+        "tier2: acceptance tests promoted OUT of the tier-1 wall (round-16 "
+        "suite-time relief) — statistical end-to-end properties (GP-beats-"
+        "random, q-EI-vs-constant-liar, mesh game grids) that each burn "
+        "15-60 s re-proving claims the faster unit tests already pin. "
+        "Run them with -m tier2 (they implicitly carry `slow`, so the "
+        "tier-1 selection -m 'not slow' keeps excluding them).")
+    config.addinivalue_line(
+        "markers",
         "release_programs: drop this module's compiled XLA programs at "
         "module teardown (jax.clear_caches + photon_tpu program caches). "
         "Apply (pytestmark = pytest.mark.release_programs) to any module "
@@ -73,6 +81,15 @@ def pytest_configure(config):
         "many live executables have accumulated in the process "
         "(~460; first seen from test_streamed_mesh's 8-device shard_map "
         "programs breaking test_tuning's GP while_loop compile).")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Every `tier2` item implicitly carries `slow`: tier-2 promotion is
+    one marker at the test site, and the long-standing tier-1 selection
+    (-m 'not slow') needs no change to exclude the promoted set."""
+    for item in items:
+        if item.get_closest_marker("tier2") is not None:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(scope="module", autouse=True)

@@ -24,16 +24,31 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 43 specs (round 15 added the roofline-closure pins: the Pallas
-    kernel X passes, the kernel-dispatch and donated-ring no-retrace
-    invariances, and the quantized serving rung) spanning every workload
-    family."""
-    assert len(_REGISTRY) >= 43
+    """≥ 46 specs (round 16 added the lane-tuner pins: the fixed-chunk
+    tuning dispatch invariance and the pre-dispatch round budget)
+    spanning every workload family."""
+    assert len(_REGISTRY) >= 46
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
-                   "evaluation", "continual", "ingest", "kernels"):
+                   "evaluation", "continual", "ingest", "kernels",
+                   "tuning"):
         assert family in tags, f"no contract covers the {family} family"
+
+
+def test_lane_tuner_specs_are_registered():
+    """The round-16 acceptance pins, strict: the tuning lane dispatch
+    (pow2 proposal padding never changes the screen program's trace
+    signature) and the round budget (modeled cost enforced BEFORE
+    dispatch; the halving tail's compact_rows + re-solve traces clean)
+    both budget ZERO collectives with no transfer/f64 escape hatch."""
+    for name in ("tuning_lane_dispatch", "tuning_round_budget"):
+        spec = _REGISTRY[name]
+        assert dict(spec.collectives or {}) == {}, name
+        assert not spec.allow_transfers and not spec.allow_f64, name
+        assert "tuning" in spec.tags and "lane" in spec.tags, name
+        violations = check_contract(spec)
+        assert violations == [], "\n".join(str(v) for v in violations)
 
 
 def test_roofline_closure_specs_are_registered():

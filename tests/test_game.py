@@ -687,6 +687,7 @@ class TestVectorizedGameGrid:
                      dataclasses.replace(cfg_r, reg_weight=wr))}
                 for wf, wr in pairs]
 
+    @pytest.mark.tier2
     def test_mixed_grid_matches_sequential(self, rng):
         """The top round-3 deliverable: lane-axis GAME grid == sequential
         per point (mirroring the fixed-only pin above), with per-lane
@@ -757,6 +758,7 @@ class TestVectorizedGameGrid:
         w_hi = np.asarray(fast[1].model["fixed"].model.coefficients.means)
         assert (w_hi == 0.0).sum() > 0
 
+    @pytest.mark.tier2
     def test_runs_on_mesh(self, rng, mesh8):
         """The lane path under a mesh (entity-axis sharded RE chunks,
         row-sharded fixed batch) matches the single-device lane path."""

@@ -148,6 +148,7 @@ class TestGameIncremental:
         # unseen entities trained freely — not pinned to zero-prior means
         assert not np.allclose(got[E // 2:], 0.0)
 
+    @pytest.mark.tier2
     def test_estimator_incremental_beats_cold_start_on_new_batch(self, rng):
         """Second-batch training with first-batch priors must track the
         pooled solution better than training on the second batch alone."""

@@ -1,0 +1,183 @@
+"""Lane-batched cost-aware tuner tests (round 16): fixed-chunk dispatch
+with zero retrace across rounds, successive-halving survivor compaction
+edges, the cost model's pre-dispatch budget gate, and the GP pow2
+observation ladder that keeps the fit from recompiling per round."""
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import make_batch
+from photon_tpu.ops.losses import TaskType
+from photon_tpu.optim.config import OptimizerConfig
+from photon_tpu.optim.regularization import l2
+from photon_tpu.parallel.mesh import compact_rows
+from photon_tpu.tuning import (
+    LaneBudget,
+    LaneTuningResult,
+    RoundBudgetError,
+    fit_gp,
+    tune_glm_reg_lanes,
+)
+from photon_tpu.tuning import gp as gp_mod
+from photon_tpu.tuning.acquisition import qei_greedy
+
+
+def _logistic_problem(rng, n=384, d=8):
+    w_true = rng.normal(size=d)
+
+    def draw(m):
+        X = rng.normal(size=(m, d)).astype(np.float32)
+        y = (X @ w_true + 0.5 * rng.normal(size=m) > 0).astype(np.float32)
+        return make_batch(X, y)
+
+    return draw(n), draw(n // 2)
+
+
+class TestCompactRowsEdges:
+    """The tuner's survivor repack: compact_rows over halving outcomes
+    that fall off the happy path (none survive / everyone survives /
+    a non-pow2 count padded back up to the lane chunk)."""
+
+    def test_zero_survivors_zero_pad(self):
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        out = np.asarray(compact_rows(x, np.zeros((0,), np.int32),
+                                      pad_rows=4))
+        assert out.shape == (4, 4)
+        assert (out == 0.0).all()
+
+    def test_zero_survivors_edge_pad_rejected(self):
+        # edge mode repeats the LAST gathered row; with nothing gathered
+        # there is nothing to repeat — must refuse, not emit garbage
+        with pytest.raises(ValueError, match="at least one"):
+            compact_rows(np.ones((6, 4), np.float32),
+                         np.zeros((0,), np.int32), pad_rows=4,
+                         pad_mode="edge")
+
+    def test_all_survivors_identity(self):
+        x = np.arange(20, dtype=np.float32).reshape(5, 4)
+        out = np.asarray(compact_rows(x, np.arange(5, dtype=np.int32)))
+        np.testing.assert_array_equal(out, x)
+
+    def test_non_pow2_survivors_zero_vs_edge_pad(self):
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        idx = np.asarray([1, 6, 3], np.int32)  # 3 survivors -> chunk 4
+        z = np.asarray(compact_rows(x, idx, pad_rows=4))
+        e = np.asarray(compact_rows(x, idx, pad_rows=4, pad_mode="edge"))
+        np.testing.assert_array_equal(z[:3], x[idx])
+        np.testing.assert_array_equal(e[:3], x[idx])
+        assert (z[3] == 0.0).all()
+        np.testing.assert_array_equal(e[3], x[3])  # last gathered row
+
+    def test_invalid_pad_mode(self):
+        with pytest.raises(ValueError, match="pad_mode"):
+            compact_rows(np.ones((4, 2), np.float32),
+                         np.asarray([0], np.int32), pad_mode="mirror")
+
+
+class TestQeiGreedyEdges:
+    def test_overdraw_returns_whole_pool_without_repeats(self, rng):
+        gp = fit_gp(rng.uniform(size=(9, 1)).astype(np.float32),
+                    rng.normal(size=9))
+        pool = rng.uniform(size=(5, 1)).astype(np.float32)
+        picks = qei_greedy(gp, pool, best_y=0.0, q=12, seed=3)
+        assert sorted(picks) == [0, 1, 2, 3, 4]
+
+    def test_uniform_costs_match_costless_greedy(self, rng):
+        gp = fit_gp(rng.uniform(size=(10, 1)).astype(np.float32),
+                    rng.normal(size=10))
+        pool = rng.uniform(size=(24, 1)).astype(np.float32)
+        plain = qei_greedy(gp, pool, best_y=0.0, q=6, seed=5)
+        uniform = qei_greedy(gp, pool, best_y=0.0, q=6, seed=5,
+                             costs=np.full(24, 37.5))
+        assert plain == uniform
+
+    def test_costs_steer_ties_to_the_cheap_duplicate(self, rng):
+        gp = fit_gp(rng.uniform(size=(8, 1)).astype(np.float32),
+                    rng.normal(size=8))
+        point = rng.uniform(size=(1, 1)).astype(np.float32)
+        pool = np.concatenate([point, point])  # identical gains
+        costs = np.asarray([50.0, 1.0])
+        picks = qei_greedy(gp, pool, best_y=1e3, q=1, seed=0, costs=costs)
+        assert picks == [1]
+
+
+class TestGpObservationLadder:
+    def test_growing_history_stays_on_rung_signatures(self, rng):
+        # warm the d=2 rung-16 program, then 7 growing counts on the same
+        # rung must add ZERO fit signatures (the per-round retrace the
+        # ladder exists to kill)
+        def fit_at(k):
+            Xo = rng.uniform(size=(k, 2)).astype(np.float32)
+            fit_gp(Xo, np.sin(3 * Xo[:, 0]) + Xo[:, 1])
+
+        fit_at(16)
+        base = len(gp_mod._FIT_SIG_LOG.signatures(gp_mod.FIT_SIG_NAME))
+        for k in range(9, 16):
+            fit_at(k)
+        now = len(gp_mod._FIT_SIG_LOG.signatures(gp_mod.FIT_SIG_NAME))
+        assert now == base
+
+    def test_padded_fit_interpolates_real_points_only(self, rng):
+        # 5 real observations pad to the rung-8 block; the masked Gram
+        # must keep the pad invisible — the posterior still interpolates
+        # the real points as if unpadded
+        X = rng.uniform(size=(5, 1)).astype(np.float32)
+        y = np.sin(4 * X[:, 0])
+        gp = fit_gp(X, y)
+        assert gp.X.shape[0] == 8 and float(gp.mask.sum()) == 5.0
+        mean, _ = gp.predict(X)
+        np.testing.assert_allclose(np.asarray(mean), y, atol=0.05)
+
+
+class TestLaneTuner:
+    @pytest.fixture(scope="class")
+    def outcome(self):
+        rng = np.random.default_rng(16)
+        train, val = _logistic_problem(rng)
+        cfg = OptimizerConfig(max_iters=24, reg=l2(), history=5)
+        base = LaneTuningResult.signature_count()
+        model, best_w, res = tune_glm_reg_lanes(
+            train, TaskType.LOGISTIC_REGRESSION, cfg, val,
+            n_configs=16, lane_chunk=8, seed=0)
+        return train, val, cfg, base, model, best_w, res
+
+    def test_recovers_a_strong_config(self, outcome):
+        _, _, _, _, model, best_w, res = outcome
+        assert len(res.ys) == 16 and len(res.rounds) == 2
+        assert 1e-4 <= best_w <= 1e4
+        assert res.best_y < -0.75  # negated validation AUC
+        hist = res.history()
+        assert (np.diff(hist) <= 1e-12).all()  # incumbent only improves
+        assert np.asarray(model.coefficients.means).ndim == 1
+
+    def test_round_stats_cost_model(self, outcome):
+        *_, res = outcome
+        for rs in res.rounds:
+            assert rs.modeled_flops > 0 and rs.modeled_bytes > 0
+            assert rs.modeled_collective_bytes == 0  # single-device
+            assert rs.n_proposed == 8 and rs.n_survivors == 2
+            assert rs.flops_per_config > 0
+
+    def test_no_retrace_across_rounds_and_reruns(self, outcome):
+        train, val, cfg, base, *_ = outcome
+        # the whole multi-round tune dispatched exactly two lane programs
+        n_sigs = LaneTuningResult.assert_no_retrace(base + 2)
+        # a second tune (different seed, same shapes) adds ZERO
+        tune_glm_reg_lanes(train, TaskType.LOGISTIC_REGRESSION, cfg, val,
+                           n_configs=16, lane_chunk=8, seed=9)
+        LaneTuningResult.assert_no_retrace(n_sigs)
+
+    def test_starved_budget_raises_before_dispatch(self, outcome):
+        train, val, cfg, *_ = outcome
+        with pytest.raises(RoundBudgetError):
+            tune_glm_reg_lanes(train, TaskType.LOGISTIC_REGRESSION, cfg,
+                               val, n_configs=8, lane_chunk=8, seed=1,
+                               budget=LaneBudget(max_round_flops=10.0))
+
+    def test_rejects_non_pow2_chunk_and_short_budget(self, outcome):
+        train, val, cfg, *_ = outcome
+        with pytest.raises(ValueError, match="pow2"):
+            tune_glm_reg_lanes(train, TaskType.LOGISTIC_REGRESSION, cfg,
+                               val, n_configs=12, lane_chunk=6)
+        with pytest.raises(ValueError):
+            tune_glm_reg_lanes(train, TaskType.LOGISTIC_REGRESSION, cfg,
+                               val, n_configs=4, lane_chunk=8)

@@ -344,6 +344,35 @@ class TestSentinel:
                              shist)["serving_slo_overload_shed_pct"
                                     ].status == "ok"
 
+    def test_tuning_e2e_leg_admission(self):
+        """The round-16 lane-tuner legs as the sentinel sees them: the
+        configs-per-second rates and the speedup admit as 'new' and gate
+        higher-better (a collapse toward point-at-a-time parity is the
+        regression the leg exists to catch); the config count is a
+        chosen budget, never gated."""
+        verdicts = sentinel.gate(
+            {"tuning_e2e_configs_per_sec": 62.0,
+             "tuning_e2e_sequential_configs_per_sec": 5.9,
+             "tuning_e2e_speedup_vs_sequential": 10.7,
+             "tuning_e2e_n_configs": 256.0,
+             "dense_rate": 1e8},
+            _history())
+        for leg in ("tuning_e2e_configs_per_sec",
+                    "tuning_e2e_sequential_configs_per_sec",
+                    "tuning_e2e_speedup_vs_sequential"):
+            assert verdicts[leg].status == "new", leg
+            assert not sentinel.lower_is_better(leg)
+        assert "tuning_e2e_n_configs" not in verdicts  # config budget
+        assert verdicts["dense_rate"].status == "ok"
+        shist = _history(leg="tuning_e2e_speedup_vs_sequential", base=10.7)
+        assert sentinel.gate({"tuning_e2e_speedup_vs_sequential": 1.1},
+                             shist)["tuning_e2e_speedup_vs_sequential"
+                                    ].status == "regressed"
+        rhist = _history(leg="tuning_e2e_configs_per_sec", base=62.0)
+        assert sentinel.gate({"tuning_e2e_configs_per_sec": 90.0},
+                             rhist)["tuning_e2e_configs_per_sec"
+                                    ].status == "ok"
+
     def test_leg_values_flattens_headline_and_skips_dups(self):
         legs = sentinel.leg_values({
             "metric": "headline", "value": 2.0,
@@ -356,7 +385,62 @@ class TestSentinel:
         (tmp_path / "BENCH_r03.json").write_text(
             json.dumps(_wrap({"a": 1.0})))
         hist = sentinel.load_history(str(tmp_path))
-        assert hist == [("BENCH_r03.json", {"a": 1.0})]
+        assert hist == [("BENCH_r03.json", {"a": 1.0}, None)]
+
+    def test_same_env_slices_single_environment_series(self):
+        """A leg's history series is single-environment: ``same_env``
+        keeps only rounds whose host fingerprint matches the candidate's
+        (the r06 TPU→CPU exclusion policy, automated at the r10
+        container-host swap). Legacy pairs/rounds with no fingerprint
+        form their own env-``None`` series."""
+        hist = [("r1", {"rate": 1.00e8}, "hostA"),
+                ("r2", {"rate": 1.01e8}, "hostA"),
+                ("r3", {"rate": 0.99e8}, "hostA"),
+                ("r4", {"rate": 1.02e8}, None)]
+        assert [h[0] for h in sentinel.same_env(hist, "hostA")] == \
+            ["r1", "r2", "r3"]
+        assert sentinel.same_env(hist, None) == [hist[3]]
+        assert sentinel.same_env(hist, "hostB") == []
+        # bare (name, legs) pairs (the test/legacy shape) are env None
+        assert sentinel.same_env(_history(), None) == _history()
+        # a collapse judged against a DIFFERENT host's rounds is
+        # warn-only, not a regression — nothing is comparable
+        v = sentinel.gate({"rate": 0.3e8},
+                          sentinel.same_env(hist, "hostB"))
+        assert v["rate"].status == "no-history"
+
+    def test_host_env_fingerprint_shape(self):
+        env = sentinel.host_env()
+        assert isinstance(env, str) and "/nproc=" in env
+        assert env == sentinel.host_env()  # deterministic on one host
+
+    def test_gate_main_env_break_restarts_gating(self, tmp_path, capsys):
+        """End-to-end host break: a collapsed round on a SWAPPED host
+        fingerprint admits warn-only (new series), and the same collapse
+        three rounds INTO the new series trips the gate again."""
+        self._write_rounds(tmp_path, [1e8, 1.01e8, 0.99e8, 1.02e8])
+
+        def _env_round(i, v):
+            d = _wrap({"rate": v})
+            d["parsed"]["env"] = "other-cpu/nproc=1"
+            (tmp_path / f"BENCH_r{i:02d}.json").write_text(json.dumps(d))
+
+        _env_round(5, 0.4e8)
+        rc = sentinel.gate_main(["--gate"], bench_dir=str(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 0
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["ok"] and doc["env"] == "other-cpu/nproc=1"
+        assert doc["n_history_rounds"] == 0  # the old host's rounds
+        # rebuild MIN_HISTORY strength on the new host, then collapse
+        for i, v in enumerate((1e8, 1.01e8, 0.99e8), start=5):
+            _env_round(i, v)
+        _env_round(8, 0.4e8)
+        rc = sentinel.gate_main(["--gate"], bench_dir=str(tmp_path))
+        out = capsys.readouterr().out
+        assert rc == 1 and "rate: regressed" in out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["n_history_rounds"] == 3  # the old host still sliced
 
     def _write_rounds(self, tmp_path, values, leg="rate"):
         for i, v in enumerate(values, start=1):

@@ -100,6 +100,7 @@ class TestTuner:
             SearchRange(1e-3, 1e3, log_scale=True),
         ])
 
+    @pytest.mark.tier2
     def test_gp_beats_random_on_bowl(self):
         budget = 18
         space = self._space()
@@ -192,6 +193,7 @@ class TestBatchedTuning:
             for i in range(24) for j in range(i + 1, 24))
         assert v_greedy >= 0.63 * v_best  # (1 − 1/e) up to MC noise
 
+    @pytest.mark.tier2
     def test_qei_batches_match_or_beat_constant_liar_on_bowl(self):
         """Same budget, same seeds: true-q-EI batches end at least as close
         to the bowl optimum as the constant-liar heuristic (the VERDICT
