@@ -1184,6 +1184,73 @@ def run_tuning_e2e(problem) -> dict:
             "round_model_flops": float(res.rounds[0].modeled_flops)}
 
 
+# ---------------------------------------------------------------------------
+# multihost_e2e (round 17): the multi-process data-parallel spine — the
+# SAME mesh-streamed GLM solve launched at 1, 2 and 4 spawned processes
+# over one 8-device global mesh. Coefficients must be BIT-identical
+# across process counts (gloo's reduction tree depends only on the
+# global rank count — docs/MULTIHOST.md), every child must return a
+# result (parallel.launch raises on a lost or hung rank), and the gated
+# number is the priced per-evaluation DCN wire bill: the one psum's
+# (d+1)-float payload, while the per-shard features stay host-local.
+# Sandboxes that block the localhost gRPC coordinator report
+# available=False and the leg's numbers are omitted (an environment
+# fact, not a regression — the same convention as the parallel CLI).
+MH_PROCESS_COUNTS = (1, 2, 4)
+
+
+def run_multihost_e2e() -> dict:
+    import pathlib
+    import tempfile
+
+    from photon_tpu.parallel import selfcheck as sc
+    from photon_tpu.parallel.launch import ClusterUnavailable, launch
+
+    root = tempfile.mkdtemp(prefix="photon_bench_mh_")
+    sc.write_e2e_dataset(pathlib.Path(root))
+    runs: dict = {}
+    try:
+        for n in MH_PROCESS_COUNTS:
+            t0 = time.perf_counter()
+            res = launch(sc.target_stream_solve, n, args=(root,),
+                         timeout_s=420)
+            runs[n] = {"wall_s": time.perf_counter() - t0, "res": res}
+    except ClusterUnavailable as e:
+        return {"available": False,
+                "reason": str(e).splitlines()[0][:200]}
+    digests = set()
+    for n, entry in runs.items():
+        ranks = [r["rank"] for r in entry["res"]]
+        if ranks != list(range(n)):
+            raise AssertionError(
+                f"multihost_e2e: lost ranks at n={n}: {ranks}")
+        digests.update(r["digest"] for r in entry["res"])
+    if len(digests) != 1:
+        raise AssertionError("multihost_e2e: coefficient drift across "
+                             f"process counts: {sorted(digests)}")
+    # price the wire bill straight off the traced psum program — the
+    # same estimator the roofline model uses, not a hand-typed constant
+    from photon_tpu.analysis import trace_contract
+    from photon_tpu.analysis.registry import load_registry
+    from photon_tpu.profiling.model import estimate_jaxpr
+
+    spec = load_registry()["multihost_grad_only_dcn"]
+    traced = trace_contract(spec)
+    cost = estimate_jaxpr(traced.closed_jaxpr)
+    feature_bytes = int(np.asarray(traced.example_args[0].X).nbytes)
+    return {
+        "available": True,
+        "dcn_bytes_per_eval": float(cost.collective_bytes),
+        "feature_bytes_per_shard": feature_bytes // len(jax.devices()),
+        "launch_wall_s": {n: round(runs[n]["wall_s"], 2)
+                          for n in MH_PROCESS_COUNTS},
+        "n_processes_verified": max(MH_PROCESS_COUNTS),
+        "digest": digests.pop(),
+        "iterations": int(runs[max(MH_PROCESS_COUNTS)]["res"][0]
+                          ["iterations"]),
+    }
+
+
 def check_contracts() -> int:
     """Trace-only registry check (no benchmark legs, no compiles): exit 0
     iff every hot-path contract holds. See photon_tpu/analysis."""
@@ -1270,6 +1337,8 @@ def main() -> None:
         tu_problem = tuning_problem()
     with telemetry.span("leg.tuning_e2e"):
         tu_stats = run_tuning_e2e(tu_problem)
+    with telemetry.span("leg.multihost_e2e"):
+        mh_stats = run_multihost_e2e()
     telemetry.finish_run()
     ledger_report = profiling.finish_ledger()
     base = BASELINE_CLUSTER_ROWS_ITERS_PER_SEC
@@ -1426,7 +1495,27 @@ def main() -> None:
             "tuning_e2e_speedup_vs_sequential":
                 round(tu_stats["speedup_vs_sequential"], 2),
             "tuning_e2e_n_configs": tu_stats["n_configs"],
+            # multi-process spine (round 17): the per-evaluation DCN
+            # wire bill, priced off the traced psum program — gates
+            # LOWER-better ("dcn_bytes"); a grown payload means
+            # something besides the gradient started riding DCN.
+            # n_processes is the verified topology, a config fact the
+            # sentinel excludes; the 4-process launch wall (spawn +
+            # cluster init + solve) gates via "_ms". Keys are omitted
+            # entirely when the sandbox blocks the coordinator.
+            **({
+                "multihost_e2e_dcn_bytes_per_eval":
+                    mh_stats["dcn_bytes_per_eval"],
+                "multihost_e2e_launch_4p_wall_ms":
+                    round(mh_stats["launch_wall_s"][4] * 1e3, 1),
+                "multihost_e2e_n_processes":
+                    mh_stats["n_processes_verified"],
+            } if mh_stats.get("available") else {}),
         },
+        # the spine's full report (bit-identity digest, per-count walls,
+        # per-shard feature bytes that never ride DCN) — nested, so
+        # invisible to the sentinel's leg_values
+        "multihost_e2e": mh_stats,
         # the verdict line + full degradation curve ride beside the legs
         # (strings/lists are invisible to the sentinel's leg_values)
         "serving_slo": {"verdict": slo_stats["verdict"],

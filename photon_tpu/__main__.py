@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the eleven per-package selftests as subprocesses (each CLI
+Runs the twelve per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -57,6 +57,14 @@ and one crashed subsystem cannot take the others down):
                    the pow2 GP observation ladder, cost-aware q-EI
                    edges, the pre-dispatch round budget raising on a
                    starved cap, and both tuning contracts
+- ``parallel``   — `--selftest`: the multi-process data-parallel spine —
+                   1/2/4-process launches of the same 8-device mesh
+                   producing BIT-identical psums, a 2-process snapshot
+                   restored bit-identically by a 1-process cluster, and
+                   the barrier-correct commit failing loudly when a rank
+                   dies between payload write and manifest (reports
+                   ``available: false`` + exit 0 in sandboxes that block
+                   the localhost gRPC coordinator)
 
 Exit status: 0 iff every suite passed; the summary line names each
 suite's verdict so a red CI run says WHICH plane drifted.
@@ -81,6 +89,7 @@ SUITES: tuple = (
     ("ingest", ("photon_tpu.ingest", "--selftest", "--json")),
     ("kernels", ("photon_tpu.kernels", "--selftest", "--json")),
     ("tuning", ("photon_tpu.tuning", "--selftest", "--json")),
+    ("parallel", ("photon_tpu.parallel", "--selftest", "--json")),
 )
 
 

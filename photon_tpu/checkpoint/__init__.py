@@ -60,7 +60,9 @@ from photon_tpu.checkpoint.state import (  # noqa: F401
     CheckpointSession,
     SnapshotSchemaError,
     SnapshotStateError,
+    pack_row_slots,
     pack_rows,
+    unpack_row_slots,
     unpack_rows,
 )
 from photon_tpu.checkpoint.store import (  # noqa: F401
@@ -81,6 +83,7 @@ __all__ = [
     "SCHEMA_VERSION", "CheckpointSession", "SnapshotStore",
     "SnapshotSchemaError", "SnapshotStateError", "AsyncSnapshotWriter",
     "commit_bytes", "replace_committed", "pack_rows", "unpack_rows",
+    "pack_row_slots", "unpack_row_slots",
     "FaultPlan", "InjectedFault", "TransientIOError", "arm_faults",
     "disarm_faults", "fault_plan", "current_plan", "kill_point",
     "record_sites", "retry_io",

@@ -53,12 +53,16 @@ from photon_tpu.checkpoint.store import (AsyncSnapshotWriter, SnapshotStore,
                                          SnapshotSchemaError)
 
 __all__ = ["SCHEMA_VERSION", "CheckpointSession", "SnapshotStateError",
-           "SnapshotSchemaError", "pack_rows", "unpack_rows"]
+           "SnapshotSchemaError", "pack_rows", "unpack_rows",
+           "pack_row_slots", "unpack_row_slots"]
 
 # Bump on ANY layout change to the per-scope payloads below. Restore
 # refuses schemas NEWER than this with a clear error (store.load_latest);
 # older schemas are read forward-compatibly or refused per field.
-SCHEMA_VERSION = 1
+# v2 (round 17): row-sharded caches snapshot as per-device-slot entries
+# (`pack_row_slots`) instead of one packed global vector — the
+# multi-process form; v1 single-key payloads still restore.
+SCHEMA_VERSION = 2
 
 
 class SnapshotStateError(ValueError):
@@ -106,6 +110,47 @@ def unpack_rows(z_global: np.ndarray, mesh, pad_rows: int):
     s = int(pad_rows) // n_slots
     stack = buf.reshape(n_slots, s)
     return np.array(stack[local_row_slots(mesh)])
+
+
+def pack_row_slots(local, mesh, n_rows: int, prefix: str) -> dict:
+    """Multi-process snapshot form of a row-sharded per-row cache: one
+    payload entry PER DEVICE SLOT this process owns, keyed
+    ``{prefix}@s{slot:04d}`` — globally unique across processes, so every
+    process's ``meta_p<k>.json`` references only ``p<k>_`` payloads it
+    wrote itself and `store.load_latest`'s cross-process merge unions the
+    full slot set (no entry ever references a file another process may
+    not have committed). Single-device (``mesh=None``): the one slot 0
+    carries the flat rows trimmed to ``n_rows``."""
+    if mesh is None:
+        return {f"{prefix}@s0000":
+                np.array(np.asarray(local)[:n_rows], dtype=np.float32)}
+    from photon_tpu.parallel.mesh import local_row_slots
+
+    local = np.asarray(local)
+    return {f"{prefix}@s{j:04d}": np.array(local[k], dtype=np.float32)
+            for k, j in enumerate(local_row_slots(mesh))}
+
+
+def unpack_row_slots(payload: dict, prefix: str, mesh, pad_rows: int,
+                     n_rows: int):
+    """Inverse of :func:`pack_row_slots` onto ANY topology (process count
+    and mesh shape may both differ from the writing run): slot entries
+    concatenate slot-major into the canonical global row order, trim to
+    ``n_rows`` (the writing layout's pad rows drop), and re-shard through
+    :func:`unpack_rows` for the target layout. Falls back to a v1
+    single-key ``prefix`` entry when present (pre-round-17 snapshots)."""
+    if prefix in payload:  # schema v1: one packed global vector
+        return unpack_rows(np.asarray(payload[prefix])[:n_rows], mesh,
+                           pad_rows)
+    tag = f"{prefix}@s"
+    keys = sorted(k for k in payload if k.startswith(tag))
+    if not keys:
+        raise SnapshotStateError(
+            f"snapshot payload has no {prefix!r} row-slot entries "
+            f"(keys: {sorted(payload)[:8]}...)")
+    z = np.concatenate([np.asarray(payload[k], np.float32).ravel()
+                        for k in keys])
+    return unpack_rows(z[:n_rows], mesh, pad_rows)
 
 
 def _copy_value(v):

@@ -28,12 +28,18 @@ The durability protocol, smallest piece first:
 
 Multi-host: every process writes its payload under a ``p<process>_``
 prefix into the same snapshot directory (shared storage, the HDFS role);
-process 0 alone replaces the manifest, after a best-effort
-``sync_global_devices`` barrier — one barrier-stamped manifest commits
-all processes' shards or none of them. Restore merges every process
-prefix it finds, so a restore onto a different process/mesh layout sees
-the full global state (`state.py` re-shards row-sharded entries via the
-``parallel/mesh.py`` slot helpers).
+process 0 alone replaces the manifest, after a REAL barrier when the
+distributed runtime is up (`_barrier`: the coordination-service
+``wait_at_barrier``, timeout-bounded by ``PHOTON_TPU_BARRIER_TIMEOUT_S``
+so a dead participant fails the commit loudly instead of hanging it;
+single-process runs no-op) — one barrier-stamped manifest commits all
+processes' shards or none of them, and no process can ever observe a
+manifest referencing a ``p<k>_`` payload that was not durably written.
+Restore merges every process prefix it finds, so a restore onto a
+different process/mesh layout sees the full global state (`state.py`
+re-shards row-sharded entries via the ``parallel/mesh.py`` slot
+helpers; row caches land as per-slot ``@s<slot>`` entries so each
+process's meta references only its own files).
 
 Snapshot reads/writes ride :func:`faults.retry_io` (site
 ``snapshot_io``): transient storage hiccups back off and retry instead of
@@ -121,20 +127,46 @@ def _process_index() -> int:
         return 0
 
 
+def _barrier_timeout_s() -> float:
+    from photon_tpu.utils.env import get_raw
+
+    raw = get_raw("PHOTON_TPU_BARRIER_TIMEOUT_S")
+    try:
+        return max(float(raw), 1.0) if raw else 120.0
+    except ValueError:
+        return 120.0
+
+
 def _barrier(tag: str) -> None:
-    """Best-effort multi-process barrier before the manifest commit (a
-    no-op single-process, which is also the fallback when the distributed
-    runtime is not initialized)."""
+    """The pre-manifest commit barrier. Single-process (including "no
+    distributed runtime at all"): a no-op. Multi-process: a REAL barrier
+    — every process's payloads must be durable before process 0 swings
+    the manifest pointer, or a straggler's death would leave a committed
+    manifest referencing payloads that were never written. Prefers the
+    coordination-service barrier (timeout-bounded: a dead participant
+    RAISES here within PHOTON_TPU_BARRIER_TIMEOUT_S and the commit fails
+    loudly, it does not hang), falling back to
+    `multihost_utils.sync_global_devices` on runtimes without the
+    client handle. Failures are NOT swallowed when a multi-process
+    runtime is up: a half-committed snapshot must surface, and the
+    previous manifest stays the restore point."""
     try:
         import jax
 
-        if jax.process_count() <= 1:
-            return
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(tag)
+        n = jax.process_count()
     except Exception:
-        pass
+        return
+    if n <= 1:
+        return
+    from photon_tpu.parallel.mesh import distributed_client
+
+    client = distributed_client()
+    if client is not None:
+        client.wait_at_barrier(tag, int(_barrier_timeout_s() * 1000))
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
 
 
 class SnapshotStore:
@@ -185,8 +217,13 @@ class SnapshotStore:
         snap_dir = os.path.join(self.root, name)
         proc = _process_index()
         if proc == 0 and os.path.isdir(snap_dir):
-            # leftovers of a dead uncommitted attempt at this seq
+            # leftovers of a dead uncommitted attempt at this seq — can
+            # include OTHER ranks' payloads (even from a different process
+            # count), which must not survive into this attempt's merge
             shutil.rmtree(snap_dir, ignore_errors=True)
+        # multi-process: nobody writes until rank 0's leftover sweep is
+        # done, or the sweep could race a peer's fresh payloads
+        _barrier(f"photon_ckpt_begin_{seq}")
         os.makedirs(snap_dir, exist_ok=True)
 
         entries: dict = {}

@@ -752,6 +752,30 @@ def stream_to_host(
     return data, n_real
 
 
+def _local_task_chunks(tasks, config, index_maps, sparse_k, use_native,
+                       local_rows):
+    """The ``local_only`` chunk source: yields ``(chunk, n_rows)`` for
+    tasks whose global row range overlaps any of this process's
+    ``local_rows`` ``[lo, hi)`` intervals (decoded in-process through the
+    exact serial assembly path — bit-identical to the serial chunk at
+    that position) and ``(None, n_rows)`` skip markers for everything
+    else, whose container blocks are never read. Tasks come from
+    `ingest_plane.plan_chunk_tasks`, so chunk boundaries — and therefore
+    every decoded chunk's contents — match the serial stream exactly."""
+    from photon_tpu.data.ingest_plane import _decode_task, _DecodeState
+
+    state = _DecodeState(config, index_maps, sparse_k, use_native)
+    r0 = 0
+    for task in tasks:
+        r1 = r0 + task.n_rows
+        if any(r0 < hi and r1 > lo for lo, hi in local_rows):
+            chunk = _decode_task(state, task)[0]
+            yield chunk, chunk.n
+        else:
+            yield None, task.n_rows
+        r0 = r1
+
+
 def stream_to_device(
     path,
     config: GameDataConfig,
@@ -768,6 +792,7 @@ def stream_to_device(
     workers: int = 0,
     cache_dir=None,
     block_index: Optional[dict] = None,
+    local_only: bool = False,
 ) -> tuple[GameData, int]:
     """Stream a dataset STRAIGHT into its device placement.
 
@@ -802,6 +827,19 @@ def stream_to_device(
     multi-controller collective. Entity-id columns stay host-side and
     GLOBAL on every process (they factorize on host for entity bucketing).
 
+    ``local_only=True`` (round 17, the per-process ingest split) goes one
+    step further: chunk tasks whose row ranges fall ENTIRELY in other
+    processes' device slots are never decoded — their container blocks
+    are never even read (`_BlockSliceReader` random-accesses only the
+    decoded tasks' block entries), so each process's disk + decode work
+    is its own row partition, exactly the RDD-partition role of the
+    reference's executors. Requires a mesh; boundary chunks overlapping a
+    local slot decode in full (their non-local rows still stream past).
+    Caveats: entity-id columns of skipped chunks fill with "" (GAME
+    entity bucketing needs the default global decode), `chunk_hook` runs
+    only on the chunks this process decodes, and ``cache_dir`` is
+    refused (a partial decode must never commit a global cache entry).
+
     `feature_dtype` (e.g. jnp.bfloat16) casts feature VALUES as chunks
     arrive — the storage-dtype path of data.dataset.cast_features without a
     full-size intermediate.
@@ -820,8 +858,24 @@ def stream_to_device(
     from photon_tpu.data.matrix import SparseRows
 
     index_maps = _frozen_maps_or_raise(config, index_maps, sparse_k)
+    local_tasks = None
+    if local_only and mesh is not None:
+        if cache_dir is not None:
+            raise ValueError(
+                "stream_to_device(local_only=True) cannot tee the chunk "
+                "cache: this process decodes only its own block ranges, "
+                "and a partial decode must never commit a global cache "
+                "entry — pre-build the cache with a full decode, or drop "
+                "local_only")
+        from photon_tpu.data.ingest_plane import (plan_chunk_tasks,
+                                                  scan_or_reuse_block_index)
+
+        block_index = scan_or_reuse_block_index(path, block_index)
+        local_tasks = plan_chunk_tasks(block_index, chunk_rows)
     if n_rows is not None:
         n_real = int(n_rows)
+    elif local_tasks is not None:
+        n_real = sum(t.n_rows for t in local_tasks)
     else:
         n_real = sum(scan_row_counts(path, block_index=block_index))
     n_dev = int(mesh.devices.size) if mesh is not None else 1
@@ -926,20 +980,46 @@ def stream_to_device(
     from photon_tpu import telemetry
     from photon_tpu.data.ingest_plane import open_chunk_source
 
-    stream, chunks = open_chunk_source(path, config, index_maps,
-                                       chunk_rows=chunk_rows,
-                                       sparse_k=sparse_k,
-                                       use_native=use_native,
-                                       workers=workers,
-                                       cache_dir=cache_dir,
-                                       block_index=block_index)
-    for chunk in chunks:
+    if local_tasks is not None:
+        local_rows = [(j * n_local, (j + 1) * n_local)
+                      for j in range(n_dev) if local_mask[j]]
+        chunk_iter = _local_task_chunks(local_tasks, config, index_maps,
+                                        sparse_k, use_native, local_rows)
+    else:
+        stream, chunks = open_chunk_source(path, config, index_maps,
+                                           chunk_rows=chunk_rows,
+                                           sparse_k=sparse_k,
+                                           use_native=use_native,
+                                           workers=workers,
+                                           cache_dir=cache_dir,
+                                           block_index=block_index)
+        chunk_iter = ((c, c.n) for c in chunks)
+    for chunk, n_c in chunk_iter:
+        if chunk is None:
+            # a skipped (non-local) chunk: rows advance through slots this
+            # process does not own — buf stays None for all of them, so
+            # the fill loop below degenerates to cursor arithmetic; only
+            # the entity-id columns (host-global by convention) need a
+            # placeholder column.
+            telemetry.count("ingest.chunks_skipped")
+            for e in config.entity_fields:
+                entity_cols[e].append(np.full(n_c, "", dtype="U1"))
+            c0 = 0
+            while c0 < n_c:
+                take = min(n_c - c0, n_local - filled)
+                filled += take
+                c0 += take
+                row += take
+                if filled == n_local and mesh is not None:
+                    ship(buf)
+                    buf = alloc_slot() if row < n_real else None
+                    filled = 0
+            continue
         telemetry.count("ingest.chunks")
         telemetry.count("ingest.rows", chunk.n)
         if chunk_hook is not None:
             chunk_hook(chunk)
         c0 = 0
-        n_c = chunk.n
         for e in config.entity_fields:
             entity_cols[e].append(np.asarray(chunk.entity_ids[e]))
         # Chunks are host numpy end to end (the assemblers build with

@@ -802,12 +802,16 @@ def _restore_history(st: dict, history: int, d: int) -> _History:
 
 def _restore_z_cache(st: dict, data, mesh) -> list:
     """Per-chunk cached margins out of a snapshot, re-laid for the
-    CURRENT backend: canonical global rows -> single-device flat chunks or
-    the mesh's local-slot stacks (a mesh-8 snapshot restores onto mesh-4
-    or one chip; pad rows carry weight 0, so re-padding is exact)."""
+    CURRENT backend: slot-keyed entries (schema v2 — written per process,
+    merged across every `p<k>_` prefix by the store) or the v1 packed
+    global vector, re-sliced to single-device flat chunks or the mesh's
+    local-slot stacks (a mesh-8 snapshot restores onto mesh-4 or one
+    chip, a 2-process snapshot onto 1 or 4 processes; pad rows carry
+    weight 0, so re-padding is exact)."""
     pad = (data.mesh_chunk_rows(mesh) if mesh is not None
            else data.chunk_rows)
-    return [_ckpt.unpack_rows(np.asarray(st[f"z{i}"]), mesh, pad)
+    return [_ckpt.unpack_row_slots(st, f"z{i}", mesh, pad,
+                                   data.chunk_rows)
             for i in range(data.n_chunks)]
 
 
@@ -869,8 +873,10 @@ def minimize_lbfgs_streamed(
 def _pack_lbfgs_state(d, n_chunks, data, mesh, max_iters, it, f, g0norm,
                       hist, ghist, converged, failed, done, w, g, hist_st,
                       z_cache, z_gen) -> dict:
-    extra = {f"z{i}": _ckpt.pack_rows(z_cache[i], mesh, data.chunk_rows)
-             for i in range(n_chunks)}
+    extra: dict = {}
+    for i in range(n_chunks):
+        extra.update(_ckpt.pack_row_slots(z_cache[i], mesh,
+                                          data.chunk_rows, prefix=f"z{i}"))
     extra["z_gen"] = int(z_gen)
     return _pack_stream_state("lbfgs_streamed", d, n_chunks,
                               data.chunk_rows, max_iters, it, f, g0norm,

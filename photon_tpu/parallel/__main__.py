@@ -1,0 +1,123 @@
+"""CLI: the multi-process data-parallel spine smoke check.
+
+    python -m photon_tpu.parallel --selftest           # human, exit 1 on drift
+    python -m photon_tpu.parallel --selftest --json    # machine report
+
+Everything here runs in SPAWNED cluster members (`parallel.launch`) —
+this process never touches a jax backend, exactly like the umbrella
+``python -m photon_tpu --selfcheck`` caller expects. The legs:
+
+1. spine bit-identity: the shard_rows + psum signature program launched
+   at 1, 2 and 4 processes over the SAME 8-device global mesh must
+   produce one digest (gloo's reduction order depends only on the
+   global rank count — docs/MULTIHOST.md);
+2. elastic restore: a 2-process mesh-streamed solve killed mid-run
+   commits per-process ``p<k>_`` payloads with per-slot row-cache
+   entries; a 1-process cluster restores them and finishes BIT-identical
+   to an uninterrupted run;
+3. barrier-correct commits: rank 1 killed between its durable payload
+   write and the commit barrier — the surviving rank's commit must fail
+   loudly within ``PHOTON_TPU_BARRIER_TIMEOUT_S`` (no hang, no manifest
+   referencing a dead rank's unconfirmed snapshot) and the previous
+   manifest must still restore.
+
+Sandboxes that block even localhost gRPC cannot form a jax.distributed
+cluster at all; the selftest then reports ``available: false`` with the
+classified reason and exits 0 — an environment skip, never a silent
+pass (the same convention as tests/test_multihost.py's skips).
+
+Exit 1 on any drift or failure.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+
+
+def selftest() -> dict:
+    from photon_tpu.parallel import selfcheck as sc
+    from photon_tpu.parallel.launch import ClusterUnavailable, launch
+
+    report: dict = {"checks": {}, "available": True}
+    ok = True
+
+    def check(name: str, passed: bool, detail: str = "") -> None:
+        nonlocal ok
+        report["checks"][name] = {"ok": bool(passed),
+                                  **({"detail": detail} if detail else {})}
+        ok = ok and bool(passed)
+
+    try:
+        # ---- 1. psum bit-identity across process counts
+        digests = {}
+        for n in (1, 2, 4):
+            res = launch(sc.target_psum_signature, n, timeout_s=180)
+            digests[n] = sorted({r["digest"] for r in res})
+        one = len({d for ds in digests.values() for d in ds}) == 1
+        check("psum_bit_identity_1_2_4", one, f"digests={digests}")
+
+        # ---- 2. 2-process snapshot -> 1-process bit-identical restore
+        ref = launch(sc.target_resume_solve, 1,
+                     args=(tempfile.mkdtemp(prefix="photon_mh_ref_"),),
+                     timeout_s=300)[0]
+        ck = tempfile.mkdtemp(prefix="photon_mh_snap_")
+        killed = launch(sc.target_snapshot_kill, 2,
+                        args=(ck, "evaluation", 7), timeout_s=300)
+        check("two_proc_kill_commits_snapshots",
+              all(r["killed"] and r["latest_seq"] >= 0 for r in killed),
+              f"{[(r['rank'], r['killed'], r['latest_seq']) for r in killed]}")
+        res = launch(sc.target_resume_solve, 1, args=(ck,), timeout_s=300)
+        check("elastic_restore_bit_identical",
+              all(r["digest"] == ref["digest"] for r in res),
+              f"ref={ref['digest']} got={[r['digest'] for r in res]}")
+
+        # ---- 3. kill between payload write and the commit barrier
+        ck2 = tempfile.mkdtemp(prefix="photon_mh_commitkill_")
+        res = launch(sc.target_commit_kill, 2, args=(ck2, 1, 2),
+                     timeout_s=300,
+                     env={"PHOTON_TPU_BARRIER_TIMEOUT_S": "8"})
+        by_rank = {r["rank"]: r for r in res}
+        check("commit_kill_is_loud",
+              by_rank[1]["outcome"] == "killed"
+              and by_rank[0]["outcome"] == "commit_failed",
+              f"{[(r['rank'], r['outcome']) for r in res]}")
+        from photon_tpu.checkpoint import SnapshotStore
+
+        store = SnapshotStore(ck2)
+        loaded = store.load_latest()
+        check("previous_manifest_still_restores",
+              store.latest_seq() == 0 and loaded is not None,
+              f"latest_seq={store.latest_seq()}")
+    except ClusterUnavailable as e:
+        report["available"] = False
+        report["reason"] = str(e).splitlines()[0][:300]
+        report["ok"] = True
+        return report
+
+    report["ok"] = ok
+    return report
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" not in argv:
+        print(__doc__)
+        return 2
+    report = selftest()
+    if "--json" in argv:
+        print(json.dumps(report))
+    elif not report["available"]:
+        print("parallel selftest: skipped — cluster unavailable "
+              f"({report.get('reason', '')})")
+    else:
+        for name, entry in report["checks"].items():
+            status = "ok" if entry["ok"] else "FAIL"
+            detail = f"  ({entry['detail']})" if entry.get("detail") else ""
+            print(f"  {name}: {status}{detail}")
+        print("parallel selftest:", "ok" if report["ok"] else "FAILED")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,17 +56,69 @@ def make_mesh(data_axis: str = "data", n_devices: int | None = None,
 
 def initialize_distributed(coordinator_address: str | None = None,
                            num_processes: int | None = None,
-                           process_id: int | None = None) -> bool:
+                           process_id: int | None = None,
+                           initialization_timeout: float | None = None
+                           ) -> bool:
     """Bring up the multi-host runtime (jax.distributed) — the analog of the
     reference's Spark driver/executor bootstrap, except the transport is
     XLA's DCN-aware runtime rather than RPC to a driver.
 
-    With no arguments, defers entirely to `jax.distributed.initialize()`'s
-    own cluster auto-detection (Cloud TPU pod metadata, SLURM, the JAX_*
-    env vars) — a plain single-process environment fails that detection and
-    returns False. With explicit arguments they are passed through. Returns
-    True when a multi-process runtime was initialized.
+    With no arguments, reads the ``PHOTON_TPU_COORDINATOR`` /
+    ``PHOTON_TPU_NUM_PROCESSES`` / ``PHOTON_TPU_PROCESS_ID`` knobs (the
+    launcher exports them to its children) and, if those are unset too,
+    defers entirely to `jax.distributed.initialize()`'s own cluster
+    auto-detection (Cloud TPU pod metadata, SLURM, the JAX_* env vars) —
+    a plain single-process environment fails that detection and returns
+    False. With explicit arguments they are passed through. Returns True
+    when the distributed runtime was initialized (including an explicit
+    ``num_processes=1`` cluster-of-one — the bit-identity convention:
+    every process count, 1 included, runs the SAME runtime + collectives
+    stack, see docs/MULTIHOST.md).
+
+    On the CPU backend the cross-process collectives implementation is
+    pinned to gloo BEFORE backend init (the default CPU client refuses
+    multi-process computations outright), which is what makes the
+    1/2/4-process CPU spine both runnable and bit-identical.
+
+    Validation is loud: a ``process_id`` outside ``[0, num_processes)``
+    raises ValueError before any network traffic, and a second initialize
+    in the same process raises RuntimeError with the fix spelled out
+    instead of jax's opaque failure.
     """
+    from photon_tpu.utils.env import get_raw
+
+    if coordinator_address is None:
+        coordinator_address = get_raw("PHOTON_TPU_COORDINATOR")
+    if num_processes is None:
+        raw = get_raw("PHOTON_TPU_NUM_PROCESSES")
+        num_processes = int(raw) if raw is not None else None
+    if process_id is None:
+        raw = get_raw("PHOTON_TPU_PROCESS_ID")
+        process_id = int(raw) if raw is not None else None
+
+    if num_processes is not None and num_processes < 1:
+        raise ValueError(
+            f"num_processes must be >= 1, got {num_processes}")
+    if process_id is not None:
+        if num_processes is None:
+            raise ValueError(
+                "process_id given without num_processes — pass both (or "
+                "set PHOTON_TPU_NUM_PROCESSES next to "
+                "PHOTON_TPU_PROCESS_ID)")
+        if not 0 <= process_id < num_processes:
+            raise ValueError(
+                f"process_id {process_id} out of range for "
+                f"num_processes={num_processes} (ranks are "
+                f"0..{num_processes - 1})")
+    if distributed_client() is not None:
+        raise RuntimeError(
+            "jax.distributed is already initialized in this process — "
+            "initialize_distributed must run exactly once, before any "
+            "backend use. Reuse the existing runtime, or call "
+            "jax.distributed.shutdown() first if you really mean to "
+            "re-form the cluster (tests: run each cluster member in a "
+            "fresh process, e.g. via parallel.launch).")
+
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -74,15 +126,52 @@ def initialize_distributed(coordinator_address: str | None = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = initialization_timeout
     if not kwargs and os.environ.get("JAX_COORDINATOR_ADDRESS") is None \
             and not _cluster_detectable():
         return False
+    _pin_cpu_collectives()
     try:
         jax.distributed.initialize(**kwargs)
         return True
     except (RuntimeError, ValueError):
-        # no detectable cluster / already initialized single-process run
+        # no detectable cluster (auto-detection path only — explicit
+        # arguments re-raise nothing here because jax only raises for
+        # malformed clusters, which the validation above already caught)
+        if kwargs:
+            raise
         return False
+
+
+def distributed_client():
+    """The live jax.distributed client, or None — the one place the
+    private global_state handle is read (double-init refusal above, the
+    checkpoint store's coordination-service barrier)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def _pin_cpu_collectives() -> None:
+    """CPU backend only: select gloo for cross-process collectives BEFORE
+    the backend initializes. jax 0.4's default CPU client refuses
+    multi-process computations ("Multiprocess computations aren't
+    implemented on the CPU backend"); the gloo ring executes them — and,
+    because its reduction order depends only on the GLOBAL rank count,
+    the same 8-device mesh produces bit-identical psums whether it is
+    split 1, 2, or 4 ways (the multihost_e2e acceptance bar). No-op on
+    TPU backends and on jax builds without the option."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms and "cpu" not in platforms:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def _cluster_detectable() -> bool:
@@ -350,3 +439,42 @@ def _contract_hybrid_mesh_value_and_grad():
     n_dev = len(jax.devices())
     mesh = make_hybrid_mesh(n_replicas=2 if n_dev % 2 == 0 else 1)
     return _contract_mesh_vg(mesh, ("replica", "data"))
+
+
+@register_contract(
+    name="multihost_grad_only_dcn",
+    description="the multi-process spine's wire bill (round 17): a sharded "
+                "evaluation over a feature block 100x the model size still "
+                "closes with ONE psum whose payload is the (d,) gradient "
+                "partial + scalar value — features ingest on their owning "
+                "process and NEVER ride a collective "
+                "(tests/test_multihost.py prices the payload through "
+                "profiling.model: O(d) bytes per evaluation, not O(n*d))",
+    collectives={"psum": 1}, tags=("mesh", "multihost", "streamed"))
+def _contract_multihost_grad_only_dcn():
+    import jax.numpy as jnp
+
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.ops.objective import Objective
+
+    mesh = make_mesh()
+    axes = tuple(mesh.axis_names)
+    # d=48 / 128 rows per shard: the per-shard feature bytes dwarf the
+    # (d+1)-float psum payload by >100x, so the byte-pricing test has an
+    # unambiguous margin to pin (not a d ~ n coincidence).
+    d = 48
+    n = 128 * int(mesh.devices.size)
+    obj = Objective(task=TaskType.LOGISTIC_REGRESSION, l2=np.float32(0.5),
+                    axis_name=axes)
+    rows = P(axes)
+
+    def vg(b, w):
+        return shard_map(lambda b, w: obj.value_and_grad(w, b),
+                         mesh=mesh, in_specs=(rows, P()),
+                         out_specs=(P(), P()))(b, w)
+
+    rng = np.random.RandomState(17)
+    batch = make_batch(rng.randn(n, d).astype(np.float32),
+                       (rng.rand(n) < 0.5).astype(np.float32))
+    return vg, (batch, jnp.zeros((d,), jnp.float32))

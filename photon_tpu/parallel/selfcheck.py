@@ -1,0 +1,249 @@
+"""Picklable launch targets for the multi-process spine's proofs.
+
+Every function here takes a :class:`photon_tpu.parallel.launch.LaunchContext`
+and runs INSIDE a spawned cluster member, after `initialize_distributed`
+has formed the jax.distributed runtime (so `jax.devices()` is the global
+8-slot mesh and `jax.process_index()` is the rank). They are module-level
+by construction — spawn children import this module fresh and unpickle
+the function reference; a lambda or closure would not survive the trip.
+
+The targets cover the round-17 acceptance matrix
+(tests/test_multihost.py, ``python -m photon_tpu.parallel --selftest``,
+and the ``multihost_e2e`` bench leg all dispatch through them):
+
+- :func:`target_psum_signature` — the cheap spine probe: shard_rows +
+  one psum, returning a digest that must be BIT-identical at every
+  process count (gloo's reduction order depends only on the global rank
+  count).
+- :func:`target_stream_solve` — the full per-process pipeline: scan →
+  ``stream_to_device(local_only=True)`` (each process decodes ONLY the
+  container blocks overlapping its own device slots) → resident mesh
+  GLM solve; returns the f64 coefficients + the ingest split counters.
+- :func:`target_snapshot_kill` / :func:`target_resume_solve` — the
+  elastic story across process counts: a mesh-streamed solve killed
+  mid-run commits per-slot (``@s<slot>``) row-cache entries under each
+  process's ``p<k>_`` payload prefix; the resume target restores the
+  SAME 8-slot global mesh from any process count's snapshot.
+- :func:`target_commit_kill` — the barrier proof: one rank dies between
+  its durable payload write and the commit barrier; the surviving
+  rank's commit must fail LOUDLY within ``PHOTON_TPU_BARRIER_TIMEOUT_S``
+  and the previous manifest must stay the restore point.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = [
+    "target_psum_signature", "target_stream_solve",
+    "target_snapshot_kill", "target_resume_solve", "target_commit_kill",
+    "chunked_problem", "solve_chunked", "write_e2e_dataset",
+]
+
+_TOL0_CFG = dict(max_iters=10, tolerance=0.0, reg_weight=1e-2, history=4)
+
+
+def _mesh():
+    import jax
+
+    from photon_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=np.asarray(jax.devices()))
+
+
+def chunked_problem(chunk_rows: int = 24):
+    """A deterministic chunked logistic problem (192 rows x 6 features,
+    seeded) — every process rebuilds the identical chunks from the seed,
+    so the mesh-streamed solve is the same program at any process count."""
+    from photon_tpu.data.dataset import chunk_batch, make_batch
+
+    rng = np.random.default_rng(17)
+    n, d = 192, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-(X @ w_true)))
+         ).astype(np.float32)
+    return chunk_batch(make_batch(X, y), chunk_rows)
+
+
+def solve_chunked(mesh):
+    """The tolerance-0 mesh-streamed solve every elastic target shares
+    (full iteration budget — kills always cut a RUNNING solve)."""
+    from photon_tpu.models.training import train_glm
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim import regularization as reg
+    from photon_tpu.optim.config import OptimizerConfig
+
+    cfg = OptimizerConfig(reg=reg.l2(), **_TOL0_CFG)
+    _, res = train_glm(chunked_problem(), TaskType.LOGISTIC_REGRESSION,
+                       cfg, mesh=mesh)
+    return np.asarray(res.w, np.float64)
+
+
+def write_e2e_dataset(root, n_files: int = 3, rows_per_file: int = 400):
+    """Write the deterministic multi-file Avro dataset the e2e solve
+    target streams (parent-side helper — targets only READ it)."""
+    from photon_tpu.data.avro_io import write_avro
+    from photon_tpu.data.ingest import training_example_schema
+
+    rng = np.random.default_rng(23)
+    schema = training_example_schema(feature_bags=("f",),
+                                     entity_fields=("member",))
+    for fi in range(int(n_files)):
+        records = []
+        for i in range(int(rows_per_file)):
+            records.append({
+                "response": float(rng.integers(0, 2)),
+                "offset": float(rng.normal()) if i % 3 == 0 else None,
+                "weight": 2.0 if i % 5 == 0 else None,
+                "uid": f"r{fi}_{i}",
+                "member": f"m{int(rng.integers(0, 37))}",
+                "f": [{"name": "age", "term": "",
+                       "value": float(rng.normal())},
+                      {"name": "ctr", "term": "",
+                       "value": float(rng.normal())}],
+            })
+        write_avro(root / f"part-{fi:03d}.avro", records, schema,
+                   block_records=130)
+    return root
+
+
+def _e2e_config():
+    from photon_tpu.data.feature_bags import FeatureShardConfig
+    from photon_tpu.data.ingest import GameDataConfig
+
+    return GameDataConfig(
+        shards={"dense": FeatureShardConfig(bags=("f",),
+                                            has_intercept=True)},
+        entity_fields=("member",),
+    )
+
+
+# ------------------------------------------------------------------ targets
+def target_psum_signature(ctx) -> dict:
+    """shard_rows over the global mesh + ONE psum: the minimal program
+    whose digest proves the 1/2/4-process spines run the same mesh and
+    the same reduction, bit for bit."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from photon_tpu.parallel.mesh import shard_map, shard_rows
+
+    mesh = _mesh()
+    n = 64 * int(mesh.devices.size)
+    host = (np.arange(n, dtype=np.float64) % 97 / 7.0).astype(np.float32)
+    arr = shard_rows(host, mesh)
+    total = shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x * x), tuple(mesh.axis_names)),
+        mesh=mesh, in_specs=(P(tuple(mesh.axis_names)),),
+        out_specs=P())(arr)
+    digest = hashlib.sha256(np.asarray(total, np.float32).tobytes())
+    return {"rank": ctx.process_id, "digest": digest.hexdigest()[:16],
+            "n_devices": int(mesh.devices.size)}
+
+
+def target_stream_solve(ctx) -> dict:
+    """args=(dataset_root,): the whole per-process pipeline — one scan
+    pass, ``local_only=True`` ingest (this process's container blocks
+    only), resident mesh GLM solve closed by the hierarchical psum."""
+    from photon_tpu import telemetry
+    from photon_tpu.data.dataset import make_batch
+    from photon_tpu.data.streaming import scan_ingest, stream_to_device
+    from photon_tpu.models.training import train_glm
+    from photon_tpu.ops.losses import TaskType
+    from photon_tpu.optim import regularization as reg
+    from photon_tpu.optim.config import OptimizerConfig
+
+    (root,) = ctx.args
+    config = _e2e_config()
+    scan = scan_ingest(str(root), config)
+    mesh = _mesh()
+    telemetry.start_run(name=f"multihost_rank{ctx.process_id}")
+    data, n_real = stream_to_device(
+        str(root), config, scan.index_maps, mesh=mesh, chunk_rows=300,
+        block_index=scan.block_index, local_only=True)
+    batch = make_batch(data.shards["dense"], data.y, weights=data.weights,
+                       offsets=data.offsets)
+    model, res = train_glm(
+        batch, TaskType.LOGISTIC_REGRESSION,
+        OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0),
+        mesh=mesh)
+    report = telemetry.finish_run() or {}
+    counters = report.get("counters", {})
+    w = np.asarray(model.coefficients.means, np.float64)
+    return {"rank": ctx.process_id, "w": w, "n_real": int(n_real),
+            "digest": hashlib.sha256(w.tobytes()).hexdigest()[:16],
+            "chunks_decoded": int(counters.get("ingest.chunks", 0)),
+            "chunks_skipped": int(counters.get("ingest.chunks_skipped", 0)),
+            "iterations": int(res.iterations)}
+
+
+def target_snapshot_kill(ctx) -> dict:
+    """args=(ckpt_dir, site, occurrence): run the shared mesh-streamed
+    solve under a checkpoint session, killed by an injected fault at
+    (site, occurrence) on EVERY rank (the host loops are lock-step, so
+    the cut is symmetric); the committed snapshots carry this rank's
+    ``p<k>_`` payloads with per-slot row-cache entries."""
+    from photon_tpu import checkpoint
+
+    ckdir, site, occurrence = ctx.args
+    mesh = _mesh()
+    killed = False
+    try:
+        with checkpoint.session(str(ckdir), every_evals=1, every_s=None,
+                                async_writer=False):
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan.kill_at(site, int(occurrence))):
+                solve_chunked(mesh)
+    except checkpoint.InjectedFault:
+        killed = True
+    return {"rank": ctx.process_id, "killed": killed,
+            "latest_seq": checkpoint.SnapshotStore(str(ckdir)).latest_seq()}
+
+
+def target_resume_solve(ctx) -> dict:
+    """args=(ckpt_dir,): restore the last committed snapshot (merging
+    every ``p<k>_`` prefix it holds — possibly written by a DIFFERENT
+    process count) onto this cluster's 8-slot mesh and finish."""
+    from photon_tpu import checkpoint
+
+    (ckdir,) = ctx.args
+    mesh = _mesh()
+    with checkpoint.session(str(ckdir), every_evals=1, every_s=None,
+                            async_writer=False):
+        w = solve_chunked(mesh)
+    return {"rank": ctx.process_id, "w": w,
+            "digest": hashlib.sha256(w.tobytes()).hexdigest()[:16]}
+
+
+def target_commit_kill(ctx) -> dict:
+    """args=(ckpt_dir, kill_rank, occurrence): rank ``kill_rank`` dies at
+    its Nth ``snapshot_write`` kill point — AFTER its payloads + meta are
+    durable, BEFORE the commit barrier. Surviving ranks must see the
+    commit fail loudly (barrier timeout/dead participant) instead of
+    hanging or committing a manifest that references a dead rank's
+    never-confirmed snapshot."""
+    from photon_tpu import checkpoint
+
+    ckdir, kill_rank, occurrence = ctx.args
+    mesh = _mesh()
+    out: dict = {"rank": ctx.process_id}
+    try:
+        with checkpoint.session(str(ckdir), every_evals=1, every_s=None,
+                                async_writer=False):
+            if ctx.process_id == int(kill_rank):
+                with checkpoint.fault_plan(checkpoint.FaultPlan.kill_at(
+                        "snapshot_write", int(occurrence))):
+                    solve_chunked(mesh)
+            else:
+                solve_chunked(mesh)
+        out["outcome"] = "completed"
+    except checkpoint.InjectedFault:
+        out["outcome"] = "killed"
+    except Exception as e:  # noqa: BLE001 — the barrier failure IS the result
+        out["outcome"] = "commit_failed"
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+    out["latest_seq"] = checkpoint.SnapshotStore(str(ckdir)).latest_seq()
+    return out

@@ -76,9 +76,11 @@ SCHEMA_VERSION = 2
 # the tier got slower, a real regression (the shed-vs-queue TRADE is
 # by design; its cost moving is not). "maxdiff": the quantized rungs'
 # measured probe-margin delta — a louder quantization is a quality
-# regression even when QPS holds.
+# regression even when QPS holds. "dcn_bytes": the multi-process
+# spine's priced per-eval wire bill (round 17) — a grown psum payload
+# means something besides the gradient started riding DCN.
 _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
-                         "stall", "shed", "maxdiff")
+                         "stall", "shed", "maxdiff", "dcn_bytes")
 
 # Config-ish / count legs that are not performance quantities: a changed
 # topology, cadence, or layout split must not read as a "regression".
@@ -88,7 +90,7 @@ _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
 # the serving SLO bar is a chosen config, not a measurement.)
 _EXCLUDE_PATTERNS = ("_n_chips", "n_requests", "snapshots", "cadence",
                      "_vs_baseline", "_frac", "_width_buckets",
-                     "slo_target", "_n_configs")
+                     "slo_target", "_n_configs", "_n_processes")
 
 
 def lower_is_better(leg: str) -> bool:

@@ -27,7 +27,8 @@ margin_cache.refreshes cache pair; retrace.new_signatures riding
 sweeps/coordinate_updates/grid_points; the training driver's
 train.dataset_estimate_bytes/train.hbm_budget_bytes gauges; the chunked
 scoring driver's score.chunks/score.rows; the ingest scan's
-ingest.chunks/ingest.rows/ingest.device_shards;
+ingest.chunks/ingest.rows/ingest.device_shards plus the multi-process
+spine's ingest.chunks_skipped (blocks another rank decodes instead);
 the random-effect block pipeline's `game_re.*` family —
 blocks/blocks_in_flight/readback_wait_ns plus the straggler compaction's
 straggler_entities/tail_resolves/iters_saved and the fused-update gate's
@@ -278,6 +279,7 @@ TELEMETRY_REGISTRY = {
         "continual.refresh_solves", "continual.refresh_iterations",
         "continual.probe_entities", "continual.swap_refusals",
         "ingest.chunks", "ingest.rows", "ingest.device_shards",
+        "ingest.chunks_skipped",
         "ingest.worker_chunks", "ingest.worker_deaths",
         "ingest.cache_hits", "ingest.cache_misses", "ingest.cache_builds",
         "ingest.cache_commits", "ingest.cache_chunks",

@@ -45,6 +45,24 @@ KNOB_DOCS = {
         "Tier-1 suite's persistent XLA compilation cache directory "
         "(empty string disables; default /tmp/photon_tpu_xla_test_cache)."
         " Owner: tests/conftest.py."),
+    "PHOTON_TPU_COORDINATOR": (
+        "Multi-process coordinator address (host:port) for "
+        "jax.distributed — the launcher exports it to every child; set "
+        "it by hand to join an externally-launched cluster. Owner: "
+        "photon_tpu.parallel.mesh (initialize_distributed())."),
+    "PHOTON_TPU_NUM_PROCESSES": (
+        "Multi-process cluster size for jax.distributed (integer >= 1; "
+        "read with PHOTON_TPU_COORDINATOR/PHOTON_TPU_PROCESS_ID). Owner: "
+        "photon_tpu.parallel.mesh (initialize_distributed())."),
+    "PHOTON_TPU_PROCESS_ID": (
+        "This process's rank in the multi-process cluster (integer in "
+        "[0, PHOTON_TPU_NUM_PROCESSES)). Owner: photon_tpu.parallel.mesh "
+        "(initialize_distributed())."),
+    "PHOTON_TPU_BARRIER_TIMEOUT_S": (
+        "Multi-process barrier timeout in seconds (default 120): how "
+        "long the checkpoint store's pre-manifest barrier waits for "
+        "every process before failing the commit loudly. Owner: "
+        "photon_tpu.checkpoint.store (_barrier())."),
 }
 
 

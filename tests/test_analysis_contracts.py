@@ -24,15 +24,15 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 46 specs (round 16 added the lane-tuner pins: the fixed-chunk
-    tuning dispatch invariance and the pre-dispatch round budget)
-    spanning every workload family."""
-    assert len(_REGISTRY) >= 46
+    """≥ 47 specs (round 17 added the multi-host wire-bill pin:
+    `multihost_grad_only_dcn` — gradient-only DCN traffic) spanning
+    every workload family."""
+    assert len(_REGISTRY) >= 47
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
                    "evaluation", "continual", "ingest", "kernels",
-                   "tuning"):
+                   "tuning", "multihost"):
         assert family in tags, f"no contract covers the {family} family"
 
 

@@ -801,4 +801,4 @@ def save(path):
         from photon_tpu.__main__ import SUITES
 
         names = [n for n, _ in SUITES]
-        assert "lint" in names and len(names) == 11  # round 16: + tuning
+        assert "lint" in names and len(names) == 12  # round 17: + parallel

@@ -114,6 +114,31 @@ class TestSentinel:
                                whist)["sparse10m_tail_pad_waste"]
         assert better.status == "ok"
 
+    def test_multihost_legs_admit_correctly(self):
+        """The round-17 spine legs as the sentinel sees them: the priced
+        DCN wire bill gates LOWER-better (a grown psum payload means
+        something besides the gradient started riding DCN), the launch
+        wall gates lower-better via "_ms", and the verified process
+        count is a topology fact the sentinel must never gate."""
+        assert sentinel.lower_is_better("multihost_e2e_dcn_bytes_per_eval")
+        assert sentinel.lower_is_better("multihost_e2e_launch_4p_wall_ms")
+        legs = sentinel.leg_values({"legs": {
+            "multihost_e2e_dcn_bytes_per_eval": 196.0,
+            "multihost_e2e_launch_4p_wall_ms": 9000.0,
+            "multihost_e2e_n_processes": 4,
+        }})
+        assert "multihost_e2e_n_processes" not in legs
+        assert legs["multihost_e2e_dcn_bytes_per_eval"] == 196.0
+        hist = _history(leg="multihost_e2e_dcn_bytes_per_eval", base=196.0)
+        worse = sentinel.gate(
+            {"multihost_e2e_dcn_bytes_per_eval": 24576.0},
+            hist)["multihost_e2e_dcn_bytes_per_eval"]
+        assert worse.status == "regressed"
+        same = sentinel.gate(
+            {"multihost_e2e_dcn_bytes_per_eval": 196.0},
+            hist)["multihost_e2e_dcn_bytes_per_eval"]
+        assert same.status == "ok"
+
     def test_layout_split_legs_are_excluded(self):
         """hot/tail split + width-bucket counts are layout CONFIG facts —
         a retuned d_dense moves them by design, so they never gate."""
