@@ -61,10 +61,13 @@ if "--check-contracts" in sys.argv:
 
 # --check-lint: the source-level convention auditor (photon_tpu/lint) —
 # durable-write discipline, fault-site/telemetry/env-knob registries,
-# lock/spawn/exception hygiene, contract + sentinel coverage. Jax-free
-# AST rules over the repo source: milliseconds, runs before the
-# heavyweight imports below, exit 1 on any finding (CI pins
-# `python bench.py --check-lint` beside --check-contracts).
+# lock/spawn/exception hygiene, contract + sentinel coverage, plus the
+# whole-program concurrency rules (thread inventory, lock-order graph,
+# blocking-under-lock, guarded-by race detection). Jax-free AST rules
+# over the repo source: milliseconds, runs before the heavyweight
+# imports below, exit 1 on any finding (CI pins
+# `python bench.py --check-lint` beside --check-contracts; pass
+# --threads to dump the thread model itself).
 if "--check-lint" in sys.argv:
     from photon_tpu.lint.__main__ import main as _lint_main
 

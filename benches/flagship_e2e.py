@@ -217,7 +217,7 @@ def main() -> None:
         total = time.perf_counter() - t0
         telemetry.finish_run()
         ledger_report = profiling.finish_ledger()
-        # lint: rawwrite(bench-run report artifact — nothing resumes from it; a torn file just re-runs the bench)
+        # photon: allow(durable_write, bench-run report artifact — nothing resumes from it; a torn file just re-runs the bench)
         with open(ledger_json, "w") as fh:
             json.dump(ledger_report, fh)
         phases = {k: round(v, 1) for k, v in sorted(out.timings.items())}

@@ -4,7 +4,7 @@
     python -m photon_tpu --selfcheck --json     # machine report
     python -m photon_tpu --selfcheck --only telemetry profiling
 
-Runs the twelve per-package selftests as subprocesses (each CLI
+Runs the thirteen per-package selftests as subprocesses (each CLI
 self-provisions its 8-device CPU platform, so results match CI exactly
 and one crashed subsystem cannot take the others down):
 
@@ -15,6 +15,10 @@ and one crashed subsystem cannot take the others down):
                    telemetry/env-knob registries, lock + spawn +
                    exception hygiene, contract/sentinel coverage —
                    jax-free, milliseconds)
+- ``threads``    — `python -m photon_tpu.lint --threads --json` (the
+                   whole-program concurrency auditor: thread inventory,
+                   lock-order graph acyclic, blocking-under-lock, and
+                   the pinned guarded-by bindings — jax-free)
 - ``telemetry``  — `--selftest`: sinks, spans, iteration stream, the
                    telemetry-off-is-free contract
 - ``serving``    — `--selftest`: store + dispatcher offline parity,
@@ -80,6 +84,7 @@ import time
 SUITES: tuple = (
     ("analysis", ("photon_tpu.analysis", "--json")),
     ("lint", ("photon_tpu.lint", "--json")),
+    ("threads", ("photon_tpu.lint", "--threads", "--json")),
     ("telemetry", ("photon_tpu.telemetry", "--selftest", "--json")),
     ("serving", ("photon_tpu.serving", "--selftest", "--json")),
     ("checkpoint", ("photon_tpu.checkpoint", "--selftest", "--json")),

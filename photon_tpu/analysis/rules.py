@@ -32,6 +32,7 @@ array).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable
 
 import jax
@@ -266,21 +267,29 @@ class TraceSignatureLog:
     """
 
     def __init__(self):
+        # record() runs on every instrumented call site, including the
+        # serving dispatch thread — the signature buckets are shared
+        # state and take a lock (signature hashing stays outside it)
+        self._lock = threading.Lock()
         self._seen: dict[str, list] = {}
 
     def record(self, name: str, args) -> tuple:
         sig = trace_signature(args)
-        bucket = self._seen.setdefault(name, [])
-        if sig not in bucket:
-            bucket.append(sig)
+        with self._lock:
+            bucket = self._seen.setdefault(name, [])
+            if sig not in bucket:
+                bucket.append(sig)
         return sig
 
     def signatures(self, name: str) -> list:
-        return list(self._seen.get(name, []))
+        with self._lock:
+            return list(self._seen.get(name, []))
 
     def hazards(self) -> list[tuple]:
         out = []
-        for name, sigs in self._seen.items():
+        with self._lock:
+            snapshot = {k: list(v) for k, v in self._seen.items()}
+        for name, sigs in snapshot.items():
             for i, a in enumerate(sigs):
                 for b in sigs[i + 1:]:
                     if weak_type_drift(a, b):

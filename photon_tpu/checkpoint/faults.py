@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Callable, Optional
 
@@ -162,11 +163,16 @@ class FaultPlan:
         return cls.kill_at(site, occ)
 
     def hit(self, site: str) -> int:
-        n = self.hits.get(site, 0) + 1
-        self.hits[site] = n
+        # fault sites fire from every thread in the stack (writer,
+        # dispatch, fleet workers); the occurrence counters must not
+        # lose increments or two kill-at-occurrence-N plans drift
+        with _HIT_LOCK:
+            n = self.hits.get(site, 0) + 1
+            self.hits[site] = n
         return n
 
 
+_HIT_LOCK = threading.Lock()
 _PLAN: Optional[FaultPlan] = None
 
 

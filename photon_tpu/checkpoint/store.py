@@ -362,6 +362,11 @@ class AsyncSnapshotWriter:
     def __init__(self, store: SnapshotStore):
         self.store = store
         self._q: queue.Queue = queue.Queue()
+        # _err crosses the writer-thread/caller boundary: the writer
+        # stores, callers read-and-clear. Without the lock a commit
+        # failure landing between _check's read and its None-store is
+        # silently lost (the lint's guarded_by rule pins this binding).
+        self._err_lock = threading.Lock()
         self._err: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="photon-ckpt-writer")
@@ -376,13 +381,15 @@ class AsyncSnapshotWriter:
             try:
                 self.store.commit(state, seq, meta)
             except BaseException as e:  # noqa: BLE001 — surfaced at submit
-                self._err = e
+                with self._err_lock:
+                    self._err = e
             finally:
                 self._q.task_done()
 
     def _check(self) -> None:
-        if self._err is not None:
+        with self._err_lock:
             err, self._err = self._err, None
+        if err is not None:
             raise err
 
     def submit(self, state: dict, seq: int,

@@ -362,7 +362,7 @@ def iter_game_chunks_parallel(
                         result = fut.result()
                     except (KeyboardInterrupt, SystemExit):
                         raise
-                    # lint: swallow(InjectedFault IS the simulated worker death — the chunk degrades to bit-identical in-process decode)
+                    # photon: allow(exception_hygiene, InjectedFault IS the simulated worker death — the chunk degrades to bit-identical in-process decode)
                     except BaseException as e:  # noqa: BLE001
                         telemetry.count("ingest.worker_deaths")
                         if not logged_death:

@@ -189,7 +189,7 @@ def run_selftest() -> dict:
     bag = cc.open_cache(cache, key, "game_chunks")
     f0 = os.path.join(bag.dir, bag.manifest["entries"][0]["file"])
     raw = open(f0, "rb").read()
-    # lint: rawwrite(deliberate corruption of a scratch cache payload — the CRC-detection selftest)
+    # photon: allow(durable_write, deliberate corruption of a scratch cache payload — the CRC-detection selftest)
     open(f0, "wb").write(raw[:-4] + b"\x00\x01\x02\x03")
     corrupt_detected = False
     try:

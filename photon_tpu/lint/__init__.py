@@ -22,11 +22,20 @@ literals, so ``python -m photon_tpu.lint`` costs milliseconds and runs
 before anything heavyweight imports — the same guard economics as
 ``bench.py --gate``.
 
-Suppression syntax (docs/ANALYSIS.md "Source-level lint"): a finding is
-suppressed by a trailing comment on its line (or the line above) of the
-form ``lint: <tag>(<reason>)`` after a ``#`` — the reason string is
-MANDATORY; an empty or missing reason is itself a finding. Tags are
-per-rule (see `rules.RULES`).
+Waiver syntax (docs/ANALYSIS.md "Source-level lint"): a finding is
+waived by a trailing comment on its line (or the line above) — the
+reason string is MANDATORY in every form; an empty or missing reason is
+itself a finding:
+
+- ``photon: allow(<rule>, <reason>)`` — keyed by RULE NAME, works for
+  every rule (the shared form new code should use);
+- ``photon: <tag>(<reason>)`` — keyed by the rule's suppression tag
+  (e.g. ``photon: unguarded(...)`` for ``guarded_by``);
+- ``lint: <tag>(<reason>)`` — the legacy tag form, still honored.
+
+``photon:``-form waivers are STALE-CHECKED: on a full run (no ``--only``
+filter), a waiver on a line where its rule no longer fires is itself a
+finding — waivers can't outlive the hazard they excuse.
 
 The shipped ``baseline.json`` is EMPTY and stays empty: every true
 violation gets fixed, not baselined — the file exists so a future
@@ -47,11 +56,17 @@ __all__ = [
     "repo_root", "load_baseline",
 ]
 
-# a trailing "lint: tag(reason)" comment; the hash is matched separately
-# so this regex never reads as a live suppression itself
+# trailing waiver comments; the marker strings are split so these
+# regexes (and this comment) never read as live waivers themselves
 _SUPPRESS_RE = re.compile(
     r"#\s*lint" r":\s*([a-z_]+)\s*\(\s*(.*?)\s*\)\s*$")
 _SUPPRESS_BARE_RE = re.compile(r"#\s*lint" r":\s*([a-z_]+)\s*$")
+_PHOTON_ALLOW_RE = re.compile(
+    r"#\s*photon" r":\s*allow\s*\(\s*([a-z_]+)\s*"
+    r"(?:,\s*(.*?))?\s*\)\s*$")
+_PHOTON_TAG_RE = re.compile(
+    r"#\s*photon" r":\s*([a-z_]+)\s*\(\s*(.*?)\s*\)\s*$")
+_PHOTON_BARE_RE = re.compile(r"#\s*photon" r":\s*([a-z_]+)\s*$")
 
 
 @dataclasses.dataclass
@@ -87,17 +102,40 @@ class SourceFile:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text)
-        # lineno -> (tag, reason); bad entries (empty reason) kept apart
+        # lineno -> (kind, name, reason) where kind is "rule" (photon
+        # allow form, name = rule name), "tag" (photon tag form), or
+        # "legacy" (lint: tag form, exempt from stale checking); bad
+        # entries (empty/missing reason) kept apart
         self.suppressions: dict = {}
         self.bad_suppressions: list = []
         for i, ln in enumerate(self.lines, start=1):
             if "#" not in ln:
                 continue
+            m = _PHOTON_ALLOW_RE.search(ln)
+            if m:
+                name, reason = m.group(1), m.group(2)
+                if reason:
+                    self.suppressions[i] = ("rule", name, reason)
+                else:
+                    self.bad_suppressions.append((i, name))
+                continue
+            m = _PHOTON_TAG_RE.search(ln)
+            if m:
+                tag, reason = m.group(1), m.group(2)
+                if reason:
+                    self.suppressions[i] = ("tag", tag, reason)
+                else:
+                    self.bad_suppressions.append((i, tag))
+                continue
+            m = _PHOTON_BARE_RE.search(ln)
+            if m:
+                self.bad_suppressions.append((i, m.group(1)))
+                continue
             m = _SUPPRESS_RE.search(ln)
             if m:
                 tag, reason = m.group(1), m.group(2)
                 if reason:
-                    self.suppressions[i] = (tag, reason)
+                    self.suppressions[i] = ("legacy", tag, reason)
                 else:
                     self.bad_suppressions.append((i, tag))
                 continue
@@ -105,14 +143,29 @@ class SourceFile:
             if m:
                 self.bad_suppressions.append((i, m.group(1)))
 
-    def suppressed(self, line: int, tag: str) -> bool:
-        """A finding at ``line`` is suppressed by a reasoned comment with
-        the rule's tag on the same line or the line directly above."""
+    def match_waiver(self, line: int, tag: str,
+                     rule: Optional[str] = None) -> Optional[int]:
+        """The lineno of the waiver covering a finding at ``line`` (same
+        line or the line directly above), or None. Tag forms match the
+        rule's suppression tag; the ``allow`` form matches the rule
+        name."""
         for at in (line, line - 1):
             got = self.suppressions.get(at)
-            if got and got[0] == tag:
-                return True
-        return False
+            if not got:
+                continue
+            kind, name, _reason = got
+            if kind == "rule":
+                if rule is not None and name == rule:
+                    return at
+            elif name == tag:
+                return at
+        return None
+
+    def suppressed(self, line: int, tag: str,
+                   rule: Optional[str] = None) -> bool:
+        """A finding at ``line`` is waived by a reasoned comment on the
+        same line or the line directly above."""
+        return self.match_waiver(line, tag, rule) is not None
 
     # ------------------------------------------------------ AST helpers
     def literal(self, name: str):
@@ -280,14 +333,18 @@ def run_lint(root: Optional[str] = None, only: Optional[list] = None,
     for rel, msg in ctx.parse_errors:
         findings.append(Finding("parse", rel, 1, msg, key="parse"))
     n_rules = 0
+    used: dict = {}  # rel -> set of waiver linenos that covered a finding
     for name, (fn, tag, _doc) in _rules.RULES.items():
         if only and name not in only:
             continue
         n_rules += 1
         for f in fn(ctx):
             src = ctx.get(f.path)
-            if src is not None and src.suppressed(f.line, tag):
+            at = (src.match_waiver(f.line, tag, rule=name)
+                  if src is not None else None)
+            if at is not None:
                 suppressed.append(f)
+                used.setdefault(f.path, set()).add(at)
             else:
                 findings.append(f)
     if not only or "suppression" in only:
@@ -299,6 +356,22 @@ def run_lint(root: Optional[str] = None, only: Optional[list] = None,
                     f"suppression comment for tag {tag!r} has no reason "
                     "string — a reason is mandatory",
                     key=f"{tag}@{line}"))
+        if not only:
+            # stale-waiver check: photon-form waivers on lines where the
+            # named rule no longer fires are themselves findings. Only
+            # meaningful on a full run — with a rule filter most waivers
+            # would look stale.
+            for rel, src in sorted(ctx.files.items()):
+                for at, (kind, name, _r) in sorted(
+                        src.suppressions.items()):
+                    if kind == "legacy" or at in used.get(rel, set()):
+                        continue
+                    findings.append(Finding(
+                        "suppression", rel, at,
+                        f"stale waiver: `photon:` comment for {name!r} "
+                        "on a line where that rule no longer fires — "
+                        "remove the waiver",
+                        key=f"stale:{name}@{at}"))
     findings = [f for f in findings if f.fingerprint not in baseline]
     if changed:
         ch = _changed_files(ctx.root)

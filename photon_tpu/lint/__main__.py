@@ -5,16 +5,57 @@
     python -m photon_tpu.lint --list      # rule names + suppression tags
     python -m photon_tpu.lint --only durable_write --only telemetry_sync
     python -m photon_tpu.lint --changed   # findings in changed files only
+    python -m photon_tpu.lint --threads   # thread inventory + lock-order
+                                          # graph + guarded-by bindings
+    python -m photon_tpu.lint --threads --json   # machine thread model
+    python -m photon_tpu.lint --threads --dot    # lock graph as graphviz
 
 Jax-free and import-side-effect-free: the rules read every registry they
-pin as an AST literal, so the whole audit costs milliseconds (bench.py's
-``--check-lint`` guard and the 10th umbrella ``--selfcheck`` suite run
-exactly this).
+pin as an AST literal, so the whole audit costs seconds (bench.py's
+``--check-lint`` guard and the ``lint`` umbrella ``--selfcheck`` suite
+run exactly this; the ``threads`` suite runs ``--threads --json``).
+``--threads`` dumps the whole-program thread model — thread inventory,
+lock-order graph, guarded-by bindings (docs/ANALYSIS.md "Concurrency
+model") — then runs the four concurrency rules and exits 1 on findings.
 """
 from __future__ import annotations
 
 import json
 import sys
+
+
+_CONCURRENCY_RULES = ("lock_order", "blocking_under_lock", "guarded_by",
+                      "concurrency_model")
+
+
+def threads_main(root, argv) -> int:
+    """Dump the whole-program thread model (``--threads``): the thread
+    inventory, lock-order graph, and guarded-by bindings — then run the
+    four concurrency rules and exit 1 on any finding."""
+    from photon_tpu.lint import load_context, run_lint
+    from photon_tpu.lint.thread_model import build_thread_model
+
+    ctx = load_context(root)
+    model = build_thread_model(ctx)
+    report = run_lint(root=root, only=list(_CONCURRENCY_RULES))
+    findings = report["findings"]
+    if "--dot" in argv:
+        print(model.render_dot())
+    elif "--json" in argv:
+        print(json.dumps({
+            "ok": report["ok"],
+            "model": model.to_doc(),
+            "n_findings": len(findings),
+            "findings": [f.to_json() for f in findings],
+        }))
+    else:
+        print(model.render())
+        for f in findings:
+            print(f.text)
+        print(f"concurrency: {len(findings)} finding(s), "
+              f"{len(report['suppressed'])} suppressed"
+              + ("" if findings else " — thread model holds"))
+    return 0 if report["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -34,6 +75,8 @@ def main(argv=None) -> int:
             only.append(next(it))
         elif a == "--root":
             root = next(it)
+    if "--threads" in argv:
+        return threads_main(root, argv)
     unknown = sorted(set(only) - set(RULES) - {"suppression"})
     if unknown:
         print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)

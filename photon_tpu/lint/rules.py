@@ -1,10 +1,12 @@
-"""The nine source-level convention rules (see package docstring).
+"""The thirteen source-level convention rules (see package docstring).
 
 Every rule is ``fn(ctx) -> list[Finding]`` registered in :data:`RULES`
 as ``name -> (fn, suppression_tag, one_line_doc)``. Rules read the
 registries they pin as AST literals — no photon_tpu (or jax) imports —
 so the auditor's verdict cannot depend on import-time side effects of
-the code it audits.
+the code it audits. The four whole-program concurrency rules (thread
+inventory, lock-order graph, guarded-by, pinned model) live in
+:mod:`photon_tpu.lint.concurrency` and register here.
 """
 from __future__ import annotations
 
@@ -14,6 +16,7 @@ import re
 from typing import Iterable, Optional
 
 from photon_tpu.lint import Context, Finding
+from photon_tpu.lint import concurrency as _conc
 
 # --------------------------------------------------------------- helpers
 
@@ -770,4 +773,16 @@ RULES = {
     "exception_hygiene": (exception_hygiene, "swallow",
                           "broad except clauses that swallow "
                           "InjectedFault"),
+    "lock_order": (_conc.lock_order, "lockorder",
+                   "cycles in the cross-call lock acquisition graph "
+                   "(potential deadlock)"),
+    "blocking_under_lock": (_conc.blocking_under_lock, "blocking",
+                            "unbounded blocking ops (IO, device_get, "
+                            "untimed queue/wait) while holding a lock"),
+    "guarded_by": (_conc.guarded_by, "unguarded",
+                   "state written from >=2 thread roles without a "
+                   "common lock"),
+    "concurrency_model": (_conc.concurrency_model, "expectation",
+                          "pinned thread inventory + guarded-by "
+                          "bindings hold at HEAD"),
 }

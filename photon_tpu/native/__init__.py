@@ -96,6 +96,7 @@ def get_lib():
         try:
             fresh = (_LIB_PATH.exists()
                      and _LIB_PATH.stat().st_mtime >= _SRC.stat().st_mtime)
+            # photon: allow(blocking_under_lock, the first-use compile MUST serialize under _lock — two threads racing g++ onto the same .so is the actual bug; hold time is bounded by the compile timeout and later callers hit the memoized fast path)
             if not fresh and not _compile():
                 return None
             lib = ctypes.CDLL(str(_LIB_PATH))

@@ -165,7 +165,7 @@ class CoefficientStore:
             np.save(buf, np.asarray(arr, np.float32), allow_pickle=False)
             final = os.path.join(out_dir, fname)
             tmp = f"{final}.tmp.{os.getpid()}"
-            # lint: rawwrite(staged two-phase payload — fsync'd here, published by replace_committed after all writes)
+            # photon: allow(durable_write, staged two-phase payload — fsync'd here, published by replace_committed after all writes)
             with open(tmp, "wb") as f:
                 f.write(buf.getvalue())
                 f.flush()

@@ -149,13 +149,16 @@ def start_run(name: str = "run", jsonl_path: Optional[str] = None,
     at a time: starting while one is attached finishes the old one first
     (runs are process-scoped, like the reference's one Spark UI per app)."""
     global _CURRENT
+    # construct (and close the displaced run) OUTSIDE the attach lock:
+    # Run() opens the JSONL sink and close() flushes it — file IO a
+    # concurrent counter bump must never wait behind (blocking_under_lock)
+    r = Run(name=name, jsonl_path=jsonl_path, resident_tap=resident_tap,
+            logger=logger, append=append)
     with _ATTACH_LOCK:
-        if _CURRENT is not None:
-            _CURRENT.close()
-        r = Run(name=name, jsonl_path=jsonl_path, resident_tap=resident_tap,
-                logger=logger, append=append)
-        _CURRENT = r
+        old, _CURRENT = _CURRENT, r
         set_resident_tap(resident_tap)
+    if old is not None:
+        old.close()
     return r
 
 

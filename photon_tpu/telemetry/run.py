@@ -136,6 +136,10 @@ class Run:
         self._t0_ns = time.perf_counter_ns()
         self._end_ns: Optional[int] = None
         self._lock = threading.Lock()
+        # the JSONL sink gets its OWN lock: serializing file writes under
+        # _lock would stall every counter bump from the serving threads
+        # behind disk latency (the lint's blocking_under_lock rule)
+        self._emit_lock = threading.Lock()
         self._tls = threading.local()
         self.spans: list[Span] = []
         self.counters: dict[str, float] = {}
@@ -175,12 +179,13 @@ class Run:
         return stack
 
     def _emit(self, obj: dict) -> None:
-        f = self._jsonl_file
-        if f is None:
+        if self._jsonl_file is None:
             return
-        with self._lock:
-            if self._jsonl_file is None:  # closed concurrently
+        with self._emit_lock:
+            f = self._jsonl_file
+            if f is None:  # closed concurrently
                 return
+            # photon: allow(blocking_under_lock, _emit_lock exists to serialize exactly this one-line write — it guards no other state, so nothing can deadlock or stall behind it)
             json.dump(obj, f)
             f.write("\n")
 
@@ -361,7 +366,7 @@ class Run:
             log = photon_logger("photon_tpu.telemetry")
         for line in self.summary_lines():
             log.info("%s", line)
-        with self._lock:
+        with self._emit_lock:
             if self._jsonl_file is not None:
                 self._jsonl_file.close()
                 self._jsonl_file = None

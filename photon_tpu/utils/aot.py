@@ -150,6 +150,7 @@ def _register_serializations() -> None:
         reg(cls)
     for cls in (GLMBatch, OptResult):
         reg_nt(cls)
+    # photon: unguarded(idempotent fast-path memo — a duplicate concurrent registration is absorbed by the except-ValueError pass above; worst case is one redundant pass through reg())
     _registered = True
 
 
@@ -258,6 +259,7 @@ class AotStore:
         if cached is None and os.path.exists(path):
             with open(path, "rb") as f:
                 cached = load_program(f.read())
+            # photon: unguarded(idempotent memo of an immutable loaded program — concurrent loaders store equivalent values and the GIL keeps the dict slot whole; locking here would hold a lock across deserialization)
             self._loaded[path] = cached
         if cached is not None:
             try:
@@ -275,6 +277,7 @@ class AotStore:
                 msg = str(e)
                 if not ("was exported for" in msg and "platform" in msg):
                     raise
+                # photon: unguarded(eviction of a wrong-platform entry is idempotent — a racing evictor just finds the slot already empty)
                 self._loaded.pop(path, None)
         data = export_program(fn, *args, platforms=self.platforms)
         # temp + fsync + rename (checkpoint.store.commit_bytes): atomic
@@ -285,5 +288,6 @@ class AotStore:
 
         commit_bytes(path, data)
         run = load_program(data)
+        # photon: unguarded(idempotent memo — concurrent exporters produce the same program and commit_bytes keeps the file atomic; last store wins with an equivalent value)
         self._loaded[path] = run
         return run(*args)

@@ -289,3 +289,31 @@ class TestTraceSignatures:
         assert weak_type_drift(a, b)
         assert not weak_type_drift(a, a)
         assert not weak_type_drift(b, c)  # dtype change: a real retrace
+
+    def test_concurrent_record_is_safe(self):
+        # Round-18 regression: _seen is mutated from serving threads while
+        # hazards() iterates — must not lose entries or raise RuntimeError.
+        import threading
+
+        log = TraceSignatureLog()
+        args = [(jnp.ones(4), 0.5), (jnp.ones(4), np.float32(0.5))]
+        errs: list = []
+
+        def pound(i: int) -> None:
+            try:
+                for k in range(200):
+                    log.record(f"fn{(i + k) % 4}", args[k % 2])
+                    log.hazards()
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        ts = [threading.Thread(target=pound, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errs == []
+        hazards = log.hazards()
+        assert sorted(h[0] for h in hazards) == ["fn0", "fn1", "fn2", "fn3"]
+        for name in ("fn0", "fn1", "fn2", "fn3"):
+            assert len(log.signatures(name)) == 2

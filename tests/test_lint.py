@@ -801,4 +801,5 @@ def save(path):
         from photon_tpu.__main__ import SUITES
 
         names = [n for n, _ in SUITES]
-        assert "lint" in names and len(names) == 12  # round 17: + parallel
+        # round 18: + the whole-program concurrency auditor (threads)
+        assert "lint" in names and "threads" in names and len(names) == 13

@@ -1,0 +1,679 @@
+"""The whole-program concurrency auditor (round 18).
+
+Three layers, mirroring the auditor itself:
+
+1. Violating fixtures for every rule — deadlock cycle, unguarded
+   two-role write, inconsistent guards, blocking-under-lock (direct,
+   untimed queue, transitive), broken pinned expectations — each proven
+   to FIRE, plus the waiver forms (``photon: unguarded``,
+   ``photon: allow``) proven to suppress with a reason and to be
+   flagged when reasonless or stale.
+2. The clean-repo law: ``run_lint`` over this repo at HEAD with an
+   empty baseline returns ZERO findings, and the ``--threads`` CLI
+   round-trips the model as JSON/dot.
+3. Deterministic interleaving tests wiring the static findings to
+   dynamic evidence: the pre-fix ``AsyncSnapshotWriter._err``
+   read-then-clear protocol demonstrably LOSES an error under a forced
+   preemption schedule; the shipped (locked) writer survives the same
+   schedule, plus seeded yielding-lock fuzz and regression tests for
+   the other races fixed in this round (telemetry emit-lock split,
+   FaultPlan hit counters).
+
+Everything here is jax-free and fast — the tier-1 budget is tight.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from photon_tpu.lint import load_context, repo_root, run_lint
+from photon_tpu.lint.rules import RULES
+from photon_tpu.lint.thread_model import build_thread_model
+
+from test_lint import write_repo  # the registry-complete clean fixture
+
+REPO = repo_root()
+
+
+def run_rules(root, only=None):
+    return run_lint(root=str(root), only=only, baseline=set())
+
+
+def findings_of(report, rule):
+    return [f for f in report["findings"] if f.rule == rule]
+
+
+def write(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+CONC = ["lock_order", "blocking_under_lock", "guarded_by",
+        "concurrency_model"]
+
+
+# ------------------------------------------------------------ lock_order
+
+DEADLOCK = """\
+    import threading
+
+    _l1 = threading.Lock()
+    _l2 = threading.Lock()
+
+    def forward():
+        with _l1:
+            take_second()
+
+    def take_second():
+        with _l2:
+            return 1
+
+    def backward():
+        with _l2:
+            with _l1:
+                return 2
+"""
+
+
+class TestLockOrder:
+    def test_cross_call_cycle_fires(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/dead.py": DEADLOCK})
+        report = run_rules(root, only=["lock_order"])
+        f, = findings_of(report, "lock_order")
+        assert "deadlock" in f.message
+        assert "_l1" in f.key and "_l2" in f.key
+
+    def test_consistent_nesting_is_clean(self, tmp_path):
+        clean = DEADLOCK.replace("with _l2:\n            with _l1:",
+                                 "with _l1:\n            with _l2:")
+        assert clean != DEADLOCK
+        root = write(tmp_path, {"photon_tpu/dead.py": clean})
+        report = run_rules(root, only=["lock_order"])
+        assert findings_of(report, "lock_order") == []
+
+
+# ------------------------------------------------------------ guarded_by
+
+UNGUARDED = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.state = 0
+            self._t = threading.Thread(target=self._loop,
+                                       name="fixture-loop")
+            self._t.start()
+
+        def _loop(self):
+            self.state = 1
+
+        def poke(self):
+            self.state = 2
+"""
+
+INCONSISTENT = """\
+    import threading
+
+    class Incons:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.val = 0
+            self._t = threading.Thread(target=self._loop,
+                                       name="incons-loop")
+
+        def _loop(self):
+            with self._a:
+                self.val = 1
+
+        def set_val(self):
+            with self._b:
+                self.val = 2
+"""
+
+
+class TestGuardedBy:
+    def test_unguarded_two_role_write_fires(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/w.py": UNGUARDED})
+        report = run_rules(root, only=["guarded_by"])
+        found = findings_of(report, "guarded_by")
+        assert len(found) == 2  # one per write site
+        msg = found[0].message
+        assert "fixture-loop" in msg and "NO lock" in msg
+
+    def test_inconsistent_guards_fire(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/i.py": INCONSISTENT})
+        report = run_rules(root, only=["guarded_by"])
+        found = findings_of(report, "guarded_by")
+        assert found and all("DIFFERENT locks" in f.message
+                             for f in found)
+
+    def test_common_lock_is_clean(self, tmp_path):
+        fixed = INCONSISTENT.replace("with self._b:", "with self._a:")
+        root = write(tmp_path, {"photon_tpu/i.py": fixed})
+        report = run_rules(root, only=["guarded_by"])
+        assert findings_of(report, "guarded_by") == []
+
+    def test_lock_inherited_through_call_is_clean(self, tmp_path):
+        # the write site itself is lockless, but EVERY call path in
+        # holds the lock — the meet-over-paths analysis must see it
+        src = UNGUARDED.replace(
+            "    def poke(self):\n        self.state = 2",
+            "    def poke(self):\n"
+            "        with self._g:\n"
+            "            self._store()\n\n"
+            "    def _loop(self2):\n"
+            "        pass\n\n"
+            "    def _store(self):\n"
+            "        self.state = 2",
+        ).replace("self.state = 0",
+                  "self.state = 0\n        self._g = threading.Lock()")
+        root = write(tmp_path, {"photon_tpu/w.py": src})
+        report = run_rules(root, only=["guarded_by"])
+        # _loop writes unlocked -> still fires there, but the _store
+        # site inherits the lock and must NOT fire
+        assert all("_store" not in f.key
+                   for f in findings_of(report, "guarded_by"))
+
+    def test_process_entries_are_not_shared_memory_roles(self, tmp_path):
+        # spawn-context Process targets live in another address space:
+        # a global written by the child entry and a public function must
+        # NOT count as a two-role shared write
+        src = """\
+            import multiprocessing
+
+            _COUNT = 0
+
+            def _child_main():
+                global _COUNT
+                _COUNT = 1
+
+            def bump():
+                global _COUNT
+                _COUNT = 2
+
+            def launch():
+                mp = multiprocessing.get_context("spawn")
+                p = mp.Process(target=_child_main)
+                p.start()
+        """
+        root = write(tmp_path, {"photon_tpu/p.py": src})
+        report = run_rules(root, only=["guarded_by"])
+        assert findings_of(report, "guarded_by") == []
+
+
+# ---------------------------------------------------- blocking_under_lock
+
+class TestBlockingUnderLock:
+    def _root(self, tmp_path, body):
+        src = ("import queue\nimport threading\nimport time\n\n"
+               "_lk = threading.Lock()\n\n" + textwrap.dedent(body))
+        return write(tmp_path, {"photon_tpu/b.py": src})
+
+    def test_direct_sleep_under_lock_fires(self, tmp_path):
+        root = self._root(tmp_path, """\
+            def hold():
+                with _lk:
+                    time.sleep(0.5)
+        """)
+        report = run_rules(root, only=["blocking_under_lock"])
+        f, = findings_of(report, "blocking_under_lock")
+        assert "time.sleep" in f.message and "_lk" in f.message
+
+    def test_untimed_queue_get_under_lock_fires(self, tmp_path):
+        root = self._root(tmp_path, """\
+            def hold():
+                q = queue.Queue()
+                with _lk:
+                    return q.get()
+        """)
+        report = run_rules(root, only=["blocking_under_lock"])
+        f, = findings_of(report, "blocking_under_lock")
+        assert "queue.Queue.get" in f.message
+
+    def test_timed_queue_get_is_exempt(self, tmp_path):
+        root = self._root(tmp_path, """\
+            def hold():
+                q = queue.Queue()
+                with _lk:
+                    return q.get(timeout=1.0)
+        """)
+        report = run_rules(root, only=["blocking_under_lock"])
+        assert findings_of(report, "blocking_under_lock") == []
+
+    def test_transitive_file_io_under_lock_fires(self, tmp_path):
+        root = self._root(tmp_path, """\
+            def _flush(path):
+                with open(path, "w") as f:
+                    f.write("x")
+
+            def hold(path):
+                with _lk:
+                    _flush(path)
+        """)
+        report = run_rules(root, only=["blocking_under_lock"])
+        f, = findings_of(report, "blocking_under_lock")
+        assert "transitively" in f.message and "_flush" in f.message
+
+    def test_io_outside_lock_is_clean(self, tmp_path):
+        root = self._root(tmp_path, """\
+            def hold(path):
+                with _lk:
+                    x = 1
+                with open(path, "w") as f:
+                    f.write(str(x))
+        """)
+        report = run_rules(root, only=["blocking_under_lock"])
+        assert findings_of(report, "blocking_under_lock") == []
+
+
+# ------------------------------------------------------ concurrency_model
+
+class TestConcurrencyModel:
+    def test_missing_pinned_thread_fires(self, tmp_path):
+        # a serving/dispatcher.py EXISTS but its pinned threads are gone
+        root = write(tmp_path, {"photon_tpu/serving/dispatcher.py":
+                                "class MicroBatchDispatcher:\n"
+                                "    pass\n"})
+        report = run_rules(root, only=["concurrency_model"])
+        keys = {f.key for f in findings_of(report, "concurrency_model")}
+        assert "thread:serving-dispatch" in keys
+        assert "thread:serving-retire" in keys
+
+    def test_absent_file_skips_expectation(self, tmp_path):
+        # fixture repos without the production modules stay clean
+        root = write(tmp_path, {"photon_tpu/other.py": "X = 1\n"})
+        report = run_rules(root, only=["concurrency_model"])
+        assert findings_of(report, "concurrency_model") == []
+
+    def test_broken_guard_binding_fires(self, tmp_path):
+        src = """\
+            import threading
+
+            class CoefficientStore:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+                    self._device = None
+
+                def reload(self):
+                    self._device = None
+        """
+        root = write(tmp_path, {"photon_tpu/serving/store.py": src})
+        report = run_rules(root, only=["concurrency_model"])
+        f, = [f for f in findings_of(report, "concurrency_model")
+              if "CoefficientStore._device" in f.key]
+        assert "_swap_lock" in f.message
+
+    def test_guard_binding_holds_when_locked(self, tmp_path):
+        src = """\
+            import threading
+
+            class CoefficientStore:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+                    self._device = None
+
+                def reload(self):
+                    with self._swap_lock:
+                        self._device = None
+        """
+        root = write(tmp_path, {"photon_tpu/serving/store.py": src})
+        report = run_rules(root, only=["concurrency_model"])
+        assert not [f for f in findings_of(report, "concurrency_model")
+                    if "CoefficientStore._device" in f.key]
+
+
+# ------------------------------------------------------------- waivers
+
+class TestWaivers:
+    def test_photon_unguarded_tag_waiver_honored(self, tmp_path):
+        src = UNGUARDED.replace(
+            "            self.state = 1",
+            "            # photon: unguarded(fixture says so)\n"
+            "            self.state = 1",
+        ).replace(
+            "        self.state = 2",
+            "        self.state = 2  # photon: unguarded(fixture says so)",
+        )
+        root = write(tmp_path, {"photon_tpu/w.py": src})
+        report = run_rules(root, only=["guarded_by"])
+        assert findings_of(report, "guarded_by") == []
+        assert len(report["suppressed"]) == 2
+
+    def test_photon_allow_rule_waiver_honored(self, tmp_path):
+        src = UNGUARDED.replace(
+            "            self.state = 1",
+            "            # photon: allow(guarded_by, fixture says so)\n"
+            "            self.state = 1",
+        ).replace(
+            "        self.state = 2",
+            "        self.state = 2  # photon: allow(guarded_by, ok here)",
+        )
+        root = write(tmp_path, {"photon_tpu/w.py": src})
+        report = run_rules(root, only=["guarded_by"])
+        assert findings_of(report, "guarded_by") == []
+        assert len(report["suppressed"]) == 2
+
+    def test_allow_for_wrong_rule_does_not_suppress(self, tmp_path):
+        src = UNGUARDED.replace(
+            "        self.state = 2",
+            "        self.state = 2  # photon: allow(lock_order, wrong)",
+        )
+        root = write(tmp_path, {"photon_tpu/w.py": src})
+        report = run_rules(root, only=["guarded_by"])
+        assert len(findings_of(report, "guarded_by")) == 2
+
+    def test_reasonless_allow_rejected(self, tmp_path):
+        src = UNGUARDED.replace(
+            "        self.state = 2",
+            "        self.state = 2  # photon: allow(guarded_by)",
+        )
+        root = write(tmp_path, {"photon_tpu/w.py": src})
+        report = run_rules(root, only=["guarded_by", "suppression"])
+        # the finding is NOT suppressed and the bad waiver is flagged
+        assert len(findings_of(report, "guarded_by")) == 2
+        sup, = findings_of(report, "suppression")
+        assert "no reason" in sup.message
+
+    def test_stale_waiver_flagged_on_full_run(self, tmp_path):
+        root = write_repo(tmp_path, extra={
+            "photon_tpu/stale.py":
+                "X = 1\n"
+                "# photon: allow(guarded_by, nothing fires here anymore)\n"
+                "Y = 2\n"})
+        report = run_rules(root)  # FULL run: stale check active
+        stale = [f for f in findings_of(report, "suppression")
+                 if f.key.startswith("stale:")]
+        assert len(stale) == 1 and stale[0].path == "photon_tpu/stale.py"
+        assert "guarded_by" in stale[0].message
+
+    def test_stale_check_skipped_under_only_filter(self, tmp_path):
+        root = write_repo(tmp_path, extra={
+            "photon_tpu/stale.py":
+                "X = 1\n"
+                "# photon: allow(guarded_by, nothing fires here anymore)\n"
+                "Y = 2\n"})
+        report = run_rules(root, only=["guarded_by", "suppression"])
+        assert not [f for f in findings_of(report, "suppression")
+                    if f.key.startswith("stale:")]
+
+    def test_legacy_lint_waivers_are_not_stale_checked(self, tmp_path):
+        root = write_repo(tmp_path, extra={
+            "photon_tpu/old.py":
+                "X = 1\n"
+                "# lint" ": rawwrite(legacy form, not stale-checked)\n"
+                "Y = 2\n"})
+        report = run_rules(root)
+        assert not [f for f in findings_of(report, "suppression")
+                    if f.key.startswith("stale:")]
+
+
+# ------------------------------------------------------ the thread model
+
+class TestThreadModel:
+    def test_inventory_and_reach(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/w.py": UNGUARDED})
+        model = build_thread_model(load_context(root))
+        entry, = [e for e in model.entries if e.kind == "thread"]
+        assert entry.label == "fixture-loop" and entry.shares_memory
+        assert entry.targets == ("photon_tpu/w.py::Worker._loop",)
+        doc = model.to_doc()
+        assert doc["threads"][0]["label"] == "fixture-loop"
+        assert "Worker.state" in model.render()
+
+    def test_model_is_memoized_on_context(self, tmp_path):
+        ctx = load_context(write(tmp_path, {"photon_tpu/w.py": UNGUARDED}))
+        assert build_thread_model(ctx) is build_thread_model(ctx)
+
+
+# ------------------------------------------------- the clean-repo law
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return run_lint(root=REPO, baseline=set())
+
+
+class TestRepoIsClean:
+    def test_zero_findings_with_empty_baseline(self, repo_report):
+        assert [f.text for f in repo_report["findings"]] == []
+        assert repo_report["ok"]
+        assert repo_report["n_rules"] == len(RULES) + 1
+
+    def test_concurrency_rules_registered(self):
+        for name in CONC:
+            assert name in RULES
+
+    def test_repo_thread_inventory_pinned(self):
+        from photon_tpu.lint.concurrency import EXPECTED_THREADS
+
+        model = build_thread_model(load_context(REPO))
+        have = {(e.rel, e.label) for e in model.entries}
+        for rel, label in EXPECTED_THREADS:
+            assert (rel, label) in have, (rel, label)
+        assert not model.cycles
+
+    def test_threads_cli_json_subprocess(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/w.py": UNGUARDED,
+                                "photon_tpu/dead.py": DEADLOCK})
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_tpu.lint", "--root", root,
+             "--threads", "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert not doc["ok"] and doc["n_findings"] >= 3
+        labels = {t["label"] for t in doc["model"]["threads"]}
+        assert "fixture-loop" in labels
+        assert doc["model"]["lock_cycles"]
+
+    def test_threads_cli_dot_subprocess(self, tmp_path):
+        root = write(tmp_path, {"photon_tpu/dead.py": DEADLOCK})
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_tpu.lint", "--root", root,
+             "--threads", "--dot"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 1
+        assert proc.stdout.startswith("digraph lock_order")
+        assert "->" in proc.stdout
+
+
+# ------------------------------------- interleaving: static -> dynamic
+
+class _FailingStore:
+    """Commit always raises a numbered error — the dying-disk stand-in."""
+
+    def __init__(self):
+        self.n = 0
+
+    def commit(self, state, seq, meta=None):
+        self.n += 1
+        raise RuntimeError(f"boom{self.n}")
+
+
+class _YieldingLock:
+    """A real lock whose acquire() first yields the GIL a seeded number
+    of times — widening any unlocked window at the auditor-identified
+    acquisition sites without changing semantics."""
+
+    def __init__(self, seed: int):
+        self._lock = threading.Lock()
+        self._state = seed or 1  # xorshift; no randomness APIs needed
+
+    def _yields(self) -> int:
+        s = self._state
+        s ^= (s << 13) & 0xFFFFFFFF
+        s ^= s >> 17
+        s ^= (s << 5) & 0xFFFFFFFF
+        self._state = s
+        return s % 4
+
+    def acquire(self, *a, **k):
+        for _ in range(self._yields()):
+            time.sleep(0)
+        return self._lock.acquire(*a, **k)
+
+    def release(self):
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def _shutdown(writer) -> None:
+    writer._q.put(None)
+    writer._thread.join(timeout=10)
+    assert not writer._thread.is_alive()
+
+
+class TestErrLatchInterleaving:
+    """The race guarded_by flagged at HEAD: AsyncSnapshotWriter._err was
+    read-then-cleared by callers with no lock, so a writer-thread store
+    landing between the read and the clear was erased UNRAISED."""
+
+    def test_prefix_protocol_drops_the_last_error(self):
+        # the pre-fix `err, self._err = self._err, None` as its two
+        # bytecode steps (LOAD_ATTR ... STORE_ATTR), with the writer's
+        # store forced into the window between them
+        box = {"err": RuntimeError("boom1")}
+        in_window = threading.Event()
+        stored = threading.Event()
+        raised = []
+
+        def check():
+            err = box["err"]          # the read
+            in_window.set()
+            assert stored.wait(5)     # the preemption the lint flagged
+            box["err"] = None         # the clear — erases boom2
+            if err is not None:
+                raised.append(str(err))
+
+        def writer():
+            assert in_window.wait(5)
+            box["err"] = RuntimeError("boom2")
+            stored.set()
+
+        tc = threading.Thread(target=check)
+        tw = threading.Thread(target=writer)
+        tc.start(); tw.start(); tc.join(5); tw.join(5)
+        # boom2 was stored by the writer, never raised, and is now gone:
+        assert raised == ["boom1"] and box["err"] is None
+
+    def test_fixed_writer_survives_the_same_schedule(self):
+        from photon_tpu.checkpoint.store import AsyncSnapshotWriter
+
+        store = _FailingStore()
+        w = AsyncSnapshotWriter(store)
+        try:
+            raised = []
+            w.submit({"x": 1}, seq=1)   # commit -> boom1 stored
+            w._q.join()
+            w._err_lock = _YieldingLock(7)  # the preemption harness
+            # same shape as the red test: a check racing a second store
+            def check():
+                try:
+                    w._check()
+                except RuntimeError as e:
+                    raised.append(str(e))
+            tc = threading.Thread(target=check)
+            tc.start()
+            try:
+                w.submit({"x": 2}, seq=2)  # may itself raise boom1
+            except RuntimeError as e:
+                raised.append(str(e))
+            tc.join(5)
+            w._q.join()
+            try:
+                w._check()
+            except RuntimeError as e:
+                raised.append(str(e))
+            # the LAST error always surfaces — nothing is silently lost
+            assert f"boom{store.n}" in raised
+        finally:
+            _shutdown(w)
+
+    @pytest.mark.parametrize("seed", [3, 11, 29])
+    def test_fixed_writer_never_silences_the_final_error(self, seed):
+        from photon_tpu.checkpoint.store import AsyncSnapshotWriter
+
+        store = _FailingStore()
+        w = AsyncSnapshotWriter(store)
+        w._err_lock = _YieldingLock(seed)
+        try:
+            raised = []
+            for i in range(25):
+                try:
+                    w.submit({"x": i}, seq=i)
+                except RuntimeError as e:
+                    raised.append(str(e))
+                if i % 3 == 0:
+                    time.sleep(0)
+            w._q.join()
+            try:
+                w._check()
+            except RuntimeError as e:
+                raised.append(str(e))
+            # after quiescence + a final check the latest injected error
+            # must have been raised (the pre-fix tear violates this)
+            if store.n:
+                assert f"boom{store.n}" in raised
+        finally:
+            _shutdown(w)
+
+
+class TestRound18RaceFixRegressions:
+    """One regression test per concurrency fix this round."""
+
+    def test_counter_bump_never_waits_on_the_jsonl_sink(self, tmp_path):
+        # Run._emit got its own lock: a counter bump must complete even
+        # while the JSONL sink lock is held (pre-fix: same lock)
+        from photon_tpu.telemetry.run import Run
+
+        r = Run(name="t", jsonl_path=str(tmp_path / "t.jsonl"))
+        done = threading.Event()
+        with r._emit_lock:
+            t = threading.Thread(
+                target=lambda: (r.count("k"), done.set()))
+            t.start()
+            assert done.wait(5), "count() blocked behind the emit lock"
+        t.join(5)
+        r.close()
+
+    def test_emit_completes_while_stats_lock_held(self, tmp_path):
+        from photon_tpu.telemetry.run import Run
+
+        r = Run(name="t", jsonl_path=str(tmp_path / "t.jsonl"))
+        done = threading.Event()
+        with r._lock:
+            t = threading.Thread(
+                target=lambda: (r._emit({"type": "x"}), done.set()))
+            t.start()
+            assert done.wait(5), "_emit blocked behind the stats lock"
+        t.join(5)
+        r.close()
+
+    def test_faultplan_hits_exact_under_contention(self):
+        from photon_tpu.checkpoint.faults import FaultPlan
+
+        plan = FaultPlan()
+        n_threads, per = 8, 400
+        threads = [threading.Thread(
+            target=lambda: [plan.hit("site") for _ in range(per)])
+            for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert plan.hits["site"] == n_threads * per
