@@ -595,12 +595,17 @@ def run_refresh_e2e(problem, resident: dict) -> dict:
 
     drop = _refresh_drop(problem, touched, seed=6)
     with tempfile.TemporaryDirectory(prefix="photon_refresh_bench_") as root:
+        # the staleness clock starts when the delta's rows changed — here,
+        # the moment the drop exists; hot_swap gauges rows-changed →
+        # servable seconds (continual.staleness_s) at cutover
+        rows_changed_unix = time.time()
         t0 = time.perf_counter()
         plan = continual.diff_manifest(manifest, drop, prev)
         res = continual.refresh_game_model(prev, drop, plan, {"re": cfg_r})
         new_store = CoefficientStore.from_game_model(res.model)
-        continual.hot_swap(live, new_store, root=root,
-                           probe=continual.ParityProbe(bound=1e3))
+        swap = continual.hot_swap(live, new_store, root=root,
+                                  probe=continual.ParityProbe(bound=1e3),
+                                  rows_changed_unix=rows_changed_unix)
         wall = time.perf_counter() - t0
     # the acceptance bar's no-retrace half, asserted live: the measured
     # (steady-state) refresh compiled nothing
@@ -610,6 +615,7 @@ def run_refresh_e2e(problem, resident: dict) -> dict:
         "speedup_vs_full_retrain": full_wall / wall,
         "touched_frac": n_touch / GE_ENTITIES,
         "n_touched": int(plan.n_touched),
+        "staleness_s": swap["staleness_s"],
     }
 
 
@@ -713,8 +719,14 @@ def run_serving(ladder, pool) -> dict:
         stats["wall_s"] = wall
         return stats
 
+    from photon_tpu.telemetry import trace
+
     drive(pool[:SV_WARM_REQUESTS])
-    stats = drive(pool[SV_WARM_REQUESTS:])
+    # the timed drive runs with request tracing ARMED: the retrace
+    # assertion below then proves arming tracing adds zero new rung
+    # signatures (the live half of serving_trace_off_is_free)
+    with trace.tracing(k=8):
+        stats = drive(pool[SV_WARM_REQUESTS:])
     # the acceptance bar: steady-state serving provably never retraces
     # (at most one compiled program per ladder rung, zero weak-type drift)
     ladder.assert_no_retrace()
@@ -854,15 +866,22 @@ def _calibrate_capacity(ladder, reqs) -> float:
 def run_serving_slo(ladder, pool, capacity_qps: float | None = None) -> dict:
     """The open-loop QPS sweep: SLO verdict + degradation curve (see the
     leg comment above)."""
+    from photon_tpu.telemetry import trace
+
     if capacity_qps is None:
         capacity_qps = _calibrate_capacity(ladder, pool[:512])
     curve = []
-    for f in SLO_RATE_FACTORS:
-        rate = capacity_qps * f
-        n = int(min(max(rate * SLO_SECONDS_PER_RATE, SLO_MIN_REQUESTS),
-                    SLO_MAX_REQUESTS))
-        reqs = [pool[i % len(pool)] for i in range(n)]
-        curve.append(_drive_open_loop(ladder, reqs, rate))
+    # the whole sweep runs with request tracing armed: the reservoir
+    # keeps the K slowest requests ACROSS every offered rate with their
+    # full hop breakdown — the overload tail, attributed
+    with trace.tracing(k=8) as reservoir:
+        for f in SLO_RATE_FACTORS:
+            rate = capacity_qps * f
+            n = int(min(max(rate * SLO_SECONDS_PER_RATE, SLO_MIN_REQUESTS),
+                        SLO_MAX_REQUESTS))
+            reqs = [pool[i % len(pool)] for i in range(n)]
+            curve.append(_drive_open_loop(ladder, reqs, rate))
+        exemplars = reservoir.snapshot()
     # the retrace bound now spans admission off (serving_qps) AND on
     ladder.assert_no_retrace()
     lost = sum(pt["lost_futures"] for pt in curve)
@@ -903,6 +922,11 @@ def run_serving_slo(ladder, pool, capacity_qps: float | None = None) -> dict:
         "ok": ok,
         "verdict": verdict,
         "curve": curve,
+        # tail exemplars (slowest-first, full hop breakdown) + the
+        # slowest request's total as a gateable lower-better number
+        "exemplars": exemplars,
+        "exemplar_slowest_ms":
+            exemplars[0]["total_ms"] if exemplars else 0.0,
     }
 
 
@@ -1212,10 +1236,14 @@ def run_multihost_e2e() -> dict:
     root = tempfile.mkdtemp(prefix="photon_bench_mh_")
     sc.write_e2e_dataset(pathlib.Path(root))
     runs: dict = {}
+    tdirs: dict = {}
     try:
         for n in MH_PROCESS_COUNTS:
+            # each rank writes its p<k>.jsonl event log here — the input
+            # the cross-rank aggregation merges
+            tdirs[n] = tempfile.mkdtemp(prefix=f"photon_bench_mh_t{n}_")
             t0 = time.perf_counter()
-            res = launch(sc.target_stream_solve, n, args=(root,),
+            res = launch(sc.target_stream_solve, n, args=(root, tdirs[n]),
                          timeout_s=420)
             runs[n] = {"wall_s": time.perf_counter() - t0, "res": res}
     except ClusterUnavailable as e:
@@ -1241,6 +1269,18 @@ def run_multihost_e2e() -> dict:
     traced = trace_contract(spec)
     cost = estimate_jaxpr(traced.closed_jaxpr)
     feature_bytes = int(np.asarray(traced.example_args[0].X).nbytes)
+    # merge the widest run's per-rank event logs into ONE cluster report:
+    # per-rank rollups, barrier-wait + decode skew with the straggler
+    # rank named, wall-clock-aligned span timeline
+    from photon_tpu.telemetry.aggregate import aggregate_cluster
+
+    n_max = max(MH_PROCESS_COUNTS)
+    cluster = aggregate_cluster(tdirs[n_max], expect_ranks=n_max)
+    cluster["timeline"] = cluster["timeline"][:64]  # bound the JSON line
+    if not cluster["complete"]:
+        raise AssertionError(
+            f"multihost_e2e: cluster report incomplete at n={n_max}: "
+            f"missing={cluster['missing_ranks']}")
     return {
         "available": True,
         "dcn_bytes_per_eval": float(cost.collective_bytes),
@@ -1251,6 +1291,7 @@ def run_multihost_e2e() -> dict:
         "digest": digests.pop(),
         "iterations": int(runs[max(MH_PROCESS_COUNTS)]["res"][0]
                           ["iterations"]),
+        "cluster_report": cluster,
     }
 
 
@@ -1455,6 +1496,11 @@ def main() -> None:
                 round(rf_stats["full_retrain_wall_s"] * 1e3, 1),
             "refresh_e2e_touched_frac":
                 round(rf_stats["touched_frac"], 4),
+            # freshness (round 19): rows-changed → servable seconds,
+            # gauged by hot_swap at cutover ("staleness" gates it
+            # LOWER-better — a slower flywheel serves staler models)
+            "refresh_e2e_staleness_s":
+                round(rf_stats["staleness_s"], 3),
             # serving regime (round 9): closed-loop online scoring over a
             # zipf entity mix through the micro-batching dispatcher; the
             # leg itself asserts the TraceSignatureLog retrace bound
@@ -1485,6 +1531,11 @@ def main() -> None:
             "serving_slo_overload_shed_pct": slo_stats["overload_shed_pct"],
             "serving_slo_target_ms": SLO_TARGET_P99_MS,
             "serving_slo_ok": bool(slo_stats["ok"]),
+            # tail attribution (round 19): the sweep runs with request
+            # tracing armed; the slowest exemplar's total gates via
+            # "_ms" (the full hop breakdowns ride nested below)
+            "serving_slo_exemplar_slowest_ms":
+                round(slo_stats["exemplar_slowest_ms"], 3),
             # lane-batched tuner regime (round 16): 256 configs through
             # GP-proposed fixed-chunk lane rounds with successive halving
             # vs the point-at-a-time architecture (sampled + extrapolated).
@@ -1519,11 +1570,19 @@ def main() -> None:
         # per-shard feature bytes that never ride DCN) — nested, so
         # invisible to the sentinel's leg_values
         "multihost_e2e": mh_stats,
-        # the verdict line + full degradation curve ride beside the legs
-        # (strings/lists are invisible to the sentinel's leg_values)
+        # the verdict line + full degradation curve + tail exemplars ride
+        # beside the legs (strings/lists/nested dicts are invisible to
+        # the sentinel's leg_values)
         "serving_slo": {"verdict": slo_stats["verdict"],
-                        "curve": slo_stats["curve"]},
+                        "curve": slo_stats["curve"],
+                        "exemplars": slo_stats["exemplars"]},
     }
+    # the health plane's snapshot of this bench run: verdict + watchdog
+    # rules + counter rates, embedded in every JSON line (nested — the
+    # sentinel gates legs, operators read health)
+    from photon_tpu.telemetry import health as _health
+
+    doc["health"] = _health.snapshot(run).to_json()
     # attribution-ledger digest: the top measured programs + compile
     # accounting ride the JSON line next to the wall-clock legs
     doc["ledger"] = {"compile": ledger_report["compile"],

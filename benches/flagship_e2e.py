@@ -220,6 +220,24 @@ def main() -> None:
         # photon: allow(durable_write, bench-run report artifact — nothing resumes from it; a torn file just re-runs the bench)
         with open(ledger_json, "w") as fh:
             json.dump(ledger_report, fh)
+        cluster_json = None
+        if args.mesh:
+            # mesh runs also get the cross-rank view beside the ledger:
+            # this process's event log as rank 0 (a multi-process launch
+            # drops its p<k>.jsonl files into the same directory and the
+            # same call merges them all), spans wall-clock aligned
+            from photon_tpu.telemetry.aggregate import (aggregate_cluster,
+                                                        rank_files)
+
+            cluster_json = os.path.join(args.out_dir, f"game_r{run}",
+                                        "cluster_report.json")
+            rank_map = {0: jsonl}
+            rank_map.update(rank_files(os.path.dirname(jsonl)))
+            cluster = aggregate_cluster(rank_map)
+            cluster["timeline"] = cluster["timeline"][:256]
+            # photon: allow(durable_write, bench-run report artifact — nothing resumes from it; a torn file just re-runs the bench)
+            with open(cluster_json, "w") as fh:
+                json.dump(cluster, fh)
         phases = {k: round(v, 1) for k, v in sorted(out.timings.items())}
         print(f"run {run}: total {total:.0f}s  phases {phases}", flush=True)
         print(f"run {run}: validation AUC {out.best.validation_score:.4f} "
@@ -228,6 +246,8 @@ def main() -> None:
         print(json.dumps({"run": run, "total_s": round(total, 1),
                           "telemetry_jsonl": jsonl,
                           "ledger_json": ledger_json,
+                          **({"cluster_report_json": cluster_json}
+                             if cluster_json else {}),
                           "telemetry": trun.report_compact()}),
               flush=True)
 

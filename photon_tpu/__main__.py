@@ -19,8 +19,10 @@ and one crashed subsystem cannot take the others down):
                    whole-program concurrency auditor: thread inventory,
                    lock-order graph acyclic, blocking-under-lock, and
                    the pinned guarded-by bindings — jax-free)
-- ``telemetry``  — `--selftest`: sinks, spans, iteration stream, the
-                   telemetry-off-is-free contract
+- ``telemetry``  — `--selftest`: sinks, spans, iteration stream, both
+                   off-is-free contracts (telemetry + request tracing),
+                   tail-exemplar attribution, quantile-digest accuracy,
+                   watchdog verdicts, cross-rank aggregation
 - ``serving``    — `--selftest`: store + dispatcher offline parity,
                    cold-miss fallback, retrace bound
 - ``checkpoint`` — `--selftest`: kill → restore → bit parity + both

@@ -26,6 +26,7 @@ HOT_PATH_MODULES = (
     "photon_tpu.game.scoring",        # streamed inter-coordinate scorer
     "photon_tpu.drivers.score",       # chunked scoring driver program
     "photon_tpu.telemetry.taps",      # telemetry-off-is-free guarantee
+    "photon_tpu.telemetry.trace",     # request-tracing-off-is-free guarantee
     "photon_tpu.serving.programs",    # online per-request scoring ladder
     "photon_tpu.serving.admission",   # overload policy: program invariance
     "photon_tpu.serving.fleet",       # replica-shard per-request path

@@ -33,6 +33,7 @@ import json
 import os
 import re
 import shutil
+import time
 from typing import Optional
 
 import numpy as np
@@ -213,13 +214,20 @@ def publish_store(root: str, store: CoefficientStore) -> int:
 
 def hot_swap(live: Optional[CoefficientStore], new: CoefficientStore, *,
              root: Optional[str] = None,
-             probe: Optional[ParityProbe] = ParityProbe()) -> dict:
+             probe: Optional[ParityProbe] = ParityProbe(),
+             rows_changed_unix: Optional[float] = None) -> dict:
     """The cutover: probe → durable publish → in-process reload.
 
     ``live``: the serving process's store (None = publish-only, e.g. a
     refresh job on a different host than the scorers). ``root``: the
     versioned publish directory (None = in-process swap only).
-    Returns ``{"report": ParityReport | None, "version": int | None}``.
+    ``rows_changed_unix``: when the data this refresh folded in CHANGED
+    (the delta drop's timestamp); the swap then gauges
+    ``continual.staleness_s`` — rows-changed → servable seconds, the
+    model-freshness number the health plane exports — at the moment the
+    new coefficients become servable.
+    Returns ``{"report": ParityReport | None, "version": int | None,
+    "staleness_s": float | None}``.
     Raises `SwapRefused` on a probe breach — nothing publishes, nothing
     reloads, the old model keeps serving.
     """
@@ -235,4 +243,9 @@ def hot_swap(live: Optional[CoefficientStore], new: CoefficientStore, *,
             version = publish_store(root, new)
         if live is not None:
             live.reload_coefficients(new)  # counts serving.hot_swaps
-        return {"report": report, "version": version}
+        staleness = None
+        if rows_changed_unix is not None:
+            staleness = max(0.0, time.time() - float(rows_changed_unix))
+            telemetry.gauge("continual.staleness_s", staleness)
+        return {"report": report, "version": version,
+                "staleness_s": staleness}

@@ -19,7 +19,11 @@ this process never touches a jax backend, exactly like the umbrella
    write and the commit barrier — the surviving rank's commit must fail
    loudly within ``PHOTON_TPU_BARRIER_TIMEOUT_S`` (no hang, no manifest
    referencing a dead rank's unconfirmed snapshot) and the previous
-   manifest must still restore.
+   manifest must still restore;
+4. cross-rank aggregation: a 2-process e2e stream-solve writes per-rank
+   ``p<k>.jsonl`` event logs; `telemetry.aggregate.aggregate_cluster`
+   must merge them into one complete cluster report — both ranks
+   rolled up, decode/barrier skew attributed, the straggler rank named.
 
 Sandboxes that block even localhost gRPC cannot form a jax.distributed
 cluster at all; the selftest then reports ``available: false`` with the
@@ -89,6 +93,26 @@ def selftest() -> dict:
         check("previous_manifest_still_restores",
               store.latest_seq() == 0 and loaded is not None,
               f"latest_seq={store.latest_seq()}")
+
+        # ---- 4. per-rank JSONL logs -> one merged cluster report
+        import pathlib
+
+        from photon_tpu.telemetry.aggregate import aggregate_cluster
+
+        root = pathlib.Path(tempfile.mkdtemp(prefix="photon_mh_agg_data_"))
+        sc.write_e2e_dataset(root)
+        tdir = tempfile.mkdtemp(prefix="photon_mh_agg_tele_")
+        res = launch(sc.target_stream_solve, 2, args=(root, tdir),
+                     timeout_s=300)
+        rep = aggregate_cluster(tdir, expect_ranks=2)
+        decoded = sum(r["chunks_decoded"] for r in res)
+        check("cross_rank_aggregation",
+              rep["complete"] and rep["n_ranks"] == 2
+              and not rep["missing_ranks"]
+              and rep["skew"]["straggler_rank"] in (0, 1)
+              and rep["counters_total"].get("ingest.chunks", 0) == decoded,
+              f"n_ranks={rep['n_ranks']} missing={rep['missing_ranks']} "
+              f"straggler={rep['skew']['straggler_rank']}")
     except ClusterUnavailable as e:
         report["available"] = False
         report["reason"] = str(e).splitlines()[0][:300]

@@ -156,6 +156,32 @@ def distributed_client():
         return None
 
 
+def cluster_barrier(tag: str, timeout_s: float = 60.0) -> float:
+    """A TIMED cluster-wide barrier: every process blocks until all ranks
+    arrive, and the wait is measured into a ``parallel.barrier_wait``
+    span (attrs carry the tag) — the signal `telemetry.aggregate` uses to
+    name the straggler rank (the rank that waits LEAST is the one the
+    others waited for). Returns this rank's wait in seconds; free no-op
+    (0.0, still spanned) on a single-process cluster. Prefers the
+    coordination-service barrier, falling back to a device-level sync
+    like the checkpoint store's commit barrier."""
+    import time
+
+    from photon_tpu import telemetry
+
+    t0 = time.perf_counter()
+    with telemetry.span("parallel.barrier_wait", tag=tag):
+        if jax.process_count() > 1:
+            client = distributed_client()
+            if client is not None:
+                client.wait_at_barrier(tag, int(timeout_s * 1000))
+            else:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(tag)
+    return time.perf_counter() - t0
+
+
 def _pin_cpu_collectives() -> None:
     """CPU backend only: select gloo for cross-process collectives BEFORE
     the backend initializes. jax 0.4's default CPU client refuses

@@ -145,9 +145,15 @@ def target_psum_signature(ctx) -> dict:
 
 
 def target_stream_solve(ctx) -> dict:
-    """args=(dataset_root,): the whole per-process pipeline — one scan
-    pass, ``local_only=True`` ingest (this process's container blocks
-    only), resident mesh GLM solve closed by the hierarchical psum."""
+    """args=(dataset_root[, telemetry_dir]): the whole per-process
+    pipeline — one scan pass, ``local_only=True`` ingest (this process's
+    container blocks only), resident mesh GLM solve closed by the
+    hierarchical psum. With a ``telemetry_dir`` the rank writes its full
+    JSONL event log as ``p<k>.jsonl`` and times a cluster barrier after
+    the solve — the inputs `telemetry.aggregate.aggregate_cluster` merges
+    into the cross-rank skew report."""
+    import os
+
     from photon_tpu import telemetry
     from photon_tpu.data.dataset import make_batch
     from photon_tpu.data.streaming import scan_ingest, stream_to_device
@@ -155,12 +161,16 @@ def target_stream_solve(ctx) -> dict:
     from photon_tpu.ops.losses import TaskType
     from photon_tpu.optim import regularization as reg
     from photon_tpu.optim.config import OptimizerConfig
+    from photon_tpu.parallel.mesh import cluster_barrier
 
-    (root,) = ctx.args
+    root, *rest = ctx.args
+    tdir = str(rest[0]) if rest else None
+    jsonl = os.path.join(tdir, f"p{ctx.process_id}.jsonl") if tdir else None
     config = _e2e_config()
     scan = scan_ingest(str(root), config)
     mesh = _mesh()
-    telemetry.start_run(name=f"multihost_rank{ctx.process_id}")
+    telemetry.start_run(name=f"multihost_rank{ctx.process_id}",
+                        jsonl_path=jsonl)
     data, n_real = stream_to_device(
         str(root), config, scan.index_maps, mesh=mesh, chunk_rows=300,
         block_index=scan.block_index, local_only=True)
@@ -170,6 +180,9 @@ def target_stream_solve(ctx) -> dict:
         batch, TaskType.LOGISTIC_REGRESSION,
         OptimizerConfig(max_iters=30, reg=reg.l2(), reg_weight=1.0),
         mesh=mesh)
+    # timed barrier: the straggler rank waits least here, which is the
+    # signal the cross-rank aggregation's skew attribution reads
+    barrier_wait_s = cluster_barrier("stream_solve_done")
     report = telemetry.finish_run() or {}
     counters = report.get("counters", {})
     w = np.asarray(model.coefficients.means, np.float64)
@@ -177,6 +190,7 @@ def target_stream_solve(ctx) -> dict:
             "digest": hashlib.sha256(w.tobytes()).hexdigest()[:16],
             "chunks_decoded": int(counters.get("ingest.chunks", 0)),
             "chunks_skipped": int(counters.get("ingest.chunks_skipped", 0)),
+            "barrier_wait_s": round(barrier_wait_s, 6),
             "iterations": int(res.iterations)}
 
 

@@ -80,7 +80,8 @@ SCHEMA_VERSION = 2
 # spine's priced per-eval wire bill (round 17) — a grown psum payload
 # means something besides the gradient started riding DCN.
 _LOWER_BETTER_PATTERNS = ("_ms", "overhead_pct", "pad_waste", "latency",
-                         "stall", "shed", "maxdiff", "dcn_bytes")
+                         "stall", "shed", "maxdiff", "dcn_bytes",
+                         "staleness")
 
 # Config-ish / count legs that are not performance quantities: a changed
 # topology, cadence, or layout split must not read as a "regression".

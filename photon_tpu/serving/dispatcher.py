@@ -31,8 +31,19 @@ Telemetry (`serving.*` family, names listed in
 pad_waste/cold_misses/admitted/shed/deadline_expired counters,
 queue-depth and batch-fill gauges, one ``serving_batch`` event per
 flush, and per-request wall latency recorded request-enqueue →
-score-delivered, summarized as p50/p95/p99 by `latency_stats` (gauged at
-`close`).
+score-delivered into a fixed-size `telemetry.health.QuantileDigest`
+(O(1) memory however long the process serves), summarized as
+p50/p95/p99 by `latency_stats` (gauged at `close`).
+
+Request tracing (`telemetry.trace`, OFF by default): each `_Pending`
+carries an optional trace context across the submit → queue → rung-flush
+→ retire thread boundaries — hops ``queue_wait`` (enqueue → batch
+pickup), ``device_flush`` (collate + program dispatch), ``retire_wait``
+(retire queue + blocking device_get) — and the retire thread, the one
+that resolves the future, closes the span into the tail-exemplar
+reservoir. Disarmed it is one global load + one branch per submit; the
+``serving_trace_off_is_free`` contract pins that arming it cannot touch
+the device program.
 
 Thread-safety: `submit`/`score` are safe from any number of client
 threads; results arrive on `concurrent.futures.Future`s — a float score,
@@ -52,6 +63,8 @@ import numpy as np
 
 from photon_tpu import profiling, telemetry
 from photon_tpu.checkpoint import faults
+from photon_tpu.telemetry import trace
+from photon_tpu.telemetry.health import QuantileDigest
 from photon_tpu.data.matrix import SparseRows
 from photon_tpu.serving.admission import (SHED_DEADLINE, SHED_QUEUE_FULL,
                                           AdmissionController,
@@ -81,13 +94,16 @@ class ScoreRequest:
 
 
 class _Pending:
-    __slots__ = ("req", "future", "t_enqueue", "deadline_ns")
+    __slots__ = ("req", "future", "t_enqueue", "deadline_ns", "trace")
 
     def __init__(self, req: ScoreRequest):
         self.req = req
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter_ns()
         self.deadline_ns: Optional[int] = None
+        # None unless tracing is armed; carried across the dispatch/retire
+        # thread boundary so the future-resolving thread closes the span
+        self.trace = trace.begin("queue_wait")
 
 
 def collate_rung_args(ladder: ProgramLadder, batch: list,
@@ -195,7 +211,9 @@ class MicroBatchDispatcher:
         self._executor = RungExecutor(ladder)
         self._q: queue.Queue = queue.Queue(maxsize=int(queue_depth))
         self._retire_q: queue.Queue = queue.Queue(maxsize=4)
-        self._latencies_ns: list = []
+        # fixed-size latency digest, NOT an append-only list: a long-lived
+        # serving process keeps O(1) percentile memory (≤0.5% rel. error)
+        self._lat = QuantileDigest()
         self._lat_lock = threading.Lock()
         self._closed = False
         self._dispatch_thread = threading.Thread(
@@ -261,16 +279,10 @@ class MicroBatchDispatcher:
     # ---------------------------------------------------------------- stats
     def latency_stats(self) -> dict:
         """Request-latency percentiles (ms) over every retired request
-        (shed requests never retire — they have no device latency)."""
+        (shed requests never retire — they have no device latency), read
+        from the fixed-size quantile digest."""
         with self._lat_lock:
-            lat = np.asarray(self._latencies_ns, np.float64)
-        if lat.size == 0:
-            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None,
-                    "mean_ms": None}
-        p50, p95, p99 = np.percentile(lat, [50, 95, 99]) / 1e6
-        return {"n": int(lat.size), "p50_ms": float(p50),
-                "p95_ms": float(p95), "p99_ms": float(p99),
-                "mean_ms": float(lat.mean() / 1e6)}
+            return self._lat.stats_ms()
 
     # ------------------------------------------------------------- internals
     def _shed(self, p: _Pending, reason: str) -> Future:
@@ -283,6 +295,8 @@ class MicroBatchDispatcher:
         if not p.future.done():
             p.future.set_result(Shed(reason, queue_depth=self._q.qsize(),
                                      waited_ms=waited_ms))
+        trace.hop(p.trace, "shed", reason=reason)
+        trace.finish(p.trace)
         return p.future
 
     def _expire(self, p: _Pending, now_ns: Optional[int] = None) -> bool:
@@ -350,6 +364,8 @@ class MicroBatchDispatcher:
         n = len(batch)
         if n == 0:
             return
+        for p in batch:
+            trace.hop(p.trace, "device_flush")
         try:
             with telemetry.span("serving.flush", rows=n):
                 out_dev, bucket, misses = self._executor.execute(batch)
@@ -362,11 +378,14 @@ class MicroBatchDispatcher:
             telemetry.gauge("serving.batch_fill", n / bucket)
             telemetry.event("serving_batch", rows=n, bucket=bucket,
                             cold_misses=misses)
+            for p in batch:
+                trace.hop(p.trace, "retire_wait")
             self._retire_q.put((batch, out_dev))  # readback off this thread
         except BaseException as e:  # noqa: BLE001 — delivered, not lost
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(e)
+                trace.finish(p.trace)
 
     def _retire_loop(self) -> None:
         import jax
@@ -381,11 +400,13 @@ class MicroBatchDispatcher:
             except BaseException as e:  # noqa: BLE001
                 for p in batch:
                     p.future.set_exception(e)
+                    trace.finish(p.trace)
                 continue
             t_now = time.perf_counter_ns()
             lats = []
             for i, p in enumerate(batch):
                 lats.append(t_now - p.t_enqueue)
                 p.future.set_result(float(scores[i]))
+                trace.finish(p.trace)  # the retire thread closes the span
             with self._lat_lock:
-                self._latencies_ns.extend(lats)
+                self._lat.add_many(lats)

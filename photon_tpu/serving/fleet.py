@@ -51,6 +51,8 @@ import numpy as np
 
 from photon_tpu import telemetry
 from photon_tpu.checkpoint.faults import retry_io
+from photon_tpu.telemetry import trace
+from photon_tpu.telemetry.health import QuantileDigest
 from photon_tpu.data.index_map import IndexMap
 from photon_tpu.serving.admission import AdmissionPolicy, Shed
 from photon_tpu.serving.dispatcher import MicroBatchDispatcher, ScoreRequest
@@ -260,13 +262,24 @@ class ReplicaFleet:
         primary = self.replica_for(req)
         state = {"attempt": 0}
         bound = self.policy.attempt_timeout_s if timeout is None else timeout
+        # one trace across every failover attempt: the ContextVar attach
+        # below lets each replica's dispatcher continue THIS trace
+        tc = trace.begin("fleet_route", primary=primary)
 
         def attempt():
             idx = (primary + state["attempt"]) % self.n_replicas
             if state["attempt"]:
                 telemetry.count("serving.fleet_failovers")
             state["attempt"] += 1
-            out = self.replicas[idx].dispatch(req, timeout=bound)
+            trace.hop(tc, "replica_dispatch", replica=idx)
+            try:
+                with trace.attach(tc):
+                    out = self.replicas[idx].dispatch(req, timeout=bound)
+            except BaseException:
+                # retry_io's backoff sleep runs between this raise and
+                # the next attempt's hop — it accrues here, by name
+                trace.hop(tc, "failover_backoff", replica=idx)
+                raise
             telemetry.count("serving.fleet_dispatches")
             if idx != primary and not isinstance(out, Shed):
                 telemetry.count("serving.fleet_degraded")
@@ -274,11 +287,14 @@ class ReplicaFleet:
 
         # InjectedFault is a RuntimeError: an injected replica death at
         # any occurrence fails over exactly like a real one
-        return retry_io(attempt, site="replica_dispatch",
-                        retries=self.policy.failover_retries,
-                        base_delay=self.policy.base_delay_s,
-                        max_delay=self.policy.max_delay_s,
-                        retry_on=(OSError, FutureTimeout, RuntimeError))
+        try:
+            return retry_io(attempt, site="replica_dispatch",
+                            retries=self.policy.failover_retries,
+                            base_delay=self.policy.base_delay_s,
+                            max_delay=self.policy.max_delay_s,
+                            retry_on=(OSError, FutureTimeout, RuntimeError))
+        finally:
+            trace.finish(tc)  # no-op if a retire thread closed it first
 
     def submit(self, req: ScoreRequest):
         """Asynchronous fleet scoring: a Future resolving to the score
@@ -295,17 +311,16 @@ class ReplicaFleet:
         return sum(r.ladder.assert_no_retrace() for r in self.replicas)
 
     def latency_stats(self) -> dict:
-        """Pooled request-latency percentiles across all replicas."""
-        lats: list = []
+        """Pooled request-latency percentiles across all replicas — an
+        EXACT digest merge (same bucketing → counts add), not a
+        concatenated sample list."""
+        merged = QuantileDigest()
         for r in self.replicas:
             with r.dispatcher._lat_lock:
-                lats.extend(r.dispatcher._latencies_ns)
-        if not lats:
-            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None}
-        arr = np.asarray(lats, np.float64)
-        p50, p95, p99 = np.percentile(arr, [50, 95, 99]) / 1e6
-        return {"n": int(arr.size), "p50_ms": float(p50),
-                "p95_ms": float(p95), "p99_ms": float(p99)}
+                merged.merge(r.dispatcher._lat)
+        s = merged.stats_ms()
+        return {"n": s["n"], "p50_ms": s["p50_ms"],
+                "p95_ms": s["p95_ms"], "p99_ms": s["p99_ms"]}
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain the submit pool, then close every replica (each close

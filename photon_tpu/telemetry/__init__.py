@@ -65,6 +65,9 @@ refreshes from the partial re-solve, probe_entities/swap_refusals from
 the parity-probed hot swap (the in-process cutover itself counts on
 `serving.hot_swaps`), with delta_diff/refresh/refresh_coordinate/
 refresh_solve/probe/swap spans — the
+the continual flywheel's staleness_s gauge (rows-changed → servable
+seconds, gauged by `continual/swap.py::hot_swap(rows_changed_unix=...)`
+at cutover — the model-freshness number `telemetry.health` exports) — the
 grouped-evaluation `eval.*` family — scatter_elems_saved, the elements
 per metric call that would have entered combining scatters before the
 round-12 sorted-segment rework of `evaluation/grouped.py` — the
@@ -90,9 +93,27 @@ resident solvers via `Run(resident_tap=True)` (a `jax.debug.callback`
 compiled out by default; the registered `telemetry_off_is_free`
 ContractSpec enforces exactly that).
 
+The multi-process spine's `parallel.*` span family holds one timed
+barrier span — ``parallel.barrier_wait``, opened by
+`parallel/mesh.py::cluster_barrier` — whose per-rank totals are what
+`telemetry.aggregate` reads to name the straggler rank.
+
 Sinks: `Run.report()` (in-memory dict), a JSONL event file
 (`sinks.read_jsonl` / `sinks.load_report`), and a human end-of-run
 summary through `photon_logger` at close.
+
+The observability plane on top of the spine (round 19):
+`telemetry.trace` — per-request distributed tracing (trace id +
+causally-ordered hops across the dispatcher's submit→queue→flush→retire
+threads and the fleet's failover attempts) with a bounded reservoir of
+tail exemplars, OFF by default and pinned free-when-off by the
+``serving_trace_off_is_free`` ContractSpec; `telemetry.aggregate` —
+cross-rank JSONL merge into one cluster report (per-rank rollups,
+barrier-wait/decode skew attribution, wall-clock-aligned timelines);
+`telemetry.health` — fixed-size quantile digests, counter-rate windows,
+declarative watchdog rules (OK/DEGRADED/CRITICAL), and the staleness
+gauge, exported as JSON + Prometheus textfile via ``python -m
+photon_tpu.telemetry --health``.
 
 THE OFF-STATE CONTRACT: every module-level helper here starts with
 ``if _CURRENT is None: return`` — a run-less process pays one global load
@@ -319,10 +340,11 @@ TELEMETRY_REGISTRY = {
         "serving.latency_*", "serving.fleet_replicas",
         "hbm.bytes_in_use.max*", "hbm.peak_bytes_in_use.max*",
         "tuning.round_model_flops",
+        "continual.staleness_s",
     ),
     "span_families": (
         "train", "score", "ingest", "solve",
         "game", "game_re", "serving", "checkpoint", "continual",
-        "tuning",
+        "tuning", "parallel",
     ),
 }

@@ -1,17 +1,25 @@
-"""CLI: smoke-check the telemetry spine.
+"""CLI: smoke-check the telemetry spine + the observability plane.
 
     python -m photon_tpu.telemetry --selftest          # exit 1 on failure
     python -m photon_tpu.telemetry --selftest --json   # machine report
     python -m photon_tpu.telemetry --report PATH       # summarize a JSONL file
+    python -m photon_tpu.telemetry --health PATH       # HealthReport JSON
+    python -m photon_tpu.telemetry --health PATH --prom OUT  # + textfile
 
 The selftest exercises every sink and the off-state guarantee without
 touching real data: span nesting + exception safety, cross-thread counter
 aggregation, the JSONL round-trip (written file == in-memory report), a
-live iteration stream from a tiny streamed L-BFGS solve, and the
+live iteration stream from a tiny streamed L-BFGS solve, the
 `telemetry_off_is_free` ContractSpec (the resident solver program traced
-with telemetry disabled must contain zero callbacks/transfers). Mirrors
-`analysis.__main__`: environment defaults are applied BEFORE jax loads,
-so it runs anywhere CI does.
+with telemetry disabled must contain zero callbacks/transfers) — and the
+round-19 observability plane: request-trace exemplar attribution (the
+slowest trace names its dominant hop), the `serving_trace_off_is_free`
+contract, quantile-digest accuracy + merge, the watchdog verdict ladder,
+and the cross-rank aggregation round-trip (torn tail + missing rank
+named, never a crash). ``--health`` rebuilds a typed HealthReport from a
+run's JSONL file and prints it as JSON (``--prom OUT`` also writes the
+Prometheus-textfile rendering). Mirrors `analysis.__main__`: environment
+defaults are applied BEFORE jax loads, so it runs anywhere CI does.
 """
 from __future__ import annotations
 
@@ -125,6 +133,89 @@ def _selftest(as_json: bool) -> int:
         check("off_is_free_contract", not violations,
               "; ".join(str(v) for v in violations))
 
+    # ---- round-19 observability plane ----------------------------------
+    import time as _time
+
+    from photon_tpu.telemetry import trace  # registers the trace spec
+
+    spec = REGISTRY.get("serving_trace_off_is_free")
+    if spec is None:
+        check("trace_off_is_free_contract", False, "spec not registered")
+    else:
+        violations = check_contract(spec)
+        check("trace_off_is_free_contract", not violations,
+              "; ".join(str(v) for v in violations))
+
+    # tail exemplars: a deterministically slow hop must be NAMED by the
+    # slowest exemplar, and fast traces must not displace it
+    with trace.tracing(k=2) as res:
+        tc = trace.begin("queue_wait")
+        trace.hop(tc, "device_flush")
+        _time.sleep(0.03)  # the injected slow hop
+        trace.hop(tc, "retire_wait")
+        trace.finish(tc)
+        for _ in range(3):
+            trace.finish(trace.begin("queue_wait"))
+        slow = res.slowest()
+    check("trace_exemplar_attribution",
+          slow is not None and slow["slowest_hop"] == "device_flush"
+          and res.n_offered == 4,
+          f"slowest={slow and slow['slowest_hop']} "
+          f"offered={res.n_offered}")
+    check("trace_disarmed_is_off",
+          trace.begin("queue_wait") is None and trace.reservoir() is None)
+
+    # quantile digest: bounded p99 error + exact merge
+    from photon_tpu.telemetry.health import (DEFAULT_RULES, QuantileDigest,
+                                             report_from_jsonl)
+
+    rng = np.random.default_rng(19)
+    samples = rng.lognormal(mean=14.0, sigma=1.2, size=20_000)  # ns scale
+    d1, d2 = QuantileDigest(), QuantileDigest()
+    d1.add_many(samples[:10_000])
+    d2.add_many(samples[10_000:])
+    d1.merge(d2)
+    exact = float(np.quantile(samples, 0.99))
+    err = abs(d1.quantile(0.99) - exact) / exact
+    check("digest_p99_error", err <= 0.01, f"rel err {err:.4f}")
+
+    # watchdog ladder: a quiet plane is OK, heavy shed is CRITICAL
+    shed = DEFAULT_RULES[0]
+    quiet = shed.evaluate({"serving.shed": 0, "serving.admitted": 100})
+    loud = shed.evaluate({"serving.shed": 30, "serving.admitted": 100})
+    check("watchdog_verdicts",
+          quiet["verdict"] == "OK" and loud["verdict"] == "CRITICAL",
+          f"quiet={quiet['verdict']} loud={loud['verdict']}")
+
+    # cross-rank aggregation: torn tail survives, missing rank is named
+    from photon_tpu.telemetry.aggregate import aggregate_cluster
+
+    with tempfile.TemporaryDirectory() as tdir:
+        for rank in range(2):
+            telemetry.start_run(f"agg_rank{rank}", jsonl_path=os.path.join(
+                tdir, f"p{rank}.jsonl"))
+            with telemetry.span("ingest.decode"):
+                telemetry.count("ingest.chunks", 3.0)
+            telemetry.finish_run()
+        with open(os.path.join(tdir, "p1.jsonl"), "a") as f:
+            f.write('{"type": "torn')  # mid-record tear after run_end
+        rep = aggregate_cluster(tdir, expect_ranks=3)
+        check("aggregate_roundtrip",
+              rep["n_ranks"] == 2 and rep["missing_ranks"] == [2]
+              and not rep["complete"]
+              and rep["counters_total"].get("ingest.chunks") == 6.0
+              and rep["skew"]["straggler_rank"] in (0, 1),
+              f"ranks={rep['n_ranks']} missing={rep['missing_ranks']} "
+              f"totals={rep['counters_total']}")
+
+        # the health plane's offline face, from the same rank file
+        hrep = report_from_jsonl(os.path.join(tdir, "p0.jsonl"))
+        check("health_from_jsonl",
+              hrep.verdict == "OK" and hrep.name == "agg_rank0"
+              and all(r["verdict"] == "OK" for r in hrep.rules)
+              and "photon_tpu_health_verdict 0" in hrep.prometheus(),
+              f"verdict={hrep.verdict} name={hrep.name}")
+
     failures = {k: v for k, v in checks.items() if v}
     if as_json:
         print(json.dumps({"ok": not failures, "checks": {
@@ -150,6 +241,20 @@ def main(argv=None) -> int:
         rep["spans"] = rep["spans"][:50]
         rep["iterations"] = rep["iterations"][:50]
         print(json.dumps(rep, indent=2))
+        return 0
+    if "--health" in argv:
+        import json
+
+        from photon_tpu.telemetry.health import report_from_jsonl
+
+        path = argv[argv.index("--health") + 1]
+        rep = report_from_jsonl(path)
+        print(json.dumps(rep.to_json(), indent=2))
+        if "--prom" in argv:
+            out = argv[argv.index("--prom") + 1]
+            # photon: allow(durable_write, node-exporter textfile — rewritten on every scrape, nothing resumes from it)
+            with open(out, "w") as f:
+                f.write(rep.prometheus())
         return 0
     if "--selftest" in argv:
         return _selftest("--json" in argv)

@@ -192,7 +192,11 @@ class Run:
     def _record_span(self, rec: Span) -> None:
         with self._lock:
             self.spans.append(rec)
-        self._emit(rec.to_json())
+        j = rec.to_json()
+        # run-relative start offset: telemetry.aggregate places the span
+        # on a wall clock as run_start.started_unix + t_s
+        j["t_s"] = round((rec.start_ns - self._t0_ns) / 1e9, 6)
+        self._emit(j)
 
     # ------------------------------------------------------------- primitives
     def span(self, name: str, **attrs) -> _SpanCM:

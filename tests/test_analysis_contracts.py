@@ -24,10 +24,10 @@ _REGISTRY = load_registry()
 
 
 def test_registry_is_broad_enough():
-    """≥ 47 specs (round 17 added the multi-host wire-bill pin:
-    `multihost_grad_only_dcn` — gradient-only DCN traffic) spanning
-    every workload family."""
-    assert len(_REGISTRY) >= 47
+    """≥ 48 specs (round 19 added the request-tracing off-state pin:
+    `serving_trace_off_is_free` — zero extra primitives + zero rung
+    signature drift armed vs disarmed) spanning every workload family."""
+    assert len(_REGISTRY) >= 48
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",
@@ -49,6 +49,21 @@ def test_lane_tuner_specs_are_registered():
         assert "tuning" in spec.tags and "lane" in spec.tags, name
         violations = check_contract(spec)
         assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_serving_trace_off_is_free_spec_is_registered():
+    """The round-19 acceptance pin, strict: the serving rung program
+    traced with request tracing DISARMED budgets zero collectives and
+    forbids transfers (tracing is host bookkeeping around host queues —
+    it cannot enter the program), and the builder itself raises if the
+    collated rung arguments drift signature between armed and disarmed
+    (the zero-retrace half)."""
+    spec = _REGISTRY["serving_trace_off_is_free"]
+    assert dict(spec.collectives or {}) == {}
+    assert not spec.allow_transfers and not spec.allow_f64
+    assert "serving" in spec.tags and "telemetry" in spec.tags
+    violations = check_contract(spec)
+    assert violations == [], "\n".join(str(v) for v in violations)
 
 
 def test_roofline_closure_specs_are_registered():

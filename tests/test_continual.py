@@ -497,6 +497,33 @@ class TestSwap:
         finally:
             telemetry.finish_run()
 
+    def test_staleness_gauge_rides_the_swap(self, world):
+        """`rows_changed_unix` arms the freshness clock: the swap gauges
+        ``continual.staleness_s`` (rows-changed -> servable seconds) at
+        the moment the new coefficients become servable, and returns the
+        same number. Without the timestamp nothing is gauged."""
+        import time as _time
+        live, new = self._stores(world)
+        changed = _time.time() - 5.0  # the delta's rows changed 5s ago
+        run = telemetry.start_run("swap_staleness")
+        try:
+            out = continual.hot_swap(live, new,
+                                     probe=continual.ParityProbe(bound=1e3),
+                                     rows_changed_unix=changed)
+            assert out["staleness_s"] is not None
+            assert 5.0 <= out["staleness_s"] < 60.0
+            assert run.gauges.get("continual.staleness_s") == pytest.approx(
+                out["staleness_s"])
+            # disarmed: no timestamp, no gauge, None in the return
+            live2, new2 = self._stores(world)
+            out2 = continual.hot_swap(live2, new2,
+                                      probe=continual.ParityProbe(bound=1e3))
+            assert out2["staleness_s"] is None
+            assert run.gauges.get("continual.staleness_s") == pytest.approx(
+                out["staleness_s"])  # untouched by the disarmed swap
+        finally:
+            telemetry.finish_run()
+
 
 def test_selftest_cli_end_to_end():
     """`python -m photon_tpu.continual --selftest --json` — the CI smoke

@@ -369,6 +369,39 @@ class TestSentinel:
                              shist)["serving_slo_overload_shed_pct"
                                     ].status == "ok"
 
+    def test_observability_leg_admission(self):
+        """The round-19 observability legs as the sentinel sees them:
+        the staleness gauge (rows-changed -> servable seconds) and the
+        slowest-exemplar latency admit as 'new' and gate LOWER-better
+        (staler models and fatter tails are the regressions these legs
+        exist to catch); the nested exemplar list riding the serving_slo
+        sub-dict is structure, not a leg."""
+        verdicts = sentinel.gate(
+            {"refresh_e2e_staleness_s": 4.2,
+             "serving_slo_exemplar_slowest_ms": 31.0,
+             "dense_rate": 1e8},
+            _history())
+        assert verdicts["refresh_e2e_staleness_s"].status == "new"
+        assert verdicts["serving_slo_exemplar_slowest_ms"].status == "new"
+        assert verdicts["dense_rate"].status == "ok"
+        # directions: both are freshness/latency costs
+        assert sentinel.lower_is_better("refresh_e2e_staleness_s")
+        assert sentinel.lower_is_better("serving_slo_exemplar_slowest_ms")
+        # a model going stale regresses; getting fresher is ok
+        shist = _history(leg="refresh_e2e_staleness_s", base=4.0)
+        assert sentinel.gate({"refresh_e2e_staleness_s": 300.0}, shist)[
+            "refresh_e2e_staleness_s"].status == "regressed"
+        assert sentinel.gate({"refresh_e2e_staleness_s": 1.0}, shist)[
+            "refresh_e2e_staleness_s"].status == "ok"
+        # exemplar dicts, the health snapshot, and verdict strings are
+        # invisible to leg_values — only scalar legs gate
+        legs = sentinel.leg_values(
+            {"legs": {"refresh_e2e_staleness_s": 4.2,
+                      "serving_slo": {"exemplars": [
+                          {"total_ms": 31.0, "slowest_hop": "queue_wait"}]},
+                      "health": {"verdict": "OK"}}})
+        assert legs == {"refresh_e2e_staleness_s": 4.2}
+
     def test_tuning_e2e_leg_admission(self):
         """The round-16 lane-tuner legs as the sentinel sees them: the
         configs-per-second rates and the speedup admit as 'new' and gate

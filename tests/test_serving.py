@@ -12,6 +12,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -19,6 +20,7 @@ import pytest
 import jax.numpy as jnp
 
 from photon_tpu import serving, telemetry
+from photon_tpu.telemetry import trace
 from photon_tpu.data.matrix import SparseRows
 from photon_tpu.game.dataset import GameData
 from photon_tpu.game.model import (FixedEffectModel, GameModel,
@@ -410,6 +412,89 @@ class TestDispatcherBehavior:
             assert isinstance(d.score(reqs[0], timeout=30), float)
         finally:
             d.close()
+
+
+# ---------------------------------------------------------- request tracing
+class TestDispatcherTracing:
+    """telemetry/trace.py riding the real dispatcher: a deterministically
+    slow hop must be NAMED by the slowest exemplar, arming tracing must
+    not mint new rung signatures, and the disarmed path stays free."""
+
+    def test_slow_device_flush_names_the_hop(self, demo):
+        """THE acceptance: inject a deterministic slow hop (a sleeping
+        executor) and the slowest-trace exemplar names it."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(11)
+        reqs, _, _ = _requests(rng, model, 4)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=500)
+        real_execute = d._executor.execute
+
+        def slow_execute(batch):
+            time.sleep(0.05)
+            return real_execute(batch)
+
+        d._executor.execute = slow_execute
+        try:
+            with trace.tracing(k=2) as res:
+                futs = [d.submit(q) for q in reqs]
+                [f.result(timeout=30) for f in futs]
+                slow = res.slowest()
+        finally:
+            d.close()
+        assert slow is not None and slow["slowest_hop"] == "device_flush"
+        assert slow["breakdown_ms"]["device_flush"] >= 40.0
+        assert res.n_offered == len(reqs)
+        # the full hop chain survives the three thread crossings
+        names = [h["name"] for h in slow["hops"]]
+        assert names == ["queue_wait", "device_flush", "retire_wait"]
+
+    def test_slow_queue_wait_names_the_hop(self, demo):
+        """Same acceptance from the other side: a long batching delay on
+        a lone request makes queue_wait the dominant hop."""
+        model, _, ladder = demo
+        rng = np.random.default_rng(12)
+        reqs, _, _ = _requests(rng, model, 1)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=80_000)
+        try:
+            with trace.tracing(k=1) as res:
+                assert isinstance(d.score(reqs[0], timeout=30), float)
+                slow = res.slowest()
+        finally:
+            d.close()
+        assert slow is not None and slow["slowest_hop"] == "queue_wait"
+        assert slow["breakdown_ms"]["queue_wait"] >= 60.0
+
+    def test_armed_tracing_never_retraces(self, demo):
+        model, _, ladder = demo
+        rng = np.random.default_rng(13)
+        reqs, _, _ = _requests(rng, model, 18)
+        d = serving.MicroBatchDispatcher(ladder, max_batch=8,
+                                         max_delay_us=2000)
+        try:
+            # untraced warm drive populates both rungs' signatures...
+            futs = [d.submit(q) for q in reqs[:9]]
+            [f.result(timeout=30) for f in futs]
+            before = ladder.assert_no_retrace()
+            # ...then the armed drive must not mint a single new one
+            with trace.tracing(k=4):
+                futs = [d.submit(q) for q in reqs[9:]]
+                [f.result(timeout=30) for f in futs]
+        finally:
+            d.close()
+        assert ladder.assert_no_retrace() == before
+
+    def test_disarmed_requests_carry_no_trace(self, demo):
+        from photon_tpu.serving.dispatcher import _Pending
+        model, _, ladder = demo
+        rng = np.random.default_rng(14)
+        reqs, _, _ = _requests(rng, model, 2)
+        # the request object is where the trace rides; disarmed it is None
+        assert _Pending(reqs[0]).trace is None
+        with trace.tracing(k=2):
+            assert _Pending(reqs[0]).trace is not None
+        assert trace.reservoir() is None
 
 
 # ------------------------------------------------------------ overload policy

@@ -217,6 +217,70 @@ class TestFleetServing:
         assert r.counters["faults.io_retries.replica_dispatch"] == 2.0
         assert r.counters["faults.backoff_seconds"] > 0
 
+    def test_failover_hops_ride_one_trace(self, rig):
+        """Request tracing across failover: a replica dying mid-dispatch
+        puts ``failover_backoff`` between its ``replica_dispatch`` hop
+        and the winning replica's, all on ONE trace that the winner's
+        retire thread closes — so the exemplar's breakdown charges the
+        backoff wait by name."""
+        from photon_tpu.telemetry import trace
+        _, _, fleet, reqs, clean, fixed_only = rig
+        primary = fleet.replica_for(reqs[0])
+        rep = fleet.replicas[primary]
+        real_dispatch = rep.dispatch
+        calls = {"n": 0}
+
+        def dying_dispatch(req, timeout):
+            calls["n"] += 1
+            raise OSError("replica died mid-flight")
+
+        rep.dispatch = dying_dispatch
+        try:
+            with trace.tracing(k=2) as res:
+                got = fleet.score(reqs[0], timeout=30)
+                slow = res.slowest()
+        finally:
+            rep.dispatch = real_dispatch
+        assert calls["n"] == 1  # failover went to the OTHER replica
+        assert got == clean[0] or got == fixed_only[0]
+        assert slow is not None and res.n_offered == 1
+        names = [h["name"] for h in slow["hops"]]
+        assert names[:4] == ["fleet_route", "replica_dispatch",
+                             "failover_backoff", "replica_dispatch"]
+        assert names[-1] == "retire_wait"  # the retire thread closed it
+        # the backoff sleep (>=1ms under FAST) accrues to its own hop
+        assert slow["breakdown_ms"]["failover_backoff"] >= 0.9
+
+    def test_injected_retry_errors_keep_one_trace(self, rig):
+        """The fault plan's injected replica_dispatch errors raise
+        BEFORE the attempt runs, so the retry sleeps accrue on the
+        still-open ``fleet_route`` hop and the single winning attempt
+        carries the full dispatcher hop chain — one exemplar, no
+        phantom attempts."""
+        from photon_tpu.telemetry import trace
+        _, _, fleet, reqs, clean, fixed_only = rig
+        with trace.tracing(k=2) as res:
+            with checkpoint.fault_plan(
+                    checkpoint.FaultPlan(errors={"replica_dispatch": 2})):
+                got = fleet.score(reqs[0], timeout=30)
+            slow = res.slowest()
+        assert got == clean[0] or got == fixed_only[0]
+        assert slow is not None and res.n_offered == 1
+        names = [h["name"] for h in slow["hops"]]
+        assert names == ["fleet_route", "replica_dispatch", "queue_wait",
+                         "device_flush", "retire_wait"]
+        # two backoffs (1ms + 2ms) landed on the route hop
+        assert slow["breakdown_ms"]["fleet_route"] >= 2.5
+
+    def test_clean_fleet_trace_has_no_failover_hops(self, rig):
+        from photon_tpu.telemetry import trace
+        _, _, fleet, reqs, clean, fixed_only = rig
+        with trace.tracing(k=1) as res:
+            got = fleet.score(reqs[1], timeout=30)
+            slow = res.slowest()
+        assert got == clean[1] or got == fixed_only[1]
+        assert "failover_backoff" not in slow["breakdown_ms"]
+
     def test_exhausted_failover_reraises(self, rig):
         """More consecutive kills than the retry budget: the final
         failure surfaces (bounded retry, never an infinite loop) and the

@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -21,6 +22,12 @@ import pytest
 import jax
 
 from photon_tpu import telemetry
+from photon_tpu.telemetry import trace
+from photon_tpu.telemetry.aggregate import aggregate_cluster, rank_files
+from photon_tpu.telemetry.health import (CRITICAL, DEGRADED, OK,
+                                         HealthMonitor, QuantileDigest,
+                                         WatchRule, report_from_jsonl,
+                                         snapshot)
 from photon_tpu.data.dataset import chunk_batch, make_batch
 from photon_tpu.models.training import train_glm
 from photon_tpu.ops.losses import TaskType
@@ -606,3 +613,315 @@ class TestServingStream:
             assert short in doc, (
                 f"{name} is not listed in telemetry/__init__'s docstring "
                 "— the single source of truth for counter names")
+
+
+# --------------------------------------- round 19: request tracing
+class TestRequestTracing:
+    def test_disarmed_is_free(self):
+        """The off state: begin returns None, every other entry point is
+        None-safe, no reservoir exists."""
+        assert not trace.armed()
+        assert trace.begin("queue_wait") is None
+        trace.hop(None, "device_flush")
+        trace.finish(None)
+        with trace.attach(None):
+            assert trace.current() is None
+        assert trace.reservoir() is None
+
+    def test_slow_hop_is_named_and_breakdown_sums(self):
+        """The acceptance pin's trace-level half: a deterministically
+        slow hop must be NAMED by the slowest exemplar, and the hop
+        breakdown must sum to the trace total (switch closes the previous
+        hop — no gap, no double count)."""
+        with trace.tracing(k=4) as res:
+            tc = trace.begin("queue_wait")
+            trace.hop(tc, "device_flush")
+            time.sleep(0.03)  # the injected slow hop
+            trace.hop(tc, "retire_wait")
+            trace.finish(tc)
+        ex = res.slowest()
+        assert ex["slowest_hop"] == "device_flush"
+        assert [h["name"] for h in ex["hops"]] == \
+            ["queue_wait", "device_flush", "retire_wait"]
+        assert sum(ex["breakdown_ms"].values()) == \
+            pytest.approx(ex["total_ms"], abs=5.0)
+        assert ex["breakdown_ms"]["device_flush"] >= 25.0
+
+    def test_reservoir_keeps_k_slowest(self):
+        res = trace.ExemplarReservoir(k=3)
+        for i in range(10):
+            tc = trace.TraceContext()
+            tc.switch("h")
+            tc.finish()
+            tc.start_ns = 0  # pin a deterministic total
+            tc.end_ns = (i + 1) * 1_000_000
+            res.offer(tc)
+        assert res.n_offered == 10
+        assert [e["total_ms"] for e in res.snapshot()] == [10.0, 9.0, 8.0]
+
+    def test_finish_is_one_shot(self):
+        """A timed-out failover attempt's late retire must not deposit a
+        second exemplar or reopen the hop list."""
+        with trace.tracing(k=8) as res:
+            tc = trace.begin("queue_wait")
+            trace.finish(tc)
+            trace.finish(tc)  # the straggler thread's late finish
+            n_hops = len(tc.hops)
+            tc.switch("late_hop")  # mutation after finish: no-op
+            assert len(tc.hops) == n_hops
+        assert res.n_offered == 1
+
+    def test_contextvar_propagation(self):
+        """attach() binds the fleet's trace as the thread's current one;
+        begin() inside the block CONTINUES it (how one trace crosses
+        fleet → dispatcher.submit), and a fresh one starts outside."""
+        with trace.tracing(k=2):
+            tc = trace.begin("fleet_route")
+            with trace.attach(tc):
+                assert trace.current() is tc
+                assert trace.begin("queue_wait") is tc
+            assert trace.current() is None
+            assert trace.begin("queue_wait") is not tc
+
+    def test_tracing_restores_surrounding_state(self):
+        outer = trace.arm_tracing()
+        try:
+            with trace.tracing(k=2) as inner:
+                assert trace.reservoir() is inner
+            assert trace.reservoir() is outer and trace.armed()
+        finally:
+            trace.disarm_tracing()
+
+    def test_trace_disabled_scopes_an_armed_session(self):
+        with trace.tracing(k=2):
+            with trace.trace_disabled():
+                assert trace.begin("queue_wait") is None
+            assert trace.begin("queue_wait") is not None
+
+
+# --------------------------------------- round 19: quantile digest
+class TestQuantileDigest:
+    def test_quantiles_within_1pct_of_exact_on_1e5(self):
+        """The dispatcher-regression satellite pin: digest p50/p95/p99 vs
+        exact on a 1e5-sample synthetic latency distribution, relative
+        error <= 1% (the default 0.5% bucketing leaves headroom)."""
+        rng = np.random.default_rng(7)
+        lat_ns = rng.lognormal(mean=15.0, sigma=1.0, size=100_000)
+        d = QuantileDigest()
+        d.add_many(lat_ns)
+        for q in (0.50, 0.95, 0.99):
+            exact = float(np.quantile(lat_ns, q))
+            got = d.quantile(q)
+            assert abs(got - exact) / exact <= 0.01, q
+
+    def test_merge_is_exact(self):
+        """Same bucketing -> merged counts are bit-identical to a single
+        digest over the concatenation (how ReplicaFleet pools replicas)."""
+        rng = np.random.default_rng(11)
+        a = rng.lognormal(14.0, 1.0, 5_000)
+        b = rng.lognormal(16.0, 0.5, 5_000)
+        d1, d2, dall = QuantileDigest(), QuantileDigest(), QuantileDigest()
+        d1.add_many(a)
+        d2.add_many(b)
+        d1.merge(d2)
+        dall.add_many(np.concatenate([a, b]))
+        assert np.array_equal(d1.counts, dall.counts)
+        assert d1.n == dall.n
+        assert d1.quantile(0.99) == dall.quantile(0.99)
+
+    def test_merge_refuses_different_bucketing(self):
+        with pytest.raises(ValueError, match="bucketing"):
+            QuantileDigest().merge(QuantileDigest(rel_error=0.01))
+
+    def test_memory_is_fixed(self):
+        """O(1) memory forever — the reason the dispatcher's append-only
+        latency list is gone."""
+        d = QuantileDigest()
+        n_buckets = d.counts.size
+        assert n_buckets < 3_000  # ~16 KB of int64
+        d.add_many(np.random.default_rng(0).lognormal(15, 1, 50_000))
+        assert d.counts.size == n_buckets
+
+    def test_stats_ms_shape(self):
+        d = QuantileDigest()
+        assert d.stats_ms() == {"n": 0, "p50_ms": None, "p95_ms": None,
+                                "p99_ms": None, "mean_ms": None}
+        d.add(2_000_000.0)  # 2 ms in ns
+        s = d.stats_ms()
+        assert s["n"] == 1
+        assert s["p50_ms"] == pytest.approx(2.0, rel=0.02)
+        assert s["mean_ms"] == pytest.approx(2.0, rel=1e-6)
+
+
+# --------------------------------------- round 19: health plane
+class TestHealthPlane:
+    def test_watch_rule_thresholds_are_inclusive(self):
+        r = WatchRule("shed", "s", 0.05, 0.25, kind="ratio",
+                      denominator="a")
+        assert r.evaluate({"s": 0, "a": 100})["verdict"] == OK
+        assert r.evaluate({"s": 5, "a": 100})["verdict"] == DEGRADED
+        assert r.evaluate({"s": 25, "a": 100})["verdict"] == CRITICAL
+        d = WatchRule("deaths", "d", 1, 4, kind="delta")
+        assert d.evaluate({})["verdict"] == OK
+        assert d.evaluate({"d": 1})["verdict"] == DEGRADED
+        assert d.evaluate({"d": 4})["verdict"] == CRITICAL
+
+    def test_monitor_windows_diff_counters(self):
+        """Each snapshot's rules see ONLY the inter-snapshot delta: a
+        healthy first window then a shed storm flips OK -> CRITICAL."""
+        run = telemetry.start_run("health_mon")
+        try:
+            mon = HealthMonitor()
+            telemetry.count("serving.admitted", 100)
+            rep1 = mon.snapshot(run)
+            assert rep1.verdict == OK
+            telemetry.count("serving.admitted", 100)
+            telemetry.count("serving.shed", 60)
+            rep2 = mon.snapshot(run)
+            shed = next(r for r in rep2.rules if r["rule"] == "shed_rate")
+            assert shed["value"] == pytest.approx(0.6)
+            assert rep2.verdict == CRITICAL
+        finally:
+            telemetry.finish_run()
+
+    def test_staleness_rides_the_gauge(self):
+        run = telemetry.start_run("health_stale")
+        try:
+            telemetry.gauge("continual.staleness_s", 12.5)
+            rep = snapshot(run)
+            assert rep.staleness_s == 12.5
+            assert "photon_tpu_serving_staleness_seconds 12.5" in \
+                rep.prometheus()
+        finally:
+            telemetry.finish_run()
+
+    def test_no_run_snapshot_is_ok_and_empty(self):
+        rep = HealthMonitor().snapshot(run=None)
+        assert rep.verdict == OK
+        assert rep.name == "(no run)"
+        assert rep.rates == {} and rep.staleness_s is None
+
+    def test_report_from_jsonl_and_torn_file(self, tmp_path):
+        path = str(tmp_path / "r.jsonl")
+        telemetry.start_run("offline", jsonl_path=path)
+        telemetry.count("serving.admitted", 10)
+        telemetry.gauge("continual.staleness_s", 3.0)
+        telemetry.finish_run()
+        rep = report_from_jsonl(path)
+        assert rep.name == "offline"
+        assert rep.staleness_s == 3.0
+        assert rep.counters["serving.admitted"] == 10
+        prom = rep.prometheus()
+        assert "photon_tpu_serving_admitted_total 10" in prom
+        assert "photon_tpu_health_verdict 0" in prom
+
+        # torn: run_end never landed + a mid-record tear — still a
+        # report, never a crash
+        with open(path) as fh:
+            lines = [ln for ln in fh.read().splitlines()
+                     if '"run_end"' not in ln]
+        torn = str(tmp_path / "torn.jsonl")
+        with open(torn, "w") as fh:
+            fh.write("\n".join(lines) + "\n" + '{"type": "co')
+        rep2 = report_from_jsonl(torn)
+        assert rep2.verdict == OK
+        assert rep2.counters == {} and rep2.window_s == 0.0
+
+
+# --------------------------------------- round 19: cross-rank aggregation
+class TestCrossRankAggregation:
+    def _write_rank(self, path, name, started_unix, spans, counters,
+                    complete=True):
+        """Hand-crafted rank JSONL (same record shapes run.Run emits) —
+        full control over wall clocks and tears."""
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "run_start", "name": name,
+                                 "started_unix": started_unix}) + "\n")
+            for p, secs, t_s in spans:
+                fh.write(json.dumps({"type": "span", "name": p, "path": p,
+                                     "seconds": secs, "depth": 0,
+                                     "t_s": t_s}) + "\n")
+            if complete:
+                fh.write(json.dumps({"type": "run_end", "duration_s": 5.0,
+                                     "counters": counters,
+                                     "gauges": {}}) + "\n")
+
+    def test_merge_names_straggler_by_min_barrier_wait(self, tmp_path):
+        """Under a barrier the straggler arrives last and waits LEAST —
+        rank 1 here, corroborated by its larger decode load."""
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 100.0,
+                         [("parallel.barrier_wait", 2.0, 3.0)],
+                         {"ingest.chunks": 4})
+        self._write_rank(tmp_path / "p1.jsonl", "r1", 100.0,
+                         [("parallel.barrier_wait", 0.1, 4.9)],
+                         {"ingest.chunks": 8})
+        rep = aggregate_cluster(str(tmp_path))
+        assert rep["complete"]
+        assert rep["n_ranks"] == 2 == rep["n_expected"]
+        assert rep["skew"]["straggler_rank"] == 1
+        assert "rank 1 is the straggler" in rep["skew"]["attribution"]
+        assert rep["counters_total"]["ingest.chunks"] == 12
+        assert rep["skew"]["barrier_wait_s"]["spread"] == \
+            pytest.approx(1.9)
+
+    def test_straggler_falls_back_to_decode_work(self, tmp_path):
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 100.0, [],
+                         {"ingest.chunks": 2})
+        self._write_rank(tmp_path / "p1.jsonl", "r1", 100.0, [],
+                         {"ingest.chunks": 9})
+        rep = aggregate_cluster(str(tmp_path))
+        assert rep["skew"]["straggler_rank"] == 1
+        assert rep["skew"]["decode_chunks"]["spread"] == 7
+
+    def test_torn_mid_record_rank_keeps_prefix(self, tmp_path):
+        """A rank killed mid-write: its torn tail drops, its prefix still
+        contributes, the cluster report is marked incomplete."""
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 100.0,
+                         [("solve", 1.0, 0.5)], {"ingest.chunks": 3})
+        with open(tmp_path / "p1.jsonl", "w") as fh:
+            fh.write(json.dumps({"type": "run_start", "name": "r1",
+                                 "started_unix": 100.2}) + "\n")
+            fh.write(json.dumps({"type": "span", "name": "solve",
+                                 "path": "solve", "seconds": 0.7,
+                                 "depth": 0, "t_s": 0.1}) + "\n")
+            fh.write('{"type": "span", "path": "x", "secon')  # the kill
+        rep = aggregate_cluster(str(tmp_path), expect_ranks=2)
+        assert rep["n_ranks"] == 2
+        assert not rep["complete"]  # rank 1 never wrote run_end
+        assert rep["missing_ranks"] == []
+        assert rep["ranks"]["1"]["complete"] is False
+        assert rep["ranks"]["1"]["span_totals"] == {"solve": 0.7}
+        assert rep["counters_total"] == {"ingest.chunks": 3.0}
+
+    def test_missing_rank_is_named_not_crashed(self, tmp_path):
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 100.0, [], {})
+        self._write_rank(tmp_path / "p2.jsonl", "r2", 100.0, [], {})
+        rep = aggregate_cluster(str(tmp_path))  # n_expected inferred: 3
+        assert rep["n_expected"] == 3
+        assert rep["missing_ranks"] == [1]
+        assert not rep["complete"]
+        rep2 = aggregate_cluster(str(tmp_path), expect_ranks=4)
+        assert rep2["missing_ranks"] == [1, 3]
+
+    def test_clock_skewed_timelines_align_on_wall_clock(self, tmp_path):
+        """Rank 1 started 50 s later: its EARLY span must land after
+        rank 0's late span on the merged wall clock, and the start
+        spread is reported as clock skew."""
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 1000.0,
+                         [("solve", 1.0, 10.0)], {})
+        self._write_rank(tmp_path / "p1.jsonl", "r1", 1050.0,
+                         [("solve", 1.0, 2.0)], {})
+        rep = aggregate_cluster(str(tmp_path))
+        assert rep["clock_skew_s"] == pytest.approx(50.0)
+        tl = rep["timeline"]
+        assert [e["rank"] for e in tl] == [0, 1]
+        assert tl[0]["start_unix"] == pytest.approx(1010.0)
+        assert tl[1]["start_unix"] == pytest.approx(1052.0)
+
+    def test_rank_files_and_dict_source(self, tmp_path):
+        self._write_rank(tmp_path / "p0.jsonl", "r0", 1.0, [], {})
+        (tmp_path / "not_a_rank.jsonl").write_text("{}\n")
+        files = rank_files(str(tmp_path))
+        assert list(files) == [0]
+        rep = aggregate_cluster({0: files[0]})
+        assert rep["n_ranks"] == 1 and rep["complete"]
