@@ -763,6 +763,39 @@ def serving_quantized_ladder(ladder):
     return q
 
 
+# --- kernel-routed quantized serving leg (round 20) -----------------------
+# The SAME closed-loop drive through an int8 ladder with the fused Pallas
+# serving kernel routed in (kernels.scope("on")): one pallas_call per
+# rung — dequant + fixed-effect matvec + per-entity gather-dot fused,
+# quantized hot blocks VMEM-resident across the dispatcher flush. The
+# ladder WARMS kernels-off (the XLA rungs trace and pass the accuracy
+# gate first) and the timed drive runs kernels-on, so run_serving's
+# closing assert_no_retrace spans BOTH modes: flipping the kernel knob
+# provably adds zero new rung signatures — the live twin of the
+# serving_kernel_mode_invariance contract, exactly the round-19 pattern
+# of asserting no-retrace across the tracing-armed drive. p99 gates
+# LOWER-better ("_ms") under the sentinel's same-fingerprint rule — the
+# tail is the whole point of the fusion.
+
+
+def serving_kernel_ladder(ladder):
+    from photon_tpu import kernels, serving
+    from photon_tpu.kernels import serving as pk_serving
+
+    q = serving.ProgramLadder(
+        ladder.store, floor=8, max_batch=SV_MAX_BATCH,
+        sparse_k={"member": SV_SPARSE_K}, output_mean=True,
+        model_tag="model-int8-pk", quantize="int8",
+        quant_epsilon=SVQ_EPSILON)
+    q.warmup()  # kernels-off: XLA rungs trace + pass the gate first
+    with kernels.scope("on"):
+        for b in q.ladder:
+            # every rung must take the fused route — otherwise the leg
+            # would silently time the XLA path twice
+            assert pk_serving.fused_feasible(*q.example_args(b)), b
+    return q
+
+
 # --- open-loop SLO leg (overload round) -----------------------------------
 # serving_qps is CLOSED-loop: clients wait for answers, so offered load
 # can never exceed capacity and overload is unobservable by construction.
@@ -1374,6 +1407,12 @@ def main() -> None:
     with telemetry.span("leg.serving_quantized"):
         svq_ladder = serving_quantized_ladder(sv_ladder)
         svq_stats = run_serving(svq_ladder, sv_pool)
+    with telemetry.span("leg.serving_quantized_kernels"):
+        from photon_tpu import kernels as pk
+
+        svk_ladder = serving_kernel_ladder(sv_ladder)
+        with pk.scope("on"):
+            svk_stats = run_serving(svk_ladder, sv_pool)
     with telemetry.span("leg.serving_slo"):
         slo_stats = run_serving_slo(sv_ladder, sv_pool,
                                     capacity_qps=serving_stats["qps"])
@@ -1516,6 +1555,14 @@ def main() -> None:
             "serving_quantized_p99_ms": round(svq_stats["p99_ms"], 3),
             "serving_quantized_margin_maxdiff":
                 round(svq_ladder.quant_report["max_abs_diff"], 6),
+            # kernel-routed quantized rung (round 20): the same mix with
+            # the fused int8 Pallas serving kernel behind every rung (the
+            # leg's warm half runs kernels-off, so its no-retrace
+            # assertion spans the mode flip); p99 gates lower-better —
+            # the tail is what the fusion buys
+            "serving_quantized_kernels_qps": round(svk_stats["qps"], 1),
+            "serving_quantized_kernels_p99_ms":
+                round(svk_stats["p99_ms"], 3),
             # open-loop SLO regime (overload round): fixed arrival rates
             # with the admission policy armed. sustained_qps/p99 gate as
             # usual; overload_shed_pct gates LOWER-better ("shed" in the

@@ -1393,32 +1393,39 @@ def _bell_tail(X, w):
     return parts
 
 
-def _use_kernel(X, vec) -> bool:
-    """The backend-dispatch seam (photon_tpu/kernels): True when the
-    Pallas kernels own this X pass — knob active (PHOTON_TPU_KERNELS /
-    OptimizerConfig.kernels), a plain BlockedEllRows with a tail (the
-    sharded global views keep XLA; inside shard_map `local()` is a plain
-    BlockedEllRows, so the mesh hot loop still routes here), and the
-    fused form fits the VMEM budget. The XLA path below stays the
-    always-available — and bitwise-identical — fallback."""
+def _kernel_route(X, vec):
+    """The backend-dispatch seam (photon_tpu/kernels), now a LADDER:
+    ``"fused"`` when the knob is active (PHOTON_TPU_KERNELS /
+    OptimizerConfig.kernels), X is a plain BlockedEllRows with a tail
+    (the sharded global views keep XLA; inside shard_map `local()` is a
+    plain BlockedEllRows, so the mesh hot loop still routes here), and
+    the single-fused form fits the VMEM budget; ``"tiled"`` past the
+    budget while the grid-tiled form still fits; ``None`` → the XLA
+    path below, the always-available — and bitwise-identical —
+    fallback."""
     if not isinstance(X, BlockedEllRows):
-        return False
+        return None
     from photon_tpu import kernels
 
-    return kernels.active() and kernels.kernel_feasible(X, vec)
+    return kernels.route(X, vec)
 
 
 def _bell_matvec(X: BlockedEllRows, w):
     """w: (d,) or (d, G) PERMUTED. Hot block against the contiguous prefix
     slice, blocked-ELL tail — gathers and dense contractions only. The
-    tail term routes through the fused Pallas kernel when the kernels
-    seam is active (`photon_tpu.kernels.tail_matvec`; bitwise-equal)."""
+    tail term routes through the Pallas kernels when the kernels seam is
+    active (`photon_tpu.kernels.tail_matvec`, grid-tiled past the VMEM
+    budget; both bitwise-equal)."""
     hot = jnp.matmul(X.dense, w[:X.d_sel].astype(X.dense.dtype),
                      preferred_element_type=jnp.float32)
-    if X.ell_vals and _use_kernel(X, w):
-        from photon_tpu import kernels
+    if X.ell_vals:
+        rt = _kernel_route(X, w)
+        if rt is not None:
+            from photon_tpu import kernels
 
-        return hot + kernels.tail_matvec(X, w)
+            tail = (kernels.tail_matvec(X, w) if rt == "fused"
+                    else kernels.tail_matvec_tiled(X, w))
+            return hot + tail
     lanes = w.ndim == 2
     zero = jnp.zeros((1, w.shape[1]) if lanes else (1,), jnp.float32)
     cat = jnp.concatenate(_bell_tail(X, w) + [zero], axis=0)
@@ -1428,18 +1435,21 @@ def _bell_matvec(X: BlockedEllRows, w):
 def _bell_rmatvec(X: BlockedEllRows, r, square: bool = False):
     """Xᵀr (or (X∘X)ᵀr): hot matmul + per-occurrence-bucket pre-sorted
     gather/reduce, assembled by concatenation — no scatter. r: (n,) or
-    (n, G). The bucket block routes through the fused Pallas kernel when
-    the kernels seam is active (`photon_tpu.kernels.bucket_rmatvec`;
-    bitwise-equal)."""
+    (n, G). The bucket block routes through the Pallas kernels when the
+    kernels seam is active (`photon_tpu.kernels.bucket_rmatvec`,
+    grid-tiled past the VMEM budget; both bitwise-equal)."""
     f32 = jnp.float32
     lanes = r.ndim == 2
     dense = X.dense * X.dense if square else X.dense
     parts = [jnp.matmul(dense.T, r.astype(X.dense.dtype),
                         preferred_element_type=f32)]
-    if X.bucket_vals and _use_kernel(X, r):
+    rt = _kernel_route(X, r) if X.bucket_vals else None
+    if rt is not None:
         from photon_tpu import kernels
 
-        parts.append(kernels.bucket_rmatvec(X, r, square=square))
+        parts.append(kernels.bucket_rmatvec(X, r, square=square)
+                     if rt == "fused"
+                     else kernels.bucket_rmatvec_tiled(X, r, square=square))
         pad = X.n_features - X.n_prefix
         if pad:
             parts.append(jnp.zeros(

@@ -56,18 +56,23 @@ from photon_tpu.utils import env as env_knobs
 
 from photon_tpu.kernels.blocked_ell import (  # noqa: F401
     bucket_rmatvec,
+    bucket_rmatvec_tiled,
     kernel_feasible,
     tail_matvec,
+    tail_matvec_tiled,
+    tiled_feasible,
 )
 
 __all__ = [
-    "ENV_KNOB", "ENV_VMEM", "KERNEL_SIGNATURES", "mode", "active",
-    "interpret", "vmem_budget", "scope", "tail_matvec", "bucket_rmatvec",
-    "kernel_feasible",
+    "ENV_KNOB", "ENV_VMEM", "ENV_TILE", "KERNEL_SIGNATURES", "mode",
+    "active", "interpret", "vmem_budget", "tile_override", "scope",
+    "route", "tail_matvec", "bucket_rmatvec", "tail_matvec_tiled",
+    "bucket_rmatvec_tiled", "kernel_feasible", "tiled_feasible",
 ]
 
 ENV_KNOB = "PHOTON_TPU_KERNELS"
 ENV_VMEM = "PHOTON_TPU_KERNELS_VMEM"
+ENV_TILE = "PHOTON_TPU_KERNELS_TILE"
 _MODES = ("on", "off", "auto")
 
 # Dispatch-signature registry: the seam records every kernel dispatch's
@@ -123,13 +128,64 @@ def active() -> bool:
 
 def vmem_budget() -> int | None:
     """Per-call VMEM byte budget for the single-fused-kernel form; a
-    layout whose operands exceed it falls back to the XLA path. Off-TPU
-    (interpret mode) there is no VMEM, so the budget is unbounded unless
-    ``PHOTON_TPU_KERNELS_VMEM`` pins one."""
+    layout whose operands exceed it routes to the grid-tiled forms (see
+    `route`). Off-TPU (interpret mode) there is no VMEM, so the budget
+    is unbounded unless ``PHOTON_TPU_KERNELS_VMEM`` pins one.
+
+    A malformed knob raises ``ValueError`` naming it HERE, at the knob
+    seam — not a bare ``int()`` parse error surfacing from the first
+    kernel dispatch deep inside a jitted X pass."""
     raw = env_knobs.get_raw(ENV_VMEM)
     if raw is not None:
-        return int(raw)
+        try:
+            budget = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VMEM} must be an integer byte budget, got "
+                f"{raw!r}") from None
+        if budget < 0:
+            raise ValueError(
+                f"{ENV_VMEM} must be >= 0 bytes, got {budget}")
+        return budget
     return None if interpret() else 12 << 20
+
+
+def tile_override() -> int | None:
+    """The ``PHOTON_TPU_KERNELS_TILE`` row-tile override for the
+    grid-tiled kernel forms (None = defer to the autotuner's cached
+    winner). Validated here: a positive pow2 multiple of 8 — the f32
+    sublane quantum — or a ValueError naming the knob."""
+    raw = env_knobs.get_raw(ENV_TILE)
+    if raw is None:
+        return None
+    try:
+        tile = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_TILE} must be an integer row tile, got {raw!r}"
+        ) from None
+    if tile < 8 or tile & (tile - 1):
+        raise ValueError(
+            f"{ENV_TILE} must be a pow2 >= 8 (sublane-aligned row "
+            f"tile), got {tile}")
+    return tile
+
+
+def route(X, vec) -> str | None:
+    """The dispatch ladder of the blocked-ELL seam, as ONE trace-time
+    verdict: ``"fused"`` (single grid-free kernel, every operand
+    VMEM-resident), ``"tiled"`` (grid-tiled form — the layout exceeds
+    `vmem_budget` but a per-bucket row tile plus the resident vector
+    still fits), or ``None`` (XLA path: seam inactive, no tail, or even
+    one tile would not fit). Mode flips clear jit caches (`scope`), so
+    the verdict is a safe trace-time branch."""
+    if not active():
+        return None
+    if kernel_feasible(X, vec):
+        return "fused"
+    if tiled_feasible(X, vec):
+        return "tiled"
+    return None
 
 
 @contextlib.contextmanager

@@ -13,14 +13,16 @@ Runs the Pallas-kernel dispatch seam end to end on the CPU backend
   kernels on equals the kernels-off solve bit for bit (the chunk
   programs dispatch the kernels inside jit).
 - ``dispatch``   — the seam is signature-invariant across mode flips and
-  steps aside (XLA fallback) on no-tail layouts and past the VMEM
-  budget, never erroring.
+  walks the fused → grid-tiled → XLA route ladder: no-tail layouts and
+  sub-tile budgets fall to XLA, past the fused budget the grid-tiled
+  rung serves (bitwise), never erroring.
 - ``ring``       — the donated DeviceChunkRing rotates across passes
   with ONE chunk-program signature and yields chunks in order.
-- ``contracts``  — the four roofline-closure ContractSpecs
+- ``contracts``  — the roofline-closure ContractSpecs
   (`blocked_ell_kernel_x_passes`, `blocked_ell_kernel_no_retrace`,
-  `mesh_stream_donated_no_retrace`, `serving_quantized_rung_invariance`)
-  trace clean.
+  `blocked_ell_tiled_x_passes`, `serving_kernel_fused_rung`,
+  `serving_kernel_mode_invariance`, `mesh_stream_donated_no_retrace`,
+  `serving_quantized_rung_invariance`) trace clean.
 
 Exit status: 0 iff every check passed.
 """
@@ -99,7 +101,7 @@ def run_selftest() -> dict:
     check("streamed_bitwise", (w_off == w_on).all(),
           max_abs_diff=float(np.max(np.abs(w_off - w_on))))
 
-    # ---- dispatch: fallback + signature invariance
+    # ---- dispatch: the route ladder (fused → tiled → XLA) + invariance
     X = M._contract_blocked_ell(bf16=False)
     nO, dO = X.shape
     wv = jnp.zeros((dO,), jnp.float32)
@@ -107,20 +109,43 @@ def run_selftest() -> dict:
         M.SparseRows(np.zeros((8, 2), np.int32),
                      np.zeros((8, 2), np.float32), 16), 16)
     with K.scope("on"):
-        fallback_ok = not M._use_kernel(no_tail, wv[:16])
+        fallback_ok = M._kernel_route(no_tail, wv[:16]) is None
         os.environ[K.ENV_VMEM] = "1"
         try:
-            budget_ok = not M._use_kernel(X, wv)
+            # one byte: even one tile cannot fit — XLA serves
+            floor_ok = M._kernel_route(X, wv) is None
         finally:
             del os.environ[K.ENV_VMEM]
-        active_ok = M._use_kernel(X, wv)
+        active_ok = M._kernel_route(X, wv) == "fused"
+    # past the fused budget but above the tiled floor: the ladder's
+    # middle rung engages (and stays bitwise) instead of falling to XLA
+    from photon_tpu.kernels import blocked_ell as BE
+
+    total = BE._nbytes(wv) + BE._nbytes(X.row_pos)
+    for t in (X.ell_pcols, X.ell_vals, X.bucket_rows, X.bucket_vals):
+        total += sum(BE._nbytes(b) for b in t)
+    wr = jnp.asarray(rng.normal(size=dO).astype(np.float32))
+    rr = jnp.asarray(rng.normal(size=nO).astype(np.float32))
+    with K.scope("off"):
+        ref_mv = np.asarray(M.matvec(X, wr))
+        ref_rm = np.asarray(M.rmatvec(X, rr))
+    os.environ[K.ENV_VMEM] = str(total - 1)
+    try:
+        with K.scope("on"):
+            tiled_ok = M._kernel_route(X, wv) == "tiled"
+            tiled_bitwise = (
+                (np.asarray(M.matvec(X, wr)) == ref_mv).all()
+                and (np.asarray(M.rmatvec(X, rr)) == ref_rm).all())
+    finally:
+        del os.environ[K.ENV_VMEM]
     from photon_tpu.analysis.rules import TraceSignatureLog
 
     log = TraceSignatureLog()
     for m in ("off", "on"):
         with K.scope(m):
             log.record("seam", (X, wv))
-    check("dispatch_seam", fallback_ok and budget_ok and active_ok
+    check("dispatch_seam", fallback_ok and floor_ok and active_ok
+          and tiled_ok and bool(tiled_bitwise)
           and len(log.signatures("seam")) == 1 and not log.hazards())
 
     # ---- ring: rotation order + one signature across passes
@@ -147,6 +172,9 @@ def run_selftest() -> dict:
     bad = {}
     for name in ("blocked_ell_kernel_x_passes",
                  "blocked_ell_kernel_no_retrace",
+                 "blocked_ell_tiled_x_passes",
+                 "serving_kernel_fused_rung",
+                 "serving_kernel_mode_invariance",
                  "mesh_stream_donated_no_retrace",
                  "serving_quantized_rung_invariance"):
         violations = check_contract(reg[name])

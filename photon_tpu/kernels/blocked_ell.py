@@ -25,14 +25,29 @@ already one `jnp.matmul` — nothing to fuse), as do the zero suffix and
 the final `hot + tail` add, so kernel-vs-XLA parity reduces to the
 bucket arithmetic these kernels own.
 
-Single-fused-kernel form: each call is one `pallas_call` with every
-operand VMEM-resident (grid-free). The dispatch seam enforces the VMEM
-budget (`kernels.vmem_budget`) and falls back to XLA above it — the
-grid-tiled production form (row-tiled reassembly over a persistent
-VMEM bucket scratch) is the measured-on-TPU follow-up recorded in
-docs/PERF.md round 15; interpret-mode parity and the contracts below
-hold for any future tiling because the per-bucket arithmetic is pinned
-primitive-for-primitive.
+Two VMEM regimes, one dispatch ladder (`kernels.route`):
+
+- Single-fused-kernel form (`tail_matvec` / `bucket_rmatvec`): one
+  grid-free `pallas_call` with EVERY operand VMEM-resident — the fastest
+  form while the whole layout fits `kernels.vmem_budget`.
+- Grid-tiled form (`tail_matvec_tiled` / `bucket_rmatvec_tiled`, round
+  20): past the budget, each width/occurrence bucket becomes its own
+  `pallas_call` with a `grid` over row tiles — only the coefficient tail
+  slice (matvec) or the cotangent (rmatvec) stays whole-array
+  VMEM-resident (its BlockSpec index_map pins block 0 for every grid
+  step), while the bucket's index/value arrays stream through in
+  (T, W_b) tiles. Billion-row ladders stay on the kernel path instead
+  of falling off to XLA exactly when the layouts get big. Row tiles come
+  from `tuning.tile_tuner` (autotuned per backend, cached beside the
+  AOT executables; `PHOTON_TPU_KERNELS_TILE` overrides), clamped so the
+  resident slice plus one tile still fits the budget. Per-row
+  reductions are row-independent, so tiling the row axis cannot move
+  the reduction order — the tiled forms stay BITWISE equal to the XLA
+  path (tests/test_kernels.py pins both forms on the full bucket
+  matrix, including a bucket smaller than one tile).
+
+The XLA path remains the always-available fallback below both forms
+(`route` returns None when even one tile would not fit).
 """
 from __future__ import annotations
 
@@ -42,7 +57,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["tail_matvec", "bucket_rmatvec", "kernel_feasible"]
+__all__ = ["tail_matvec", "bucket_rmatvec", "tail_matvec_tiled",
+           "bucket_rmatvec_tiled", "kernel_feasible", "tiled_feasible"]
+
+_MIN_TILE = 8  # the f32 sublane quantum: no row tile below this
 
 
 def _nbytes(a) -> int:
@@ -65,6 +83,67 @@ def kernel_feasible(X, w_or_r) -> bool:
         total += sum(_nbytes(b) for b in t)
     total += _nbytes(X.row_pos)
     return total <= budget
+
+
+def _resident_nbytes(X, v) -> int:
+    """Bytes of the slice of ``v`` a grid-tiled kernel keeps whole-array
+    VMEM-resident: the full cotangent for an rmatvec (``v`` has row
+    length n), only the ``[d_sel:n_prefix]`` tail slice for a matvec
+    (``v`` has row length d)."""
+    n = int(X.shape[0])
+    rows = int(v.shape[0])
+    if rows != n:  # coefficient vector: only the tail slice rides along
+        rows = int(X.n_prefix - X.d_sel)
+    per_row = _nbytes(v) // max(int(v.shape[0]), 1)
+    return rows * per_row
+
+
+def tiled_feasible(X, w_or_r) -> bool:
+    """Whether the grid-tiled form fits the VMEM budget: the resident
+    vector slice plus one minimum (``_MIN_TILE``-row) tile of the widest
+    bucket's index/value pair. Row tiles shrink toward ``_MIN_TILE`` to
+    fit (`_clamp_tile`), so this is the true floor — below it even the
+    tiled form steps aside and the XLA path serves."""
+    from photon_tpu import kernels as K
+
+    if not getattr(X, "ell_vals", ()) and not getattr(X, "bucket_vals", ()):
+        return False
+    budget = K.vmem_budget()
+    if budget is None:
+        return True
+    worst = 0
+    for t in (X.ell_pcols, X.ell_vals, X.bucket_rows, X.bucket_vals):
+        for b in t:
+            width = int(np.prod(b.shape[1:], dtype=np.int64))
+            worst = max(worst,
+                        _MIN_TILE * width * np.dtype(b.dtype).itemsize)
+    # one tile's index + value blocks ride together (2x the worst one is
+    # a conservative bound: indices are int32, values <= 4 B/elem)
+    return _resident_nbytes(X, w_or_r) + 2 * worst <= budget
+
+
+def _clamp_tile(tile: int, row_bytes: int, budget_left) -> int:
+    """Halve the autotuned row tile until one (tile x width) index+value
+    block pair fits what the budget leaves after the resident slice."""
+    tile = max(int(tile), _MIN_TILE)
+    if budget_left is None:
+        return tile
+    while tile > _MIN_TILE and tile * row_bytes > budget_left:
+        tile //= 2
+    return tile
+
+
+def _resolve_tile(kind: str, width: int, row_bytes: int, budget_left) -> int:
+    """The row tile for one bucket: ``PHOTON_TPU_KERNELS_TILE`` override,
+    else the autotuner's cached per-backend winner (default when never
+    tuned), clamped to the VMEM budget."""
+    from photon_tpu import kernels as K
+    from photon_tpu.tuning.tile_tuner import tile_for
+
+    tile = K.tile_override()
+    if tile is None:
+        tile = tile_for(kind, width)
+    return _clamp_tile(tile, row_bytes, budget_left)
 
 
 @functools.lru_cache(maxsize=256)
@@ -126,6 +205,99 @@ def tail_matvec(X, w):
     return call(*args)
 
 
+@functools.lru_cache(maxsize=512)
+def _tiled_tail_call(W: int, T: int, n_tiles: int, lanes: bool,
+                     interp: bool, U: int, G: int):
+    """One width-bucket's grid-tiled `pallas_call`: the tail-coefficient
+    slice ``wt`` (U rows) is whole-array VMEM-resident (index_map pins
+    block 0 every step) while the (R, W) index/value pair streams in
+    (T, W) tiles over ``grid=(n_tiles,)``. Per-row arithmetic is the
+    fused kernel's, verbatim — rows are reduction-independent, so the
+    tiling cannot perturb a single row's bits."""
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+
+    def kernel(wt_ref, pc_ref, pv_ref, out_ref):
+        wt = wt_ref[:]
+        pc = pc_ref[:]
+        pv = pv_ref[:]
+        g = wt[pc]                          # (T, W[, G]) gather
+        if g.dtype != pv.dtype:
+            g = g.astype(pv.dtype)          # the _bell_compute recipe
+        eq = "rw,rwg->rg" if lanes else "rw,rw->r"
+        out_ref[:] = jnp.einsum(eq, pv, g, preferred_element_type=f32)
+
+    R = n_tiles * T
+    wt_shape = (U, G) if lanes else (U,)
+    wt_zero = (0, 0) if lanes else (0,)
+    out_spec = (pl.BlockSpec((T, G), lambda i: (i, 0)) if lanes
+                else pl.BlockSpec((T,), lambda i: (i,)))
+
+    def call(wt, pc, pv):
+        return pl.pallas_call(
+            kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec(wt_shape, lambda i: wt_zero),
+                pl.BlockSpec((T, W), lambda i: (i, 0)),
+                pl.BlockSpec((T, W), lambda i: (i, 0)),
+            ],
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((R, G) if lanes else (R,), f32),
+            interpret=interp,
+        )(wt, pc, pv)
+
+    return call
+
+
+def tail_matvec_tiled(X, w):
+    """The grid-tiled blocked-ELL tail matvec: bitwise-equal to both the
+    fused form and `data.matrix._bell_matvec`'s tail term, but each
+    width bucket runs as its own row-tiled `pallas_call` so only the
+    tail slice + one tile occupy VMEM at a time. Buckets pad to a tile
+    multiple with zero rows (sliced back off before reassembly — a
+    bucket smaller than one tile simply pads up to one); the concat +
+    ``row_pos`` reassembly stays on the XLA side, exactly the fallback
+    path's ops."""
+    from photon_tpu import kernels as K
+
+    lanes = w.ndim == 2
+    wt = w[X.d_sel:X.n_prefix]
+    row_pos = jnp.asarray(X.row_pos)
+    G = int(w.shape[1]) if lanes else 0
+    U = int(X.n_prefix - X.d_sel)
+    args = (row_pos, wt) + tuple(
+        x for pc, pv in zip(X.ell_pcols, X.ell_vals)
+        for x in (jnp.asarray(pc), jnp.asarray(pv)))
+    K.KERNEL_SIGNATURES.record("kernels.tail_matvec_tiled", args)
+    budget = K.vmem_budget()
+    left = None if budget is None else budget - _resident_nbytes(X, w)
+    interp = K.interpret()
+    parts = []
+    for pc, pv in zip(X.ell_pcols, X.ell_vals):
+        pc, pv = jnp.asarray(pc), jnp.asarray(pv)
+        r_b, W = int(pc.shape[0]), int(pc.shape[1])
+        row_bytes = (W * (4 + np.dtype(pv.dtype).itemsize)
+                     + 4 * max(G, 1))
+        T = _resolve_tile("tail_matvec", W, row_bytes, left)
+        # a bucket smaller than one tile runs at its EXACT shape (one
+        # grid step, no padding): XLA's per-row reduction strategy is a
+        # function of the einsum's total row count, so only the exact
+        # shape reproduces the fallback path's bits for tiny buckets —
+        # at T >= 8 rows the strategy is row-stable and padding is safe
+        T = min(T, r_b)
+        R = -(-r_b // T) * T
+        if R != r_b:
+            pad = ((0, R - r_b), (0, 0))
+            pc, pv = jnp.pad(pc, pad), jnp.pad(pv, pad)
+        call = _tiled_tail_call(W, T, R // T, lanes, interp, U, G)
+        parts.append(call(wt, pc, pv)[:r_b])
+    zero = jnp.zeros((1, G) if lanes else (1,), jnp.float32)
+    cat = jnp.concatenate(parts + [zero], axis=0)
+    return cat[row_pos]
+
+
 @functools.lru_cache(maxsize=256)
 def _rmatvec_call(n_buckets: int, lanes: bool, square: bool, interp: bool,
                   U: int, G: int):
@@ -182,6 +354,93 @@ def bucket_rmatvec(X, r, square: bool = False):
     call = _rmatvec_call(len(X.bucket_vals), lanes, bool(square),
                          K.interpret(), U, G)
     return call(*args)
+
+
+@functools.lru_cache(maxsize=512)
+def _tiled_rmatvec_call(kk: int, T: int, n_tiles: int, lanes: bool,
+                        square: bool, interp: bool, n: int, G: int):
+    """One occurrence-bucket's grid-tiled `pallas_call`: the cotangent
+    ``r`` (n rows) stays whole-array VMEM-resident while the (C, k_b)
+    row/value pair streams in (T, k_b) tiles. Same per-column arithmetic
+    as the fused kernel — column outputs are reduction-independent."""
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+
+    def kernel(r_ref, br_ref, bv_ref, out_ref):
+        r = r_ref[:]
+        br = br_ref[:]
+        bv = bv_ref[:]
+        g = r[br]                           # (T, k_b[, G]) gather
+        if square:
+            v = bv.astype(f32)
+            v, g = v * v, g.astype(f32)
+        else:
+            v = bv
+            if g.dtype != v.dtype:
+                g = g.astype(v.dtype)       # the _bell_compute recipe
+        eq = "ck,ckg->cg" if lanes else "ck,ck->c"
+        out_ref[:] = jnp.einsum(eq, v, g, preferred_element_type=f32)
+
+    C = n_tiles * T
+    r_shape = (n, G) if lanes else (n,)
+    r_zero = (0, 0) if lanes else (0,)
+    out_spec = (pl.BlockSpec((T, G), lambda i: (i, 0)) if lanes
+                else pl.BlockSpec((T,), lambda i: (i,)))
+
+    def call(r, br, bv):
+        return pl.pallas_call(
+            kernel,
+            grid=(n_tiles,),
+            in_specs=[
+                pl.BlockSpec(r_shape, lambda i: r_zero),
+                pl.BlockSpec((T, kk), lambda i: (i, 0)),
+                pl.BlockSpec((T, kk), lambda i: (i, 0)),
+            ],
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((C, G) if lanes else (C,), f32),
+            interpret=interp,
+        )(r, br, bv)
+
+    return call
+
+
+def bucket_rmatvec_tiled(X, r, square: bool = False):
+    """The grid-tiled occurrence-bucket rmatvec: bitwise-equal to the
+    fused form and to the bucket terms of `data.matrix._bell_rmatvec`,
+    with each occurrence bucket as its own column-tiled `pallas_call`
+    (only the cotangent + one tile VMEM-resident at a time). Buckets
+    pad to a tile multiple with zero columns, sliced back off before the
+    XLA-side concat."""
+    from photon_tpu import kernels as K
+
+    lanes = r.ndim == 2
+    r = jnp.asarray(r)
+    n = int(r.shape[0])
+    G = int(r.shape[1]) if lanes else 0
+    args = (r,) + tuple(
+        x for br, bv in zip(X.bucket_rows, X.bucket_vals)
+        for x in (jnp.asarray(br), jnp.asarray(bv)))
+    K.KERNEL_SIGNATURES.record("kernels.bucket_rmatvec_tiled", args)
+    budget = K.vmem_budget()
+    left = None if budget is None else budget - _resident_nbytes(X, r)
+    interp = K.interpret()
+    parts = []
+    for br, bv in zip(X.bucket_rows, X.bucket_vals):
+        br, bv = jnp.asarray(br), jnp.asarray(bv)
+        c_b, kk = int(br.shape[0]), int(br.shape[1])
+        row_bytes = (kk * (4 + np.dtype(bv.dtype).itemsize)
+                     + 4 * max(G, 1))
+        T = _resolve_tile("bucket_rmatvec", kk, row_bytes, left)
+        T = min(T, c_b)  # sub-tile bucket: exact shape (see tail twin)
+        C = -(-c_b // T) * T
+        if C != c_b:
+            pad = ((0, C - c_b), (0, 0))
+            br, bv = jnp.pad(br, pad), jnp.pad(bv, pad)
+        call = _tiled_rmatvec_call(kk, T, C // T, lanes, bool(square),
+                                   interp, n, G)
+        parts.append(call(r, br, bv)[:c_b])
+    return jnp.concatenate(parts, axis=0)
 
 
 # ----------------------------------------------------------------- contracts
@@ -267,3 +526,28 @@ def _contract_kernel_no_retrace():
             return M.matvec(Xb, wv), M.rmatvec(Xb, rv)
 
     return passes, (X, w, r)
+
+
+@register_contract(
+    name="blocked_ell_tiled_x_passes",
+    description="the grid-tiled middle rung (round 20): tail matvec and "
+                "occurrence-bucket rmatvec streamed through VMEM in row "
+                "tiles obey the SAME law as the fused forms — ZERO "
+                "scatters anywhere (reassembly is concatenate + gather "
+                "on the XLA side), every sparse dot/einsum accumulating "
+                "f32 inside the tiled pallas_call bodies",
+    collectives={}, forbid=SCATTER_PRIMITIVES, require_f32_accum=True,
+    tags=("kernels", "sparse", "streamed"))
+def _contract_tiled_x_passes():
+    from photon_tpu import kernels as K
+
+    X = _contract_X(bf16=True)
+    n, d = X.shape
+
+    def both(Xb, w, r):
+        with K.scope("on"):
+            z = tail_matvec_tiled(Xb, w)
+            return z, bucket_rmatvec_tiled(Xb, r)
+
+    return both, (X, jnp.zeros((d,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32))

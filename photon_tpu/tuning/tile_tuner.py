@@ -1,0 +1,193 @@
+"""Row-tile autotuner for the grid-tiled kernel forms (round 20).
+
+The grid-tiled blocked-ELL kernels (`kernels/blocked_ell.py`) stream
+each bucket's index/value pair through VMEM in (T, W_b) row tiles. The
+right T is a BACKEND fact — it trades grid-step overhead against VMEM
+occupancy and pipelining depth, and the winner on this container's CPU
+interpreter is not the winner on a real TPU core — so it is measured,
+not guessed, exactly once per (backend, kernel kind, bucket width):
+
+- `autotune_tiles(X, w, r, cache_dir=...)` runs every candidate tile
+  through the REAL tiled kernels on a representative layout at warmup
+  time, wall-clocks each (best-of-``repeats``, attributed to the
+  profiling ledger under ``kernels.tile/<kind>`` when one is active),
+  and picks the fastest per (kind, width).
+- Winners persist as one JSON file per backend INSIDE the AOT store's
+  cache directory — beside the exported executables they tune, written
+  through `checkpoint.store.commit_bytes` (atomic + durable, the same
+  discipline as the exports themselves). A warm second run — or a fresh
+  process pointed at the same ``cache_dir`` — reloads the file and
+  measures NOTHING (``kernels.tile_cache_hits`` counts the reuse;
+  ``kernels.tile_measures`` counts live measurements, so tests can
+  assert the no-re-measure contract).
+- `tile_for(kind, width)` is the dispatch-time resolver the kernels
+  call at trace time: in-memory memo (seeded from the cache file) else
+  ``DEFAULT_TILE``. It NEVER measures — an untuned process simply runs
+  the default, and ``PHOTON_TPU_KERNELS_TILE`` (validated by
+  `kernels.tile_override`) beats everything for operator pinning.
+
+Measurement happens under ``kernels.scope("on")`` with the candidate
+planted in the memo, so the timed path is byte-for-byte the path the
+winner will serve; candidates are clamped by the kernels' own
+budget-fitting (`_clamp_tile`), so an infeasible candidate is measured
+at the tile it would actually run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["CANDIDATE_TILES", "DEFAULT_TILE", "tile_for", "autotune_tiles",
+           "tile_cache_path", "reset_memo"]
+
+CANDIDATE_TILES = (64, 128, 256, 512)
+DEFAULT_TILE = 256
+_FORMAT = "photon_tpu-kernel-tiles-v1"
+
+# (backend, kind, width) -> winning row tile. Process-local; seeded from
+# the on-disk cache by autotune_tiles, consulted by tile_for at kernel
+# trace time (never written there).
+_MEMO: dict = {}
+
+
+def reset_memo() -> None:
+    """Drop the in-memory winners (tests: simulate a fresh process)."""
+    _MEMO.clear()
+
+
+def tile_for(kind: str, width: int) -> int:
+    """The dispatch-time tile resolver: the autotuned winner for
+    (current backend, kind, width) if one is memoized, else
+    ``DEFAULT_TILE``. Pure lookup — dispatch never measures. (The env
+    override is applied by the caller, `kernels._resolve_tile`, so a
+    pinned tile also bypasses this memo.)"""
+    import jax
+
+    return int(_MEMO.get((jax.default_backend(), kind, int(width)),
+                         DEFAULT_TILE))
+
+
+def tile_cache_path(cache_dir: str) -> str:
+    """Where the winners live: one JSON per backend, beside the AOT
+    store's exported executables in the same ``cache_dir``."""
+    import jax
+
+    return os.path.join(cache_dir,
+                        f"kernel-tiles-{jax.default_backend()}.json")
+
+
+def _load_cache(cache_dir: str) -> dict:
+    path = tile_cache_path(cache_dir)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}  # unreadable cache == cold cache (re-measure, rewrite)
+    if doc.get("format") != _FORMAT:
+        return {}
+    return {str(k): int(v) for k, v in doc.get("tiles", {}).items()}
+
+
+def _persist_cache(cache_dir: str, tiles: dict) -> None:
+    import jax
+
+    from photon_tpu.checkpoint.store import commit_bytes
+
+    doc = {"format": _FORMAT, "backend": jax.default_backend(),
+           "jax": jax.__version__,
+           "tiles": {k: int(v) for k, v in sorted(tiles.items())}}
+    commit_bytes(tile_cache_path(cache_dir),
+                 json.dumps(doc, indent=1).encode())
+
+
+def _measure_candidate(X, w, r, kind: str, width: int, tile: int,
+                       repeats: int) -> float:
+    """Best-of-``repeats`` wall seconds of the tiled kernel with ``tile``
+    planted for (kind, width) — every other bucket keeps its current
+    choice, so candidates differ in exactly one coordinate."""
+    import importlib
+
+    import jax
+
+    from photon_tpu import kernels as K
+
+    # the ledger MODULE: photon_tpu.profiling re-exports a `ledger`
+    # context-manager function that shadows the submodule attribute
+    ledger = importlib.import_module("photon_tpu.profiling.ledger")
+    key = (jax.default_backend(), kind, int(width))
+    prev = _MEMO.get(key)
+    _MEMO[key] = int(tile)
+    try:
+        with K.scope("on"):
+            if kind == "tail_matvec":
+                fn = lambda: K.tail_matvec_tiled(X, w)      # noqa: E731
+            else:
+                fn = lambda: K.bucket_rmatvec_tiled(X, r)   # noqa: E731
+            jax.block_until_ready(fn())  # absorb trace + compile
+            best = float("inf")
+            for _ in range(max(int(repeats), 1)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                dt = time.perf_counter() - t0
+                ledger.attribute(f"kernels.tile/{kind}",
+                                 f"w{width}:T{tile}", dt)
+                best = min(best, dt)
+        return best
+    finally:
+        if prev is None:
+            _MEMO.pop(key, None)
+        else:
+            _MEMO[key] = prev
+
+
+def autotune_tiles(X, w, r, cache_dir: Optional[str] = None,
+                   candidates: tuple = CANDIDATE_TILES,
+                   repeats: int = 2) -> dict:
+    """Measure candidate row tiles for every bucket of ``X``'s tiled
+    forms on the current backend; memoize + persist the winners.
+
+    ``X`` is a representative `BlockedEllRows` layout (the warmup
+    problem — bucket WIDTHS are the tuning key, so any layout sharing
+    the production widths tunes for it); ``w``/``r`` the matvec /
+    rmatvec vectors. With ``cache_dir`` (normally the serving AotStore's
+    directory) a previous run's winners reload and ALREADY-COVERED keys
+    are not re-measured — the warm path is a pure file read. Returns
+    ``{"kind:width": tile}`` for the keys this layout exercises."""
+    import jax
+
+    from photon_tpu import telemetry
+
+    backend = jax.default_backend()
+    keys = []
+    for pv in getattr(X, "ell_vals", ()):
+        keys.append(("tail_matvec", int(pv.shape[1])))
+    for bv in getattr(X, "bucket_vals", ()):
+        keys.append(("bucket_rmatvec", int(bv.shape[1])))
+    keys = list(dict.fromkeys(keys))
+    cached = _load_cache(cache_dir) if cache_dir is not None else {}
+    out: dict = {}
+    measured = False
+    for kind, width in keys:
+        ck = f"{kind}:{width}"
+        if ck in cached:
+            out[ck] = int(cached[ck])
+            telemetry.count("kernels.tile_cache_hits")
+        else:
+            best_dt, best_tile = float("inf"), DEFAULT_TILE
+            for tile in candidates:
+                dt = _measure_candidate(X, w, r, kind, width, tile,
+                                        repeats)
+                telemetry.count("kernels.tile_measures")
+                if dt < best_dt:
+                    best_dt, best_tile = dt, int(tile)
+            out[ck] = best_tile
+            cached[ck] = best_tile
+            measured = True
+        _MEMO[(backend, kind, width)] = out[ck]
+    if cache_dir is not None and measured:
+        _persist_cache(cache_dir, cached)
+    return out

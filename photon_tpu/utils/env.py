@@ -26,9 +26,15 @@ KNOB_DOCS = {
         "(mode(); OptimizerConfig.kernels overrides per solve)."),
     "PHOTON_TPU_KERNELS_VMEM": (
         "Per-call VMEM byte budget for the single-fused-kernel form; a "
-        "layout whose operands exceed it falls back to the XLA path. "
-        "Default 12 MiB on TPU, unbounded in interpret mode. Owner: "
-        "photon_tpu.kernels (vmem_budget())."),
+        "layout whose operands exceed it routes to the grid-tiled forms "
+        "(and past even those, the XLA path). Default 12 MiB on TPU, "
+        "unbounded in interpret mode. Owner: photon_tpu.kernels "
+        "(vmem_budget())."),
+    "PHOTON_TPU_KERNELS_TILE": (
+        "Row-tile override for the grid-tiled kernel forms: a positive "
+        "pow2 multiple of 8 that beats the autotuned/cached per-backend "
+        "choice (tuning/tile_tuner.py). Unset (default) = tuner winner, "
+        "else DEFAULT_TILE. Owner: photon_tpu.kernels (tile_override())."),
     "PHOTON_TPU_PEAK_FLOPS": (
         "Modeled per-chip FLOP/s ceiling for roofline-utilization "
         "denominators (overrides the backend default). Owner: "

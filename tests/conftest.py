@@ -112,6 +112,49 @@ def _release_compiled_programs(request):
     jax.clear_caches()
 
 
+# Tier-1 budget guard (round-20 suite-time relief): the driver runs the
+# tier-1 selection under `timeout -k 10 870`, and a pass that lands
+# within a minute of the cap is one contended box away from a wall-clock
+# kill that reads as a regression. The guard asserts the MEASURED
+# headroom stays >= 60 s whenever the canonical tier-1 selection runs
+# (full tests/ tree, -m 'not slow', no -k filter) — a breach fails the
+# session teardown loudly TODAY, instead of the timeout failing it
+# nondeterministically next round. Partial selections (single modules,
+# -k filters) never trip it.
+TIER1_BUDGET_S = 870.0
+TIER1_MIN_HEADROOM_S = 60.0
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _tier1_budget_guard(request):
+    import time as _time
+
+    t0 = _time.time()
+    yield
+    config = request.config
+    if config.option.markexpr != "not slow" or config.option.keyword:
+        return
+    if getattr(request.session, "testscollected", 0) < 500:
+        return  # partial selection: not the tier-1 wall
+    wall = _time.time() - t0
+    headroom = TIER1_BUDGET_S - wall
+    reporter = config.pluginmanager.get_plugin("terminalreporter")
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if reporter is not None and capman is not None:
+        # fd-level capture is still armed during session-fixture
+        # teardown (the output would silently attach to the last item);
+        # suspend it so the headroom line lands on the real terminal
+        with capman.global_and_fixture_disabled():
+            reporter.write_line(
+                f"tier-1 wall {wall:.0f}s — {headroom:.0f}s headroom "
+                f"against the {TIER1_BUDGET_S:.0f}s budget")
+    assert headroom >= TIER1_MIN_HEADROOM_S, (
+        f"tier-1 suite burned {wall:.0f}s of the {TIER1_BUDGET_S:.0f}s "
+        f"budget — headroom {headroom:.0f}s < {TIER1_MIN_HEADROOM_S:.0f}s "
+        f"floor; promote the slowest acceptance tests to tier2 "
+        f"(see `--durations=25`) before the timeout kills a round")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from photon_tpu.parallel.mesh import make_mesh

@@ -27,7 +27,7 @@ def test_registry_is_broad_enough():
     """≥ 48 specs (round 19 added the request-tracing off-state pin:
     `serving_trace_off_is_free` — zero extra primitives + zero rung
     signature drift armed vs disarmed) spanning every workload family."""
-    assert len(_REGISTRY) >= 48
+    assert len(_REGISTRY) >= 51
     tags = {t for spec in _REGISTRY.values() for t in spec.tags}
     for family in ("resident", "streamed", "mesh-streamed", "lane", "game",
                    "serving", "checkpoint", "profiling", "sparse",

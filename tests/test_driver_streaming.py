@@ -187,6 +187,7 @@ class TestStreamingTrainingDriver:
         # chunk merge), amplified through solver convergence
         np.testing.assert_allclose(wa, wb, rtol=2e-3, atol=1e-4)
 
+    @pytest.mark.tier2
     def test_weight_form_down_sampling_matches_row_form(self, stream_job,
                                                         tmp_path):
         """Streaming down-sampling (weight-0 rows) selects the same rows as

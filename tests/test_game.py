@@ -50,6 +50,7 @@ def _mixed_effect_logistic(rng, n_entities=30, d_fixed=8, d_re=3, rows_lo=5,
     return data, w_fixed, w_re, ent
 
 
+@pytest.mark.tier2
 def test_movielens_style_two_random_effects(rng):
     """BASELINE config 3 shape: fixed effect + per-USER + per-ITEM random
     effects (MovieLens-style), coordinate descent alternating over three
@@ -733,6 +734,7 @@ class TestVectorizedGameGrid:
         norm_big = np.linalg.norm(np.asarray(fast[1].model["per_e"].coefficients))
         assert norm_big < norm_small
 
+    @pytest.mark.tier2
     def test_l1_grid_runs_owlqn_lanes(self, rng):
         """An elastic-net sweep routes the lane solves through OWL-QN and
         matches the sequential path (sparsity included)."""

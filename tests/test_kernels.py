@@ -72,6 +72,17 @@ def _wide_bucket_problem(n=51, d=160, d_dense=8, seed=0, bf16=False):
     return X
 
 
+def _fused_nbytes(X, v):
+    """The fused form's whole operand set in bytes — one byte past this
+    the route ladder's middle (grid-tiled) rung takes over."""
+    from photon_tpu.kernels import blocked_ell as BE
+
+    total = BE._nbytes(v) + BE._nbytes(X.row_pos)
+    for t in (X.ell_pcols, X.ell_vals, X.bucket_rows, X.bucket_vals):
+        total += sum(BE._nbytes(b) for b in t)
+    return total
+
+
 class TestKernelParity:
     @pytest.mark.parametrize("bf16", [False, True])
     def test_full_bucket_matrix_bitwise(self, bf16):
@@ -104,24 +115,36 @@ class TestKernelParity:
         assert X.ell_vals == ()
         w = jnp.ones((16,), jnp.float32)
         with K.scope("on"):
-            assert not M._use_kernel(X, w)
+            assert M._kernel_route(X, w) is None
             out = np.asarray(M.matvec(X, w))
         with K.scope("off"):
             np.testing.assert_array_equal(out, np.asarray(M.matvec(X, w)))
 
     def test_vmem_budget_fallback(self):
-        """Past the VMEM budget the seam steps aside per call — never an
-        error, same bits."""
+        """The route ladder walks down under pressure: past the fused
+        budget the grid-tiled rung serves (same bits), and at one byte —
+        below even one tile — the seam steps aside to XLA entirely.
+        Never an error, never different bits."""
         X = _wide_bucket_problem()
         w = jnp.ones((X.shape[1],), jnp.float32)
+        total = _fused_nbytes(X, w)
         with K.scope("on"):
+            assert M._kernel_route(X, w) == "fused"
             ref = np.asarray(M.matvec(X, w))
-            os.environ[K.ENV_VMEM] = "1"
-            try:
-                assert not M._use_kernel(X, w)
+        os.environ[K.ENV_VMEM] = str(total - 1)
+        try:
+            with K.scope("on"):
+                assert M._kernel_route(X, w) == "tiled"
                 np.testing.assert_array_equal(ref, np.asarray(M.matvec(X, w)))
-            finally:
-                del os.environ[K.ENV_VMEM]
+        finally:
+            del os.environ[K.ENV_VMEM]
+        os.environ[K.ENV_VMEM] = "1"
+        try:
+            with K.scope("on"):
+                assert M._kernel_route(X, w) is None
+                np.testing.assert_array_equal(ref, np.asarray(M.matvec(X, w)))
+        finally:
+            del os.environ[K.ENV_VMEM]
 
     def test_jit_solve_parity_resident(self):
         """A resident blocked-ELL train_glm with kernels on equals the
@@ -389,3 +412,164 @@ class TestStaticCostNarrowing:
                                 np.zeros(16, np.int32),
                                 np.zeros((16, 8), np.float32)))
         assert c3.narrowed_bytes == 16 * 8 * 3
+
+
+class TestTiledForms:
+    """Round 20: the grid-tiled middle rung of the route ladder — bitwise
+    vs the XLA path across tile choices, including a tail bucket SMALLER
+    than one tile (which must run at its exact shape: padding a tiny
+    einsum changes XLA CPU's per-row reduction strategy and the bits)."""
+
+    def _refs(self, X, w, r, W, R):
+        cases = ((M.matvec, w), (M.rmatvec, r), (M.matvec_lanes, W),
+                 (M.rmatvec_lanes, R), (M.sq_rmatvec, r))
+        with K.scope("off"):
+            return cases, [np.asarray(f(X, v)) for f, v in cases]
+
+    @pytest.mark.parametrize("bf16", [False, True])
+    @pytest.mark.parametrize("tile", [None, "8"])
+    def test_tiled_route_full_surface_bitwise(self, monkeypatch, bf16,
+                                              tile):
+        """Every op through the seam with the route pinned to "tiled"
+        (one byte past the fused budget): kernel == XLA bit for bit, at
+        the default tile AND at the minimum tile where sub-tile buckets
+        take the exact-shape path."""
+        X = _wide_bucket_problem(bf16=bf16)
+        # the sub-tile regime is real: some bucket has fewer rows than
+        # even the minimum 8-row tile (it must run at its exact shape)
+        assert min(int(b.shape[0])
+                   for t in (X.ell_vals, X.bucket_rows) for b in t) < 8
+        n, d = X.shape
+        rng = np.random.default_rng(20)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        W = jnp.asarray(rng.normal(size=(d, 3)).astype(np.float32))
+        R = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+        cases, ref = self._refs(X, w, r, W, R)
+        if tile is not None:
+            monkeypatch.setenv(K.ENV_TILE, tile)
+        monkeypatch.setenv(K.ENV_VMEM, str(_fused_nbytes(X, w) - 1))
+        with K.scope("on"):
+            assert M._kernel_route(X, w) == "tiled"
+            got = [np.asarray(f(X, v)) for f, v in cases]
+        for (f, _), a, b in zip(cases, ref, got):
+            np.testing.assert_array_equal(a, b, err_msg=f.__name__)
+
+    def test_tiled_direct_forms_bitwise(self):
+        """The tiled forms called directly equal the fused forms bit for
+        bit — same inputs, same outputs, only the VMEM schedule moves."""
+        X = _wide_bucket_problem()
+        n, d = X.shape
+        rng = np.random.default_rng(21)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        r = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        with K.scope("on"):
+            np.testing.assert_array_equal(
+                np.asarray(K.tail_matvec(X, w)),
+                np.asarray(K.tail_matvec_tiled(X, w)))
+            np.testing.assert_array_equal(
+                np.asarray(K.bucket_rmatvec(X, r)),
+                np.asarray(K.bucket_rmatvec_tiled(X, r)))
+            np.testing.assert_array_equal(
+                np.asarray(K.bucket_rmatvec(X, r, square=True)),
+                np.asarray(K.bucket_rmatvec_tiled(X, r, square=True)))
+
+    def test_vmem_knob_validation(self, monkeypatch):
+        """Satellite 1: a malformed PHOTON_TPU_KERNELS_VMEM raises a
+        ValueError NAMING the knob — not a bare int() parse error from
+        deep inside a jitted X pass."""
+        monkeypatch.setenv(K.ENV_VMEM, "lots")
+        with pytest.raises(ValueError, match="PHOTON_TPU_KERNELS_VMEM"):
+            K.vmem_budget()
+        monkeypatch.setenv(K.ENV_VMEM, "-4096")
+        with pytest.raises(ValueError, match="PHOTON_TPU_KERNELS_VMEM"):
+            K.vmem_budget()
+        monkeypatch.setenv(K.ENV_VMEM, "4096")
+        assert K.vmem_budget() == 4096
+        monkeypatch.delenv(K.ENV_VMEM)
+        assert K.vmem_budget() is None  # interpret mode: unbounded
+
+    def test_tile_knob_validation(self, monkeypatch):
+        for bad in ("wide", "12", "4", "-8", "0"):
+            monkeypatch.setenv(K.ENV_TILE, bad)
+            with pytest.raises(ValueError,
+                               match="PHOTON_TPU_KERNELS_TILE"):
+                K.tile_override()
+        monkeypatch.setenv(K.ENV_TILE, "64")
+        assert K.tile_override() == 64
+        monkeypatch.delenv(K.ENV_TILE)
+        assert K.tile_override() is None
+
+
+class TestTileTuner:
+    """Round 20: the ledger-driven tile autotuner — measures once per
+    (backend, kind, width), persists beside the AOT store, and a warm
+    run reuses the cached winner WITHOUT re-measuring."""
+
+    def _problem(self):
+        X = M._contract_blocked_ell(n=24, d=48, k=3, d_dense=8)
+        n, d = X.shape
+        rng = np.random.default_rng(22)
+        return (X, jnp.asarray(rng.normal(size=d).astype(np.float32)),
+                jnp.asarray(rng.normal(size=n).astype(np.float32)))
+
+    def test_cold_measures_warm_reuses(self, tmp_path):
+        from photon_tpu import telemetry
+        from photon_tpu.tuning import tile_tuner as TT
+
+        X, w, r = self._problem()
+        TT.reset_memo()
+        try:
+            run = telemetry.start_run("tile_tuner_cold")
+            try:
+                cold = TT.autotune_tiles(X, w, r, cache_dir=str(tmp_path),
+                                         candidates=(64, 128), repeats=1)
+                assert cold  # layout exercises at least one key
+                assert run.counters.get("kernels.tile_measures", 0) \
+                    == 2 * len(cold)
+                assert run.counters.get("kernels.tile_cache_hits", 0) == 0
+            finally:
+                telemetry.finish_run()
+            assert os.path.exists(TT.tile_cache_path(str(tmp_path)))
+            TT.reset_memo()  # simulate a fresh process, same cache_dir
+            run = telemetry.start_run("tile_tuner_warm")
+            try:
+                warm = TT.autotune_tiles(X, w, r, cache_dir=str(tmp_path),
+                                         candidates=(64, 128), repeats=1)
+                assert warm == cold  # the cached choice, verbatim
+                assert run.counters.get("kernels.tile_measures", 0) == 0
+                assert run.counters.get("kernels.tile_cache_hits", 0) \
+                    == len(cold)
+            finally:
+                telemetry.finish_run()
+            # the warm winners drive dispatch: tile_for resolves them
+            kind, width = next(iter(warm)).split(":")
+            assert TT.tile_for(kind, int(width)) == warm[f"{kind}:{width}"]
+        finally:
+            TT.reset_memo()
+
+    def test_untuned_process_runs_default(self):
+        from photon_tpu.tuning import tile_tuner as TT
+
+        TT.reset_memo()
+        assert TT.tile_for("tail_matvec", 16) == TT.DEFAULT_TILE
+
+    def test_corrupt_cache_is_cold_cache(self, tmp_path):
+        from photon_tpu.tuning import tile_tuner as TT
+
+        path = TT.tile_cache_path(str(tmp_path))
+        with open(path, "w") as f:
+            f.write("{not json")
+        X, w, r = self._problem()
+        TT.reset_memo()
+        try:
+            out = TT.autotune_tiles(X, w, r, cache_dir=str(tmp_path),
+                                    candidates=(64,), repeats=1)
+            assert out  # re-measured, no crash
+            import json
+
+            with open(path) as f:
+                doc = json.load(f)  # rewritten well-formed
+            assert doc["format"] == TT._FORMAT
+        finally:
+            TT.reset_memo()

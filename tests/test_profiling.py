@@ -139,6 +139,32 @@ class TestSentinel:
             hist)["multihost_e2e_dcn_bytes_per_eval"]
         assert same.status == "ok"
 
+    def test_serving_kernel_legs_admit_correctly(self):
+        """The round-20 serving_quantized_kernels legs as the sentinel
+        sees them: both admit as 'new' beside existing serving history
+        (the same-fingerprint rule still applies — `_history` pairs are
+        env-None series), QPS gates higher-better, and the p99 gates
+        LOWER-better via "_ms" once it has history — the fused kernel's
+        whole claim is the tail."""
+        hist = _history(leg="serving_quantized_p99_ms", base=2.0)
+        verdicts = sentinel.gate(
+            {"serving_quantized_p99_ms": 2.0,
+             "serving_quantized_kernels_qps": 900.0,
+             "serving_quantized_kernels_p99_ms": 1.4}, hist)
+        assert verdicts["serving_quantized_kernels_qps"].status == "new"
+        assert verdicts["serving_quantized_kernels_p99_ms"].status == "new"
+        assert sentinel.lower_is_better("serving_quantized_kernels_p99_ms")
+        assert not sentinel.lower_is_better("serving_quantized_kernels_qps")
+        khist = _history(leg="serving_quantized_kernels_p99_ms", base=1.4)
+        worse = sentinel.gate(
+            {"serving_quantized_kernels_p99_ms": 6.0},
+            khist)["serving_quantized_kernels_p99_ms"]
+        assert worse.status == "regressed"
+        better = sentinel.gate(
+            {"serving_quantized_kernels_p99_ms": 0.7},
+            khist)["serving_quantized_kernels_p99_ms"]
+        assert better.status == "ok"
+
     def test_layout_split_legs_are_excluded(self):
         """hot/tail split + width-bucket counts are layout CONFIG facts —
         a retuned d_dense moves them by design, so they never gate."""

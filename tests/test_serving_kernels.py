@@ -1,0 +1,187 @@
+"""Round 20: the fused int8 serving-rung Pallas kernel.
+
+The serving-side roofline claims, pinned bitwise where the design says
+bitwise:
+
+- One rung's whole quantized score — dequant + fixed-effect matvec +
+  per-entity gather-dot, coordinate order — fused into a single
+  `pallas_call` reproduces the XLA rung BIT FOR BIT in interpret mode,
+  cold-miss row (all-entities-unseen) included.
+- The fallback ladder never errors and never changes bits: past the
+  VMEM budget the rung stays on XLA; mode flips never move a rung's
+  dispatch signature (only its executable).
+- The AOT key carries the kernel route (``:pk``), because a stored
+  export replays WITHOUT tracing — the trace-time verdict must be part
+  of the file identity.
+- A `continual.hot_swap` invalidates the ladder's quantized-block cache
+  (`_qdev`): the next kernel-path dispatch re-quantizes and scores the
+  NEW model through the SAME executables.
+"""
+import copy
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu import kernels as K
+from photon_tpu import serving
+from photon_tpu.data.matrix import SparseRows
+from photon_tpu.ops.losses import TaskType
+
+pytestmark = pytest.mark.release_programs
+
+
+def _ladder(quantize="int8", eps=0.5, E=32, df=12, dr=6, k=3):
+    from photon_tpu.game.model import (FixedEffectModel, GameModel,
+                                       RandomEffectModel)
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+
+    rng = np.random.default_rng(8)
+    task = TaskType.LOGISTIC_REGRESSION
+    keys = np.asarray(sorted(str(i) for i in range(E)))
+    model = GameModel({
+        "fixed": FixedEffectModel(GeneralizedLinearModel(
+            Coefficients(jnp.asarray(
+                rng.normal(size=df).astype(np.float32))), task),
+            "global"),
+        "perMember": RandomEffectModel(
+            entity_name="memberId", feature_shard="member", task=task,
+            coefficients=jnp.asarray(
+                rng.normal(size=(E, dr)).astype(np.float32)),
+            entity_keys=keys,
+            key_to_index={kk: i for i, kk in enumerate(keys.tolist())}),
+    }, task)
+    store = serving.CoefficientStore.from_game_model(model)
+    return serving.ProgramLadder(
+        store, floor=8, max_batch=16, sparse_k={"member": k},
+        quantize=quantize, quant_epsilon=eps), (df, dr, k, E)
+
+
+def _batch(df, dr, k, B=8, seed=30, entity=0):
+    rng = np.random.default_rng(seed)
+    shards = {"global": rng.normal(size=(B, df)).astype(np.float32),
+              "member": SparseRows(
+                  rng.integers(0, dr, size=(B, k)).astype(np.int32),
+                  rng.normal(size=(B, k)).astype(np.float32), dr)}
+    ids = {"perMember": np.full(B, entity, np.int32)}
+    return np.zeros(B, np.float32), shards, ids
+
+
+class TestFusedRungParity:
+    def test_fused_vs_xla_bitwise(self):
+        """The fused kernel rung equals the XLA rung bit for bit — and
+        the kernel path really engaged (it recorded its dispatch)."""
+        ladder, (df, dr, k, _E) = _ladder()
+        ladder.warmup()
+        off, shards, ids = _batch(df, dr, k, entity=3)
+        with K.scope("off"):
+            ref = np.asarray(ladder.score_padded(off, shards, ids))
+        with K.scope("on"):
+            from photon_tpu.kernels import serving as KS
+
+            assert KS.fused_feasible(*ladder.example_args(8))
+            got = np.asarray(ladder.score_padded(off, shards, ids))
+            assert K.KERNEL_SIGNATURES.signatures("kernels.serving_int8")
+        np.testing.assert_array_equal(ref, got)
+
+    def test_cold_miss_row_bitwise(self):
+        """An all-unseen-entity batch through the FUSED rung equals the
+        f32 ladder bit for bit: row E dequantizes to exact zeros inside
+        the kernel too."""
+        ladder, (df, dr, k, E) = _ladder()
+        f32, _ = _ladder(quantize=None)
+        ladder.warmup()
+        f32.warmup()
+        off, shards, ids = _batch(df, dr, k, entity=E)  # the cold row
+        # kernel == XLA on the quantized rung itself, cold row included
+        with K.scope("off"):
+            ref = np.asarray(ladder.score_padded(off, shards, ids))
+        with K.scope("on"):
+            got = np.asarray(ladder.score_padded(off, shards, ids))
+        np.testing.assert_array_equal(ref, got)
+        # and with no fixed contribution the fused int8 rung equals the
+        # f32 ladder outright: the cold row is EXACT zeros in-kernel
+        shards["global"] = np.zeros_like(shards["global"])
+        with K.scope("off"):
+            ref32 = np.asarray(f32.score_padded(off, shards, ids))
+        with K.scope("on"):
+            got8 = np.asarray(ladder.score_padded(off, shards, ids))
+        np.testing.assert_array_equal(ref32, got8)
+
+    def test_budget_infeasible_stays_xla(self, monkeypatch):
+        """Past the VMEM budget the rung stays on the XLA path — no
+        error, same bits (it IS the XLA program)."""
+        ladder, (df, dr, k, _E) = _ladder()
+        ladder.warmup()
+        off, shards, ids = _batch(df, dr, k)
+        with K.scope("off"):
+            ref = np.asarray(ladder.score_padded(off, shards, ids))
+        monkeypatch.setenv(K.ENV_VMEM, "1")
+        with K.scope("on"):
+            from photon_tpu.kernels import serving as KS
+
+            assert not KS.fused_feasible(*ladder.example_args(8))
+            got = np.asarray(ladder.score_padded(off, shards, ids))
+        np.testing.assert_array_equal(ref, got)
+
+    def test_mode_flips_never_move_signatures(self):
+        """Mixed batch sizes driven kernels-off AND kernels-on: the
+        rung dispatch signatures stay one-per-bucket (the route is an
+        executable fact, never a call-signature fact)."""
+        ladder, (df, dr, k, _E) = _ladder()
+        ladder.warmup()
+        for m in ("off", "on", "off"):
+            with K.scope(m):
+                for B, seed in ((8, 1), (16, 2), (8, 3)):
+                    off, shards, ids = _batch(df, dr, k, B=B, seed=seed)
+                    ladder.score_padded(off, shards, ids)
+        assert ladder.assert_no_retrace() <= len(ladder.ladder)
+
+    def test_aot_key_carries_route(self, monkeypatch):
+        """A stored export replays without tracing, so the kernel route
+        must be part of the AOT file identity: kernels-on feasible rungs
+        key with the ``:pk`` marker, everything else without."""
+        ladder, _ = _ladder()
+        with K.scope("off"):
+            key_off = ladder._key(8)
+        with K.scope("on"):
+            key_on = ladder._key(8)
+        assert key_on.endswith(":pk") and not key_off.endswith(":pk")
+        assert key_on[: -len(":pk")] == key_off
+        monkeypatch.setenv(K.ENV_VMEM, "1")
+        with K.scope("on"):
+            assert ladder._key(8) == key_off  # infeasible: XLA identity
+
+
+class TestHotSwapQuantCache:
+    def test_hot_swap_invalidates_qdev(self):
+        """Satellite 2: a `continual.hot_swap` swings `device_blocks()`
+        to a new generation, which invalidates the ladder's `_qdev`
+        quantized-block cache — the next KERNEL-path dispatch
+        re-quantizes and scores the new model (negated coefficients
+        mirror the logistic mean around 0.5), through the same
+        executables (no retrace)."""
+        from photon_tpu.continual import hot_swap
+
+        ladder, (df, dr, k, _E) = _ladder()
+        ladder.warmup()
+        off, shards, ids = _batch(df, dr, k, seed=31)
+        with K.scope("on"):
+            before = np.asarray(ladder.score_padded(off, shards, ids))
+        token_before = ladder._qdev[0]
+        other = copy.copy(ladder.store)
+        other.fixed = {n: dataclasses.replace(
+            b, weights=-np.asarray(b.weights))
+            for n, b in ladder.store.fixed.items()}
+        other.random = {n: dataclasses.replace(
+            b, coefficients=-np.asarray(b.coefficients))
+            for n, b in ladder.store.random.items()}
+        other._device = None
+        hot_swap(ladder.store, other, probe=None, root=None)
+        with K.scope("on"):
+            after = np.asarray(ladder.score_padded(off, shards, ids))
+        assert ladder._qdev[0] is not token_before  # cache turned over
+        np.testing.assert_allclose(before + after, 1.0, atol=1e-6)
+        assert ladder.assert_no_retrace() <= len(ladder.ladder)
